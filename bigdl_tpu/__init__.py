@@ -1,0 +1,12 @@
+"""bigdl_tpu — a TPU-native deep learning framework.
+
+A from-scratch JAX/XLA/Pallas rebuild of the capabilities of BigDL
+(reference: github.com/benjamim93/BigDL, mounted at /root/reference):
+Torch-style layer library, criterions, optimizers with LR schedules,
+local + distributed (SPMD mesh) training loops, data pipeline, model zoo,
+checkpointing, TensorBoard visualization and serving — all designed for
+TPU hardware: MXU-shaped matmuls, NHWC layouts, lax.scan recurrence,
+jax.sharding + psum collectives over the ICI mesh.
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,86 @@
+"""bigdl_tpu.nn — the layer library (reference DL/nn parity, TPU-native)."""
+
+from bigdl_tpu.nn.module import (Activity, ApplyContext, Module, Node,
+                                 functional_apply, merge_state, param_count,
+                                 topo_sort)
+from bigdl_tpu.nn.containers import (Bottle, CAddTable, CAveTable, CDivTable,
+                                     CMaxTable, CMinTable, CMulTable, CSubTable,
+                                     Concat, ConcatTable, Container, Echo,
+                                     FlattenTable, Graph, Identity, Input,
+                                     InputNode, JoinTable, MapTable,
+                                     MixtureTable, NarrowTable, ParallelTable,
+                                     SelectTable, Sequential, SplitTable)
+from bigdl_tpu.nn.linear import (Add, AddConstant, Bilinear, CAdd, CMul,
+                                 Cosine, Euclidean, Highway, Linear, Maxout,
+                                 Mul, MulConstant, Scale)
+from bigdl_tpu.nn.conv import (LocallyConnected2D, SpatialConvolution,
+                               SpatialDilatedConvolution, SpatialFullConvolution,
+                               SpatialSeparableConvolution,
+                               SpatialShareConvolution, TemporalConvolution,
+                               VolumetricConvolution)
+from bigdl_tpu.nn.pooling import (Pooler, ResizeBilinear, SpatialAveragePooling,
+                                  SpatialCrossMapLRN, SpatialMaxPooling,
+                                  TemporalMaxPooling, UpSampling1D, UpSampling2D,
+                                  UpSampling3D, VolumetricAveragePooling,
+                                  VolumetricMaxPooling)
+from bigdl_tpu.nn.normalization import (BatchNormalization, LayerNormalization,
+                                        Normalize, NormalizeScale,
+                                        SpatialBatchNormalization)
+from bigdl_tpu.nn.activation import (ELU, GELU, Abs, BinaryThreshold, Clamp,
+                                     Exp, GradientReversal, HardShrink,
+                                     HardSigmoid, HardTanh, LeakyReLU, Log,
+                                     LogSigmoid, LogSoftMax, Negative, Power,
+                                     PReLU, ReLU, ReLU6, RReLU, Sigmoid,
+                                     SoftMax, SoftMin, SoftPlus, SoftShrink,
+                                     SoftSign, Sqrt, Square, SReLU, Tanh,
+                                     TanhShrink, Threshold)
+from bigdl_tpu.nn.dropout import (Dropout, GaussianDropout, GaussianNoise,
+                                  GaussianSampler, SpatialDropout1D,
+                                  SpatialDropout2D, SpatialDropout3D)
+from bigdl_tpu.nn.shape_ops import (MM, MV, ActivityRegularization, Contiguous,
+                                    CosineDistance, Cropping2D, Cropping3D,
+                                    CrossProduct, DenseToSparse, DotProduct,
+                                    Index, InferReshape, Masking, MaskedSelect,
+                                    Max, Mean, Min, Narrow, Pack, Padding,
+                                    PairwiseDistance, Permute, Replicate,
+                                    Reshape, Reverse, Select, SpatialZeroPadding,
+                                    Squeeze, Sum, Tile, Transpose, Unsqueeze,
+                                    View)
+from bigdl_tpu.nn.embedding import (LookupTable, LookupTableSparse,
+                                    SparseJoinTable, SparseLinear)
+from bigdl_tpu.nn.recurrent import (BiRecurrent, Cell, ConvLSTMPeephole, GRU,
+                                    GRUCell, LSTM, LSTMCell, LSTMPeephole,
+                                    LSTMPeepholeCell, MultiRNNCell, Recurrent,
+                                    RecurrentDecoder, RnnCell, TimeDistributed)
+from bigdl_tpu.nn import criterion
+from bigdl_tpu.nn.criterion import (AbsCriterion, BCECriterion,
+                                    BCECriterionWithLogits, ClassNLLCriterion,
+                                    CosineDistanceCriterion,
+                                    CosineEmbeddingCriterion,
+                                    CosineProximityCriterion, Criterion,
+                                    CrossEntropyCriterion,
+                                    DiceCoefficientCriterion,
+                                    DistKLDivCriterion, DotProductCriterion,
+                                    GaussianCriterion, HingeEmbeddingCriterion,
+                                    KLDCriterion,
+                                    KullbackLeiblerDivergenceCriterion, L1Cost,
+                                    L1HingeEmbeddingCriterion, L1Penalty,
+                                    MarginCriterion, MarginRankingCriterion,
+                                    MeanAbsolutePercentageCriterion,
+                                    MeanSquaredLogarithmicCriterion,
+                                    MSECriterion, MultiCriterion,
+                                    MultiLabelMarginCriterion,
+                                    MultiLabelSoftMarginCriterion,
+                                    MultiMarginCriterion,
+                                    NegativeEntropyPenalty, ParallelCriterion,
+                                    PGCriterion, PoissonCriterion,
+                                    SmoothL1Criterion,
+                                    SmoothL1CriterionWithWeights,
+                                    SoftMarginCriterion, SoftmaxWithCriterion,
+                                    TimeDistributedCriterion,
+                                    TimeDistributedMaskCriterion,
+                                    TransformerCriterion)
+from bigdl_tpu.nn import initialization
+from bigdl_tpu.nn.initialization import (BilinearFiller, ConstInitMethod,
+                                         MsraFiller, Ones, RandomNormal,
+                                         RandomUniform, Xavier, Zeros)

@@ -1,0 +1,291 @@
+"""Activation layers.
+
+Parity: the reference's full activation list (SURVEY.md A.1 Activations) —
+ReLU, ReLU6, RReLU, PReLU, SReLU, ELU, LeakyReLU, Threshold, BinaryThreshold,
+HardShrink, SoftShrink, HardSigmoid, HardTanh, Sigmoid, LogSigmoid, Tanh,
+TanhShrink, SoftPlus, SoftSign, SoftMax, SoftMin, LogSoftMax + GELU. All are
+stateless jnp expressions; XLA fuses them into adjacent matmuls/convs, which
+is the TPU replacement for the reference's in-place `inplace=true` mode.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from bigdl_tpu.nn.module import Module
+
+
+class _Elementwise(Module):
+    def __init__(self, name=None, **kw):
+        super().__init__(name)
+
+    def fn(self, x):
+        raise NotImplementedError
+
+    def apply(self, params, input, ctx):
+        return self.fn(input)
+
+
+class ReLU(_Elementwise):
+    def __init__(self, ip: bool = False, name=None):
+        super().__init__(name)
+
+    def fn(self, x):
+        return jax.nn.relu(x)
+
+
+class ReLU6(_Elementwise):
+    def fn(self, x):
+        return jnp.clip(x, 0.0, 6.0)
+
+
+class Sigmoid(_Elementwise):
+    def fn(self, x):
+        return jax.nn.sigmoid(x)
+
+
+class LogSigmoid(_Elementwise):
+    def fn(self, x):
+        return jax.nn.log_sigmoid(x)
+
+
+class Tanh(_Elementwise):
+    def fn(self, x):
+        return jnp.tanh(x)
+
+
+class TanhShrink(_Elementwise):
+    def fn(self, x):
+        return x - jnp.tanh(x)
+
+
+class SoftPlus(_Elementwise):
+    def __init__(self, beta: float = 1.0, name=None):
+        super().__init__(name)
+        self.beta = beta
+
+    def fn(self, x):
+        return jax.nn.softplus(self.beta * x) / self.beta
+
+
+class SoftSign(_Elementwise):
+    def fn(self, x):
+        return jax.nn.soft_sign(x)
+
+
+class ELU(_Elementwise):
+    def __init__(self, alpha: float = 1.0, ip: bool = False, name=None):
+        super().__init__(name)
+        self.alpha = alpha
+
+    def fn(self, x):
+        return jax.nn.elu(x, self.alpha)
+
+
+class GELU(_Elementwise):
+    def fn(self, x):
+        return jax.nn.gelu(x)
+
+
+class LeakyReLU(_Elementwise):
+    def __init__(self, negval: float = 0.01, ip: bool = False, name=None):
+        super().__init__(name)
+        self.negval = negval
+
+    def fn(self, x):
+        return jax.nn.leaky_relu(x, self.negval)
+
+
+class Threshold(_Elementwise):
+    """x if x > th else value (DL/nn/Threshold.scala)."""
+
+    def __init__(self, th: float = 1e-6, v: float = 0.0, ip: bool = False, name=None):
+        super().__init__(name)
+        self.th, self.v = th, v
+
+    def fn(self, x):
+        return jnp.where(x > self.th, x, self.v)
+
+
+class BinaryThreshold(_Elementwise):
+    def __init__(self, th: float = 1e-6, name=None):
+        super().__init__(name)
+        self.th = th
+
+    def fn(self, x):
+        return (x > self.th).astype(x.dtype)
+
+
+class HardShrink(_Elementwise):
+    def __init__(self, lambd: float = 0.5, name=None):
+        super().__init__(name)
+        self.lambd = lambd
+
+    def fn(self, x):
+        return jnp.where(jnp.abs(x) > self.lambd, x, 0.0)
+
+
+class SoftShrink(_Elementwise):
+    def __init__(self, lambd: float = 0.5, name=None):
+        super().__init__(name)
+        self.lambd = lambd
+
+    def fn(self, x):
+        return jnp.sign(x) * jnp.maximum(jnp.abs(x) - self.lambd, 0.0)
+
+
+class HardSigmoid(_Elementwise):
+    """clip(0.2x + 0.5, 0, 1) — reference/Keras formula."""
+
+    def fn(self, x):
+        return jnp.clip(0.2 * x + 0.5, 0.0, 1.0)
+
+
+class HardTanh(_Elementwise):
+    def __init__(self, min_value: float = -1.0, max_value: float = 1.0,
+                 ip: bool = False, name=None):
+        super().__init__(name)
+        self.min_value, self.max_value = min_value, max_value
+
+    def fn(self, x):
+        return jnp.clip(x, self.min_value, self.max_value)
+
+
+class Clamp(HardTanh):
+    def __init__(self, min_v: float, max_v: float, name=None):
+        super().__init__(min_v, max_v, name=name)
+
+
+class SoftMax(_Elementwise):
+    def fn(self, x):
+        return jax.nn.softmax(x, axis=-1)
+
+
+class SoftMin(_Elementwise):
+    def fn(self, x):
+        return jax.nn.softmax(-x, axis=-1)
+
+
+class LogSoftMax(_Elementwise):
+    def fn(self, x):
+        return jax.nn.log_softmax(x, axis=-1)
+
+
+class PReLU(Module):
+    """Learned negative slope; n_output_plane=0 => single shared scalar
+    (DL/nn/PReLU.scala)."""
+
+    def __init__(self, n_output_plane: int = 0, name=None):
+        super().__init__(name)
+        self.n = n_output_plane
+
+    def init(self, rng):
+        shape = () if self.n == 0 else (self.n,)
+        return {"weight": jnp.full(shape, 0.25)}
+
+    def apply(self, params, input, ctx):
+        w = params["weight"]
+        return jnp.where(input >= 0, input, input * w)
+
+
+class RReLU(Module):
+    """Randomized leaky ReLU (DL/nn/RReLU.scala): train = random slope in
+    [lower, upper], eval = fixed mean slope."""
+
+    def __init__(self, lower: float = 1.0 / 8, upper: float = 1.0 / 3,
+                 ip: bool = False, name=None):
+        super().__init__(name)
+        self.lower, self.upper = lower, upper
+
+    def apply(self, params, input, ctx):
+        if ctx.training:
+            a = jax.random.uniform(ctx.make_rng(), input.shape,
+                                   minval=self.lower, maxval=self.upper)
+        else:
+            a = (self.lower + self.upper) / 2.0
+        return jnp.where(input >= 0, input, input * a)
+
+
+class SReLU(Module):
+    """S-shaped ReLU with 4 learned params per channel (DL/nn/SReLU.scala)."""
+
+    def __init__(self, shape, shared_axes=None, name=None):
+        super().__init__(name)
+        self.shape = tuple(shape) if not isinstance(shape, int) else (shape,)
+
+    def init(self, rng):
+        return {"tl": jnp.zeros(self.shape), "al": jnp.full(self.shape, 0.0),
+                "tr": jnp.ones(self.shape), "ar": jnp.ones(self.shape)}
+
+    def apply(self, params, input, ctx):
+        tl, al, tr, ar = params["tl"], params["al"], params["tr"], params["ar"]
+        y = jnp.where(input >= tr, tr + ar * (input - tr), input)
+        return jnp.where(y <= tl, tl + al * (y - tl), y)
+
+
+class Power(_Elementwise):
+    """(shift + scale*x)^power (DL/nn/Power.scala)."""
+
+    def __init__(self, power: float, scale: float = 1.0, shift: float = 0.0, name=None):
+        super().__init__(name)
+        self.power, self.scale, self.shift = power, scale, shift
+
+    def fn(self, x):
+        return jnp.power(self.shift + self.scale * x, self.power)
+
+
+class Sqrt(_Elementwise):
+    def fn(self, x):
+        return jnp.sqrt(x)
+
+
+class Square(_Elementwise):
+    def fn(self, x):
+        return x * x
+
+
+class Log(_Elementwise):
+    def fn(self, x):
+        return jnp.log(x)
+
+
+class Exp(_Elementwise):
+    def fn(self, x):
+        return jnp.exp(x)
+
+
+class Abs(_Elementwise):
+    def fn(self, x):
+        return jnp.abs(x)
+
+
+class Negative(_Elementwise):
+    def fn(self, x):
+        return -x
+
+
+class GradientReversal(Module):
+    """Identity forward, -lambda * grad backward (DL/nn/GradientReversal.scala).
+    Implemented with a custom VJP — the one place the reference's hand-written
+    backward survives into the autodiff world."""
+
+    def __init__(self, the_lambda: float = 1.0, name=None):
+        super().__init__(name)
+        self.the_lambda = the_lambda
+
+        @jax.custom_vjp
+        def rev(x):
+            return x
+
+        def fwd(x):
+            return x, None
+
+        def bwd(_, g):
+            return (-self.the_lambda * g,)
+
+        rev.defvjp(fwd, bwd)
+        self._rev = rev
+
+    def apply(self, params, input, ctx):
+        return self._rev(input)

@@ -1,0 +1,328 @@
+"""Containers and graph execution.
+
+Parity: reference `Container`/`Sequential`/`Concat`/`ConcatTable`/
+`ParallelTable`/`CAddTable`-family (DL/nn/*.scala) and the graph containers
+`Graph`/`StaticGraph` (DL/nn/Graph.scala:72, StaticGraph.scala:38). TPU-first
+translation: containers compose pure `apply` functions; graph execution is a
+pre-computed topological sort traced once under jit (no per-step scheduling).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from bigdl_tpu.nn.module import ApplyContext, Module, Node, topo_sort
+from bigdl_tpu.utils.table import T, Table
+
+
+class Container(Module):
+    def __init__(self, name: Optional[str] = None):
+        super().__init__(name)
+        self.children: List[Module] = []
+        self._child_keys: List[str] = []
+
+    def add(self, module: Module) -> "Container":
+        key = f"{len(self.children)}_{module.name}"
+        self.children.append(module)
+        self._child_keys.append(key)
+        return self
+
+    def init(self, rng: jax.Array) -> Dict:
+        params = {}
+        for key, child in zip(self._child_keys, self.children):
+            rng, sub = jax.random.split(rng)
+            params[key] = child.init(sub)
+        return params
+
+    def _collect_state(self, out, path):
+        for key, child in zip(self._child_keys, self.children):
+            child._collect_state(out, path + (key,))
+
+    def _apply_child(self, i: int, params: Dict, x, ctx: ApplyContext):
+        key = self._child_keys[i]
+        ctx.push(key)
+        try:
+            return self.children[i].apply(params[key], x, ctx)
+        finally:
+            ctx.pop()
+
+
+class Sequential(Container):
+    def apply(self, params, input, ctx):
+        x = input
+        for i in range(len(self.children)):
+            x = self._apply_child(i, params, x, ctx)
+        return x
+
+
+class ConcatTable(Container):
+    """Apply each child to the same input, return a Table of outputs."""
+
+    def apply(self, params, input, ctx):
+        return T(*[self._apply_child(i, params, input, ctx)
+                   for i in range(len(self.children))])
+
+
+class ParallelTable(Container):
+    """Apply child i to input[i] (Table input, Table output)."""
+
+    def apply(self, params, input, ctx):
+        vals = list(input) if isinstance(input, Table) else list(input)
+        return T(*[self._apply_child(i, params, x, ctx)
+                   for i, x in enumerate(vals)])
+
+
+class MapTable(Container):
+    """Apply the single shared child to every element of the input table."""
+
+    def apply(self, params, input, ctx):
+        vals = list(input) if isinstance(input, Table) else list(input)
+        return T(*[self._apply_child(0, params, x, ctx) for x in vals])
+
+
+class Concat(Container):
+    """Concat children outputs along `dimension` (reference 1-based, default
+    dim 2 = channel under NCHW batch layouts; here axis is 0-based)."""
+
+    def __init__(self, axis: int = 1, name=None):
+        super().__init__(name)
+        self.axis = axis
+
+    def apply(self, params, input, ctx):
+        outs = [self._apply_child(i, params, input, ctx)
+                for i in range(len(self.children))]
+        return jnp.concatenate(outs, axis=self.axis)
+
+
+class Bottle(Container):
+    """Fold leading dims so the child sees `n_input_dim`-D input, then restore
+    them (reference DL/nn/Bottle.scala). n_input_dim counts the child's
+    expected rank including batch (Torch convention)."""
+
+    def __init__(self, module: Module, n_input_dim: int = 2, name=None):
+        super().__init__(name)
+        self.add(module)
+        if n_input_dim < 1:
+            raise ValueError("n_input_dim must be >= 1")
+        self.n_input_dim = n_input_dim
+
+    def apply(self, params, input, ctx):
+        shape = input.shape
+        if len(shape) <= self.n_input_dim:
+            return self._apply_child(0, params, input, ctx)
+        trail = self.n_input_dim - 1
+        lead = shape[:len(shape) - trail]
+        x = jnp.reshape(input, (-1,) + (shape[len(shape) - trail:] if trail else ()))
+        y = self._apply_child(0, params, x, ctx)
+        return jnp.reshape(y, lead + y.shape[1:])
+
+
+# ---------------------------------------------------------------------- #
+# element-wise table reducers (CAddTable family)
+# ---------------------------------------------------------------------- #
+
+class _TableReduce(Module):
+    def _reduce(self, a, b):
+        raise NotImplementedError
+
+    def apply(self, params, input, ctx):
+        vals = list(input)
+        out = vals[0]
+        for v in vals[1:]:
+            out = self._reduce(out, v)
+        return out
+
+
+class CAddTable(_TableReduce):
+    def _reduce(self, a, b):
+        return a + b
+
+
+class CSubTable(_TableReduce):
+    def _reduce(self, a, b):
+        return a - b
+
+
+class CMulTable(_TableReduce):
+    def _reduce(self, a, b):
+        return a * b
+
+
+class CDivTable(_TableReduce):
+    def _reduce(self, a, b):
+        return a / b
+
+
+class CMaxTable(_TableReduce):
+    def _reduce(self, a, b):
+        return jnp.maximum(a, b)
+
+
+class CMinTable(_TableReduce):
+    def _reduce(self, a, b):
+        return jnp.minimum(a, b)
+
+
+class CAveTable(Module):
+    def apply(self, params, input, ctx):
+        vals = list(input)
+        return sum(vals) / float(len(vals))
+
+
+class JoinTable(Module):
+    """Concatenate table elements along an axis (0-based; reference
+    `JoinTable` uses 1-based dimension + nInputDims)."""
+
+    def __init__(self, axis: int = 1, name=None):
+        super().__init__(name)
+        self.axis = axis
+
+    def apply(self, params, input, ctx):
+        return jnp.concatenate(list(input), axis=self.axis)
+
+
+class SplitTable(Module):
+    def __init__(self, axis: int = 1, name=None):
+        super().__init__(name)
+        self.axis = axis
+
+    def apply(self, params, input, ctx):
+        n = input.shape[self.axis]
+        parts = jnp.split(input, n, axis=self.axis)
+        return T(*[jnp.squeeze(p, axis=self.axis) for p in parts])
+
+
+class FlattenTable(Module):
+    def apply(self, params, input, ctx):
+        flat = []
+
+        def rec(t):
+            if isinstance(t, Table):
+                for v in t:
+                    rec(v)
+            else:
+                flat.append(t)
+
+        rec(input)
+        return T(*flat)
+
+
+class SelectTable(Module):
+    """Select element `index` (1-based like the reference) from a table."""
+
+    def __init__(self, index: int, name=None):
+        super().__init__(name)
+        self.index = index
+
+    def apply(self, params, input, ctx):
+        vals = list(input)
+        i = self.index - 1 if self.index > 0 else self.index
+        return vals[i]
+
+
+class NarrowTable(Module):
+    def __init__(self, offset: int, length: int = 1, name=None):
+        super().__init__(name)
+        self.offset, self.length = offset, length
+
+    def apply(self, params, input, ctx):
+        vals = list(input)
+        return T(*vals[self.offset - 1: self.offset - 1 + self.length])
+
+
+class MixtureTable(Module):
+    """input = T(gates [B,K], experts Table/Tensor); weighted sum of experts."""
+
+    def apply(self, params, input, ctx):
+        gates, experts = input[1], input[2]
+        if isinstance(experts, Table):
+            stacked = jnp.stack(list(experts), axis=1)  # [B, K, ...]
+        else:
+            stacked = experts
+        g = gates.reshape(gates.shape + (1,) * (stacked.ndim - gates.ndim))
+        return jnp.sum(stacked * g, axis=1)
+
+
+# ---------------------------------------------------------------------- #
+# Graph
+# ---------------------------------------------------------------------- #
+
+class Input(Module):
+    """Graph input placeholder (reference DL/nn/Input.scala)."""
+
+    def apply(self, params, input, ctx):
+        return input
+
+
+def InputNode(name: Optional[str] = None) -> Node:
+    return Node(Input(name or "Input"), [])
+
+
+class Graph(Container):
+    """Static DAG container (reference StaticGraph.scala:38).
+
+    Build with the node DSL:
+        inp = InputNode()
+        h = Linear(10, 4).inputs(inp)
+        out = Linear(4, 2).inputs(h)
+        model = Graph([inp], [out])
+
+    Execution order is a topo sort computed once at construction; under jit
+    the whole DAG is traced into a single XLA computation, so there is no
+    runtime scheduler (the reference's Scheduler/FrameManager dynamic path is
+    unnecessary under XLA — data-dependent control flow must use lax.cond).
+    """
+
+    def __init__(self, inputs: Sequence[Node], outputs: Sequence[Node], name=None):
+        super().__init__(name)
+        self.input_nodes = list(inputs)
+        self.output_nodes = list(outputs)
+        self.exec_order = topo_sort(self.output_nodes)
+        for n in self.exec_order:
+            self.children.append(n.module)
+            self._child_keys.append(n.key)
+
+    def apply(self, params, input, ctx):
+        if isinstance(input, Table):
+            inputs = list(input)
+        elif isinstance(input, (list, tuple)):
+            inputs = list(input)
+        else:
+            inputs = [input]
+        if len(inputs) != len(self.input_nodes):
+            raise ValueError(
+                f"graph expects {len(self.input_nodes)} inputs, got {len(inputs)}")
+        values: Dict[int, any] = {}
+        for node, x in zip(self.input_nodes, inputs):
+            values[node.id] = x
+        for i, node in enumerate(self.exec_order):
+            if not node.prev:
+                x = values.get(node.id)
+            elif len(node.prev) == 1:
+                x = values[node.prev[0].id]
+            else:
+                x = T(*[values[p.id] for p in node.prev])
+            ctx.push(node.key)
+            try:
+                values[node.id] = node.module.apply(params[node.key], x, ctx)
+            finally:
+                ctx.pop()
+        outs = [values[n.id] for n in self.output_nodes]
+        return outs[0] if len(outs) == 1 else T(*outs)
+
+
+class Identity(Module):
+    def apply(self, params, input, ctx):
+        return input
+
+
+class Echo(Module):
+    """Debug pass-through (reference DL/nn/Echo.scala); prints at trace time."""
+
+    def apply(self, params, input, ctx):
+        shape = getattr(input, "shape", None)
+        print(f"[Echo {self.name}] shape={shape}")
+        return input

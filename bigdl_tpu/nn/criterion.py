@@ -1,0 +1,558 @@
+"""Loss functions.
+
+Parity: the reference's 38 criterions (SURVEY.md A.2, DL/nn/*Criterion*.scala).
+A Criterion is a pure function (output, target) -> scalar loss; autodiff
+replaces every hand-written `updateGradInput`. `size_average=True` matches the
+reference defaults. Targets for classification are 1-based class indices like
+the reference (Torch convention); pass `zero_based=True` for 0-based.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from bigdl_tpu.utils.table import Table
+
+
+class Criterion:
+    """Base: subclasses implement loss(output, target) -> scalar."""
+
+    def __init__(self, size_average: bool = True, name: Optional[str] = None):
+        self.size_average = size_average
+        self.name = name or self.__class__.__name__
+
+    def loss(self, output, target):
+        raise NotImplementedError
+
+    def apply(self, output, target):
+        return self.loss(output, target)
+
+    def forward(self, output, target):
+        return self.apply(output, target)
+
+    __call__ = forward
+
+    def _reduce(self, per_example):
+        return jnp.mean(per_example) if self.size_average else jnp.sum(per_example)
+
+
+def _class_indices(target, zero_based):
+    t = target.astype(jnp.int32)
+    if not zero_based:
+        t = t - 1
+    return t.reshape((-1,))
+
+
+class ClassNLLCriterion(Criterion):
+    """NLL over log-probabilities (pair with LogSoftMax), 1-based targets
+    (DL/nn/ClassNLLCriterion.scala). `weights` = per-class rescaling."""
+
+    def __init__(self, weights=None, size_average: bool = True,
+                 logProbAsInput: bool = True, zero_based: bool = False):
+        super().__init__(size_average)
+        self.weights = None if weights is None else jnp.asarray(weights)
+        self.log_prob = logProbAsInput
+        self.zero_based = zero_based
+
+    def loss(self, output, target):
+        logp = output if self.log_prob else jnp.log(output + 1e-8)
+        logp = logp.reshape((-1, logp.shape[-1]))
+        t = _class_indices(target, self.zero_based)
+        picked = jnp.take_along_axis(logp, t[:, None], axis=1)[:, 0]
+        if self.weights is not None:
+            w = jnp.take(self.weights, t)
+            losses = -picked * w
+            return jnp.sum(losses) / jnp.sum(w) if self.size_average else jnp.sum(losses)
+        return self._reduce(-picked)
+
+
+class CrossEntropyCriterion(Criterion):
+    """Softmax + NLL fused (DL/nn/CrossEntropyCriterion.scala); input =
+    unnormalized logits."""
+
+    def __init__(self, weights=None, size_average: bool = True, zero_based: bool = False):
+        super().__init__(size_average)
+        self.inner = ClassNLLCriterion(weights, size_average, True, zero_based)
+
+    def loss(self, output, target):
+        return self.inner.loss(jax.nn.log_softmax(output, axis=-1), target)
+
+
+class MSECriterion(Criterion):
+    def loss(self, output, target):
+        d = output - target
+        return jnp.mean(d * d) if self.size_average else jnp.sum(d * d)
+
+
+class AbsCriterion(Criterion):
+    def loss(self, output, target):
+        d = jnp.abs(output - target)
+        return jnp.mean(d) if self.size_average else jnp.sum(d)
+
+
+class SmoothL1Criterion(Criterion):
+    def loss(self, output, target):
+        d = jnp.abs(output - target)
+        l = jnp.where(d < 1.0, 0.5 * d * d, d - 0.5)
+        return jnp.mean(l) if self.size_average else jnp.sum(l)
+
+
+class SmoothL1CriterionWithWeights(Criterion):
+    def __init__(self, sigma: float = 1.0, num: int = 0):
+        super().__init__(size_average=False)
+        self.sigma2 = sigma * sigma
+        self.num = num
+
+    def loss(self, output, target):
+        if isinstance(target, Table):
+            t, inw, outw = target[1], target[2], target[3]
+        else:
+            t, inw, outw = target, 1.0, 1.0
+        d = jnp.abs((output - t) * inw)
+        l = jnp.where(d < 1.0 / self.sigma2,
+                      0.5 * self.sigma2 * d * d, d - 0.5 / self.sigma2)
+        s = jnp.sum(l * outw)
+        return s / self.num if self.num > 0 else s
+
+
+class BCECriterion(Criterion):
+    """Binary cross entropy on probabilities (DL/nn/BCECriterion.scala)."""
+
+    def __init__(self, weights=None, size_average: bool = True):
+        super().__init__(size_average)
+        self.weights = None if weights is None else jnp.asarray(weights)
+
+    def loss(self, output, target):
+        eps = 1e-12
+        o = jnp.clip(output, eps, 1.0 - eps)
+        l = -(target * jnp.log(o) + (1.0 - target) * jnp.log(1.0 - o))
+        if self.weights is not None:
+            l = l * self.weights
+        return jnp.mean(l) if self.size_average else jnp.sum(l)
+
+
+class BCECriterionWithLogits(Criterion):
+    """Numerically-stable sigmoid+BCE (TPU-friendly fused form)."""
+
+    def loss(self, output, target):
+        l = jnp.maximum(output, 0) - output * target + jnp.log1p(jnp.exp(-jnp.abs(output)))
+        return jnp.mean(l) if self.size_average else jnp.sum(l)
+
+
+class MarginCriterion(Criterion):
+    """Hinge loss / squared hinge (DL/nn/MarginCriterion.scala); target ±1."""
+
+    def __init__(self, margin: float = 1.0, size_average: bool = True,
+                 squared: bool = False):
+        super().__init__(size_average)
+        self.margin, self.squared = margin, squared
+
+    def loss(self, output, target):
+        l = jnp.maximum(0.0, self.margin - output * target)
+        if self.squared:
+            l = l * l
+        return jnp.mean(l) if self.size_average else jnp.sum(l)
+
+
+class MarginRankingCriterion(Criterion):
+    """input T(x1, x2), target y=±1 (DL/nn/MarginRankingCriterion.scala)."""
+
+    def __init__(self, margin: float = 1.0, size_average: bool = True):
+        super().__init__(size_average)
+        self.margin = margin
+
+    def loss(self, output, target):
+        x1, x2 = output[1], output[2]
+        y = target[1] if isinstance(target, Table) else target
+        l = jnp.maximum(0.0, -y * (x1 - x2) + self.margin)
+        return jnp.mean(l) if self.size_average else jnp.sum(l)
+
+
+class MultiLabelMarginCriterion(Criterion):
+    """Multi-class multi-label hinge (DL/nn/MultiLabelMarginCriterion.scala).
+    target rows: 1-based label ids, zero-padded."""
+
+    def loss(self, output, target):
+        t = target.astype(jnp.int32) - 1  # [B, C], -1 = pad
+        valid = t >= 0
+        safe = jnp.clip(t, 0, output.shape[-1] - 1)
+        tgt_scores = jnp.take_along_axis(output, safe, axis=1)  # [B, C]
+        is_target = jax.nn.one_hot(safe, output.shape[-1]) * valid[..., None]
+        is_target = jnp.clip(jnp.sum(is_target, axis=1), 0, 1)  # [B, D]
+        # for every (target j, non-target i): max(0, 1 - (x[j] - x[i]))
+        margins = 1.0 - (tgt_scores[:, :, None] - output[:, None, :])  # [B,C,D]
+        margins = jnp.maximum(margins, 0.0)
+        mask = valid[:, :, None] * (1.0 - is_target[:, None, :])
+        l = jnp.sum(margins * mask, axis=(1, 2)) / output.shape[-1]
+        return jnp.mean(l) if self.size_average else jnp.sum(l)
+
+
+class MultiLabelSoftMarginCriterion(Criterion):
+    def __init__(self, weights=None, size_average: bool = True):
+        super().__init__(size_average)
+        self.weights = None if weights is None else jnp.asarray(weights)
+
+    def loss(self, output, target):
+        l = jnp.maximum(output, 0) - output * target + jnp.log1p(jnp.exp(-jnp.abs(output)))
+        if self.weights is not None:
+            l = l * self.weights
+        l = jnp.mean(l, axis=-1)
+        return jnp.mean(l) if self.size_average else jnp.sum(l)
+
+
+class MultiMarginCriterion(Criterion):
+    """Multi-class hinge (DL/nn/MultiMarginCriterion.scala)."""
+
+    def __init__(self, p: int = 1, weights=None, margin: float = 1.0,
+                 size_average: bool = True, zero_based: bool = False):
+        super().__init__(size_average)
+        self.p, self.margin = p, margin
+        self.weights = None if weights is None else jnp.asarray(weights)
+        self.zero_based = zero_based
+
+    def loss(self, output, target):
+        t = _class_indices(target, self.zero_based)
+        tgt = jnp.take_along_axis(output, t[:, None], axis=1)
+        m = jnp.maximum(0.0, self.margin - (tgt - output))
+        if self.p == 2:
+            m = m * m
+        if self.weights is not None:
+            m = m * jnp.take(self.weights, t)[:, None]
+        one_hot = jax.nn.one_hot(t, output.shape[-1])
+        l = jnp.sum(m * (1 - one_hot), axis=-1) / output.shape[-1]
+        return jnp.mean(l) if self.size_average else jnp.sum(l)
+
+
+class HingeEmbeddingCriterion(Criterion):
+    def __init__(self, margin: float = 1.0, size_average: bool = True):
+        super().__init__(size_average)
+        self.margin = margin
+
+    def loss(self, output, target):
+        l = jnp.where(target > 0, output, jnp.maximum(0.0, self.margin - output))
+        return jnp.mean(l) if self.size_average else jnp.sum(l)
+
+
+class L1HingeEmbeddingCriterion(Criterion):
+    def __init__(self, margin: float = 1.0):
+        super().__init__()
+        self.margin = margin
+
+    def loss(self, output, target):
+        x1, x2 = output[1], output[2]
+        d = jnp.sum(jnp.abs(x1 - x2), axis=-1)
+        l = jnp.where(target.reshape(d.shape) > 0, d,
+                      jnp.maximum(0.0, self.margin - d))
+        return jnp.mean(l)
+
+
+class CosineEmbeddingCriterion(Criterion):
+    def __init__(self, margin: float = 0.0, size_average: bool = True):
+        super().__init__(size_average)
+        self.margin = margin
+
+    def loss(self, output, target):
+        x1, x2 = output[1], output[2]
+        cos = jnp.sum(x1 * x2, axis=-1) / (
+            jnp.linalg.norm(x1, axis=-1) * jnp.linalg.norm(x2, axis=-1) + 1e-12)
+        y = target[1] if isinstance(target, Table) else target
+        y = y.reshape(cos.shape)
+        l = jnp.where(y > 0, 1.0 - cos, jnp.maximum(0.0, cos - self.margin))
+        return jnp.mean(l) if self.size_average else jnp.sum(l)
+
+
+class CosineDistanceCriterion(Criterion):
+    def loss(self, output, target):
+        cos = jnp.sum(output * target, axis=-1) / (
+            jnp.linalg.norm(output, axis=-1) * jnp.linalg.norm(target, axis=-1) + 1e-12)
+        return self._reduce(1.0 - cos)
+
+
+class CosineProximityCriterion(Criterion):
+    def loss(self, output, target):
+        o = output / (jnp.linalg.norm(output, axis=-1, keepdims=True) + 1e-12)
+        t = target / (jnp.linalg.norm(target, axis=-1, keepdims=True) + 1e-12)
+        return -jnp.mean(jnp.sum(o * t, axis=-1))
+
+
+class DistKLDivCriterion(Criterion):
+    """KL(target || output) with output = log-probs (DL/nn/DistKLDivCriterion)."""
+
+    def loss(self, output, target):
+        l = jnp.where(target > 0, target * (jnp.log(target + 1e-12) - output), 0.0)
+        # Torch size_average divides by total element count
+        return jnp.mean(l) if self.size_average else jnp.sum(l)
+
+
+class KLDCriterion(Criterion):
+    """VAE KL to standard normal; input T(mean, logvar) (DL/nn/KLDCriterion)."""
+
+    def loss(self, output, target=None):
+        mean, logvar = output[1], output[2]
+        kl = 0.5 * jnp.sum(mean * mean + jnp.exp(logvar) - 1.0 - logvar, axis=-1)
+        return jnp.mean(kl)
+
+
+class KullbackLeiblerDivergenceCriterion(Criterion):
+    """Keras kld on probability vectors."""
+
+    def loss(self, output, target):
+        t = jnp.clip(target, 1e-7, 1.0)
+        o = jnp.clip(output, 1e-7, 1.0)
+        return jnp.mean(jnp.sum(t * jnp.log(t / o), axis=-1))
+
+
+class GaussianCriterion(Criterion):
+    """-log N(target; mean, exp(logvar)) (DL/nn/GaussianCriterion.scala)."""
+
+    def loss(self, output, target):
+        mean, logvar = output[1], output[2]
+        nll = 0.5 * (logvar + jnp.log(2 * jnp.pi)
+                     + (target - mean) ** 2 / jnp.exp(logvar))
+        return jnp.sum(nll)
+
+
+class PoissonCriterion(Criterion):
+    def loss(self, output, target):
+        return jnp.mean(output - target * jnp.log(output + 1e-7))
+
+
+class MeanAbsolutePercentageCriterion(Criterion):
+    def loss(self, output, target):
+        diff = jnp.abs(target - output) / jnp.clip(jnp.abs(target), 1e-7, None)
+        return 100.0 * jnp.mean(diff)
+
+
+class MeanSquaredLogarithmicCriterion(Criterion):
+    def loss(self, output, target):
+        a = jnp.log(jnp.clip(output, 1e-7, None) + 1.0)
+        b = jnp.log(jnp.clip(target, 1e-7, None) + 1.0)
+        return jnp.mean((a - b) ** 2)
+
+
+class L1Cost(Criterion):
+    def loss(self, output, target=None):
+        return jnp.sum(jnp.abs(output))
+
+
+class L1Penalty(Criterion):
+    def __init__(self, l1weight: float, size_average: bool = False,
+                 provide_output: bool = True):
+        super().__init__(size_average)
+        self.l1weight = l1weight
+
+    def loss(self, output, target=None):
+        return self.l1weight * jnp.sum(jnp.abs(output))
+
+
+class NegativeEntropyPenalty(Criterion):
+    def __init__(self, beta: float = 0.01):
+        super().__init__()
+        self.beta = beta
+
+    def loss(self, output, target=None):
+        p = jnp.clip(output, 1e-12, 1.0)
+        return self.beta * jnp.sum(p * jnp.log(p))
+
+
+class SoftMarginCriterion(Criterion):
+    def loss(self, output, target):
+        l = jnp.log1p(jnp.exp(-output * target))
+        return jnp.mean(l) if self.size_average else jnp.sum(l)
+
+
+class SoftmaxWithCriterion(Criterion):
+    """Caffe-style fused softmax loss with ignore_label
+    (DL/nn/SoftmaxWithCriterion.scala); input NHWC logits, target [B,H,W]."""
+
+    def __init__(self, ignore_label: Optional[int] = None,
+                 normalize_mode: str = "VALID", zero_based: bool = False):
+        super().__init__()
+        self.ignore_label = ignore_label
+        self.normalize_mode = normalize_mode
+        self.zero_based = zero_based
+
+    def loss(self, output, target):
+        logp = jax.nn.log_softmax(output, axis=-1)
+        t = target.astype(jnp.int32)
+        if not self.zero_based:
+            t = t - 1
+        valid = jnp.ones_like(t, dtype=jnp.float32)
+        if self.ignore_label is not None:
+            ig = self.ignore_label if self.zero_based else self.ignore_label - 1
+            valid = (t != ig).astype(jnp.float32)
+        safe = jnp.clip(t, 0, output.shape[-1] - 1)
+        picked = jnp.take_along_axis(logp, safe[..., None], axis=-1)[..., 0]
+        total = -jnp.sum(picked * valid)
+        if self.normalize_mode == "VALID":
+            return total / jnp.maximum(jnp.sum(valid), 1.0)
+        if self.normalize_mode == "BATCH_SIZE":
+            return total / output.shape[0]
+        if self.normalize_mode == "FULL":
+            return total / float(t.size)
+        return total
+
+
+class DiceCoefficientCriterion(Criterion):
+    def __init__(self, size_average: bool = True, epsilon: float = 1.0):
+        super().__init__(size_average)
+        self.epsilon = epsilon
+
+    def loss(self, output, target):
+        o = output.reshape((output.shape[0], -1))
+        t = target.reshape((target.shape[0], -1))
+        inter = jnp.sum(o * t, axis=1)
+        denom = jnp.sum(o, axis=1) + jnp.sum(t, axis=1)
+        dice = (2.0 * inter + self.epsilon) / (denom + self.epsilon)
+        return jnp.mean(1.0 - dice)
+
+
+class DotProductCriterion(Criterion):
+    def loss(self, output, target):
+        return -jnp.sum(output * target)
+
+
+class PGCriterion(Criterion):
+    """Policy-gradient criterion: -sum(log pi * reward)
+    (DL/nn/PGCriterion.scala)."""
+
+    def __init__(self, sizeAverage: bool = False):
+        super().__init__(sizeAverage)
+
+    def loss(self, output, target):
+        logp = jnp.log(output + 1e-12)
+        l = -jnp.sum(logp * target, axis=-1)
+        return jnp.mean(l) if self.size_average else jnp.sum(l)
+
+
+class ClassSimplexCriterion(Criterion):
+    """MSE against simplex-embedded class targets
+    (DL/nn/ClassSimplexCriterion.scala)."""
+
+    def __init__(self, n_classes: int):
+        super().__init__()
+        self.n_classes = n_classes
+        simplex = self._build_simplex(n_classes)
+        self.simplex = simplex
+
+    @staticmethod
+    def _build_simplex(n):
+        import numpy as np
+        a = np.zeros((n, n), dtype=np.float32)
+        a[0, 0] = 1.0
+        for k in range(1, n - 1):
+            s = float(np.dot(a[k - 1, :k], a[k - 1, :k]))
+            a[k, :k] = a[k - 1, :k]
+            a[k, k] = np.sqrt(max(0.0, 1.0 - s))
+        if n > 1:
+            c = (1.0 + np.sqrt(float(n))) / ((n - 1) ** 1.5)
+            a[n - 1] = -np.sum(a[:n - 1], axis=0) * c
+        return jnp.asarray(a)
+
+    def loss(self, output, target):
+        t = _class_indices(target, zero_based=False)
+        tgt = jnp.take(self.simplex, t, axis=0)
+        d = output - tgt
+        return jnp.mean(jnp.sum(d * d, axis=-1))
+
+
+class MultiCriterion(Criterion):
+    """Weighted sum of criterions on the same (output, target)."""
+
+    def __init__(self):
+        super().__init__()
+        self.criterions = []
+        self.weights = []
+
+    def add(self, criterion: Criterion, weight: float = 1.0):
+        self.criterions.append(criterion)
+        self.weights.append(weight)
+        return self
+
+    def loss(self, output, target):
+        return sum(w * c.loss(output, target)
+                   for c, w in zip(self.criterions, self.weights))
+
+
+class ParallelCriterion(Criterion):
+    """Each criterion consumes its slot of (output table, target table)."""
+
+    def __init__(self, repeat_target: bool = False):
+        super().__init__()
+        self.repeat_target = repeat_target
+        self.criterions = []
+        self.weights = []
+
+    def add(self, criterion: Criterion, weight: float = 1.0):
+        self.criterions.append(criterion)
+        self.weights.append(weight)
+        return self
+
+    def loss(self, output, target):
+        outs = list(output)
+        tgts = [target] * len(outs) if self.repeat_target else list(target)
+        return sum(w * c.loss(o, t)
+                   for c, w, o, t in zip(self.criterions, self.weights, outs, tgts))
+
+
+class TimeDistributedCriterion(Criterion):
+    """Apply a criterion at every timestep of [B, T, ...]
+    (DL/nn/TimeDistributedCriterion.scala)."""
+
+    def __init__(self, critrn: Criterion, size_average: bool = False,
+                 dimension: int = 1):
+        super().__init__(size_average)
+        self.critrn = critrn
+        self.dimension = dimension
+
+    def loss(self, output, target):
+        steps = output.shape[self.dimension]
+        total = 0.0
+        for t in range(steps):
+            o = jnp.take(output, t, axis=self.dimension)
+            g = jnp.take(target, t, axis=self.dimension)
+            total = total + self.critrn.loss(o, g)
+        return total / steps if self.size_average else total
+
+
+class TimeDistributedMaskCriterion(Criterion):
+    """Masked per-timestep NLL (padding-aware), parity with
+    DL/nn/TimeDistributedMaskCriterion.scala. Flattens [B,T] and relies on
+    the inner criterion's padding handling via target id 0 => masked."""
+
+    def __init__(self, critrn: Criterion, padding_value: int = 0):
+        super().__init__()
+        self.critrn = critrn
+        self.padding_value = padding_value
+
+    def loss(self, output, target):
+        C = output.shape[-1]
+        o = output.reshape((-1, C))
+        t = target.reshape((-1,))
+        mask = (t != self.padding_value).astype(jnp.float32)
+        safe_t = jnp.where(mask > 0, t, 1)
+        logp = o if isinstance(self.critrn, ClassNLLCriterion) else jax.nn.log_softmax(o, -1)
+        picked = jnp.take_along_axis(logp, (safe_t.astype(jnp.int32) - 1)[:, None], axis=1)[:, 0]
+        return -jnp.sum(picked * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+
+
+class TransformerCriterion(Criterion):
+    """Apply transformations to output/target before an inner criterion
+    (DL/nn/TransformerCriterion.scala)."""
+
+    def __init__(self, criterion: Criterion, input_transformer=None,
+                 target_transformer=None):
+        super().__init__()
+        self.criterion = criterion
+        self.input_transformer = input_transformer
+        self.target_transformer = target_transformer
+
+    def loss(self, output, target):
+        if self.input_transformer is not None:
+            output = self.input_transformer.forward(output)
+        if self.target_transformer is not None:
+            target = self.target_transformer.forward(target)
+        return self.criterion.loss(output, target)

@@ -1,0 +1,103 @@
+"""Stochastic regularization layers.
+
+Parity: Dropout (DL/nn/Dropout.scala), GaussianDropout, GaussianNoise,
+SpatialDropout1D/2D/3D, GaussianSampler (VAE reparameterization). RNG comes
+from the ApplyContext (deterministic per-path fold of the step key), the
+functional replacement for the reference's per-thread RandomGenerator.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from bigdl_tpu.nn.module import Module
+from bigdl_tpu.utils.table import Table
+
+
+class Dropout(Module):
+    """Keep-prob scaling at train time (inverted dropout), identity at eval.
+    `init_p` is the DROP probability like the reference (default 0.5)."""
+
+    def __init__(self, init_p: float = 0.5, inplace: bool = False,
+                 scale: bool = True, name=None):
+        super().__init__(name)
+        self.p = init_p
+        self.scale = scale
+
+    def apply(self, params, input, ctx):
+        if not ctx.training or self.p <= 0.0:
+            return input
+        keep = 1.0 - self.p
+        mask = jax.random.bernoulli(ctx.make_rng(), keep, input.shape)
+        y = jnp.where(mask, input, 0.0)
+        return y / keep if self.scale else y
+
+
+class GaussianDropout(Module):
+    """Multiplicative N(1, p/(1-p)) noise (DL/nn/GaussianDropout.scala)."""
+
+    def __init__(self, rate: float, name=None):
+        super().__init__(name)
+        self.rate = rate
+
+    def apply(self, params, input, ctx):
+        if not ctx.training:
+            return input
+        stddev = (self.rate / (1.0 - self.rate)) ** 0.5
+        noise = 1.0 + stddev * jax.random.normal(ctx.make_rng(), input.shape)
+        return input * noise
+
+
+class GaussianNoise(Module):
+    """Additive N(0, stddev) noise at train time (DL/nn/GaussianNoise.scala)."""
+
+    def __init__(self, stddev: float, name=None):
+        super().__init__(name)
+        self.stddev = stddev
+
+    def apply(self, params, input, ctx):
+        if not ctx.training:
+            return input
+        return input + self.stddev * jax.random.normal(ctx.make_rng(), input.shape)
+
+
+class _SpatialDropout(Module):
+    """Drop whole feature maps; mask shape keeps channel axis, broadcasts over
+    spatial axes (NHWC/N..C layouts)."""
+
+    spatial_ndim = 2
+
+    def __init__(self, init_p: float = 0.5, name=None):
+        super().__init__(name)
+        self.p = init_p
+
+    def apply(self, params, input, ctx):
+        if not ctx.training or self.p <= 0.0:
+            return input
+        keep = 1.0 - self.p
+        mask_shape = (input.shape[0],) + (1,) * self.spatial_ndim + (input.shape[-1],)
+        mask = jax.random.bernoulli(ctx.make_rng(), keep, mask_shape)
+        return jnp.where(mask, input, 0.0)
+
+
+class SpatialDropout1D(_SpatialDropout):
+    spatial_ndim = 1
+
+
+class SpatialDropout2D(_SpatialDropout):
+    spatial_ndim = 2
+
+
+class SpatialDropout3D(_SpatialDropout):
+    spatial_ndim = 3
+
+
+class GaussianSampler(Module):
+    """Sample from N(mean, exp(logvar)) given T(mean, logvar) — the VAE
+    reparameterization layer (DL/nn/GaussianSampler.scala)."""
+
+    def apply(self, params, input, ctx):
+        mean, logvar = input[1], input[2]
+        eps = jax.random.normal(ctx.make_rng(), mean.shape)
+        return mean + jnp.exp(0.5 * logvar) * eps

@@ -1,0 +1,159 @@
+"""Embedding / lookup layers.
+
+Parity: LookupTable (DL/nn/LookupTable.scala), LookupTableSparse
+(DL/nn/LookupTableSparse.scala — the Wide&Deep building block). TPU-first:
+lookups are `jnp.take` gathers (XLA lowers to dynamic-gather tiled for HBM);
+sparse bags become segment-sum over a padded [B, L] id matrix with a mask —
+static shapes instead of the reference's COO SparseTensor.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from bigdl_tpu.nn.initialization import InitializationMethod, RandomNormal
+from bigdl_tpu.nn.module import Module
+from bigdl_tpu.utils.table import Table
+
+
+class LookupTable(Module):
+    """Embedding lookup; ids are 1-based like the reference (padding_value=0
+    maps to a zero row when one_based=True)."""
+
+    def __init__(self, n_index: int, n_output: int, padding_value: float = 0,
+                 max_norm: float = float("inf"), norm_type: float = 2.0,
+                 should_scale_grad_by_freq: bool = False,
+                 weight_init: Optional[InitializationMethod] = None,
+                 one_based: bool = True, name=None):
+        super().__init__(name)
+        self.n_index, self.n_output = n_index, n_output
+        self.padding_value = padding_value
+        self.max_norm, self.norm_type = max_norm, norm_type
+        self.weight_init = weight_init or RandomNormal(0.0, 1.0)
+        self.one_based = one_based
+
+    def init(self, rng):
+        return {"weight": self.weight_init(rng, (self.n_index, self.n_output))}
+
+    def _embed(self, w, ids):
+        ids = ids.astype(jnp.int32)
+        pad = None
+        if self.padding_value:
+            pad = int(self.padding_value) - (1 if self.one_based else 0)
+        if self.one_based:
+            ids = ids - 1
+        safe = jnp.clip(ids, 0, self.n_index - 1)
+        out = jnp.take(w, safe, axis=0)
+        # zero out out-of-range ids (<0 after the 1-based shift) and the
+        # reference's paddingValue index
+        valid = ids >= 0
+        if pad is not None:
+            valid = valid & (ids != pad)
+        return jnp.where(valid[..., None], out, 0.0)
+
+    def apply(self, params, input, ctx):
+        w = params["weight"]
+        if self.max_norm != float("inf"):
+            norms = jnp.linalg.norm(w, ord=self.norm_type, axis=1, keepdims=True)
+            w = w * jnp.minimum(1.0, self.max_norm / (norms + 1e-7))
+        return self._embed(w, input)
+
+
+class LookupTableSparse(Module):
+    """Bag embedding with combiner sum|mean|sqrtn
+    (DL/nn/LookupTableSparse.scala). Input: T(ids [B, L], weights [B, L]) or
+    ids alone; L is the padded bag length, id 0 (1-based) = padding."""
+
+    def __init__(self, n_index: int, n_output: int, combiner: str = "sum",
+                 max_norm: float = -1, weight_init=None, name=None):
+        super().__init__(name)
+        self.inner = LookupTable(
+            n_index, n_output, weight_init=weight_init,
+            max_norm=(max_norm if max_norm > 0 else float("inf")))
+        self.combiner = combiner
+
+    def init(self, rng):
+        return {"embed": self.inner.init(rng)}
+
+    def apply(self, params, input, ctx):
+        if isinstance(input, Table):
+            ids, wts = input[1], input[2]
+        else:
+            ids, wts = input, None
+        ids = ids.astype(jnp.int32)
+        mask = (ids > 0).astype(jnp.float32) if self.inner.one_based else (ids >= 0).astype(jnp.float32)
+        emb = self.inner.apply(params["embed"], ids, ctx)  # [B, L, D], max_norm applied
+        w = mask if wts is None else wts * mask
+        weighted = emb * w[..., None]
+        s = jnp.sum(weighted, axis=1)
+        if self.combiner == "sum":
+            return s
+        denom = jnp.sum(w, axis=1, keepdims=True)
+        if self.combiner == "mean":
+            return s / jnp.maximum(denom, 1e-12)
+        if self.combiner == "sqrtn":
+            sq = jnp.sqrt(jnp.sum(w * w, axis=1, keepdims=True))
+            return s / jnp.maximum(sq, 1e-12)
+        raise ValueError(f"unknown combiner {self.combiner}")
+
+
+class SparseLinear(Module):
+    """Linear over a high-dim sparse feature vector, fed as T(indices [B, L],
+    values [B, L]) with padding index -1 — the TPU-static replacement for the
+    reference's SparseTensor input (DL/nn/SparseLinear.scala)."""
+
+    def __init__(self, input_size: int, output_size: int, with_bias: bool = True,
+                 backward_start: int = -1, backward_length: int = -1, name=None):
+        super().__init__(name)
+        self.input_size, self.output_size = input_size, output_size
+        self.with_bias = with_bias
+
+    def init(self, rng):
+        k1, k2 = jax.random.split(rng)
+        stdv = 1.0 / jnp.sqrt(self.input_size)
+        p = {"weight": jax.random.uniform(
+            k1, (self.input_size, self.output_size), minval=-stdv, maxval=stdv)}
+        if self.with_bias:
+            p["bias"] = jnp.zeros((self.output_size,))
+        return p
+
+    def apply(self, params, input, ctx):
+        if isinstance(input, Table):
+            idx, vals = input[1], input[2]
+        else:
+            # dense fallback
+            y = input @ params["weight"]
+            return y + params["bias"] if self.with_bias else y
+        idx = idx.astype(jnp.int32)
+        mask = (idx >= 0)
+        safe = jnp.clip(idx, 0, self.input_size - 1)
+        rows = jnp.take(params["weight"], safe, axis=0)  # [B, L, out]
+        vals = jnp.where(mask, vals, 0.0)
+        y = jnp.einsum("blo,bl->bo", rows, vals)
+        if self.with_bias:
+            y = y + params["bias"]
+        return y
+
+
+class SparseJoinTable(Module):
+    """Concatenate sparse (indices, values) pairs along the feature axis
+    (DL/nn/SparseJoinTable.scala). Inputs: Table of T(idx, val) with known
+    per-slot dimension sizes."""
+
+    def __init__(self, dims, name=None):
+        super().__init__(name)
+        self.dims = list(dims)
+
+    def apply(self, params, input, ctx):
+        offset = 0
+        idxs, vals = [], []
+        for slot, dim in zip(list(input), self.dims):
+            i, v = slot[1], slot[2]
+            shifted = jnp.where(i >= 0, i + offset, -1)
+            idxs.append(shifted)
+            vals.append(v)
+            offset += dim
+        return Table(jnp.concatenate(idxs, axis=1), jnp.concatenate(vals, axis=1))

@@ -1,0 +1,256 @@
+"""Dense / bilinear / elementwise-affine layers.
+
+Parity: reference Linear (DL/nn/Linear.scala), Bilinear, CMul, CAdd, Mul, Add,
+MulConstant, AddConstant, Maxout, Highway, Scale, Cosine, Euclidean.
+TPU-first: weights stored (in, out) so the forward is a single row-major
+`x @ w` feeding the MXU without transpose; autodiff supplies backward.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from bigdl_tpu.nn.initialization import InitializationMethod, RandomUniform, Xavier, Zeros
+from bigdl_tpu.nn.module import Module
+
+
+class Linear(Module):
+    """y = x @ W + b with W:[in, out].
+
+    Reference stores weight [out, in] (DL/nn/Linear.scala); we keep [in, out]
+    so the MXU consumes it directly. `weight_init` default matches the
+    reference's sqrt(1/fanIn) uniform reset().
+    """
+
+    def __init__(self, input_size: int, output_size: int, with_bias: bool = True,
+                 weight_init: Optional[InitializationMethod] = None,
+                 bias_init: Optional[InitializationMethod] = None,
+                 name: Optional[str] = None, dtype=jnp.float32):
+        super().__init__(name)
+        self.input_size = input_size
+        self.output_size = output_size
+        self.with_bias = with_bias
+        self.weight_init = weight_init or RandomUniform()
+        self.bias_init = bias_init or RandomUniform()
+        self.dtype = dtype
+
+    def init(self, rng):
+        k1, k2 = jax.random.split(rng)
+        p = {"weight": self.weight_init(k1, (self.input_size, self.output_size), self.dtype)}
+        if self.with_bias:
+            stdv = 1.0 / math.sqrt(self.input_size)
+            if isinstance(self.bias_init, RandomUniform) and self.bias_init.lower is None:
+                p["bias"] = jax.random.uniform(
+                    k2, (self.output_size,), self.dtype, minval=-stdv, maxval=stdv)
+            else:
+                p["bias"] = self.bias_init(k2, (self.output_size,), self.dtype)
+        return p
+
+    def apply(self, params, input, ctx):
+        x = input
+        flat = x.ndim > 2
+        if flat:
+            lead = x.shape[:-1]
+            x = x.reshape((-1, x.shape[-1]))
+        y = x @ params["weight"]
+        if self.with_bias:
+            y = y + params["bias"]
+        if flat:
+            y = y.reshape(lead + (self.output_size,))
+        return y
+
+
+class Bilinear(Module):
+    """y_k = x1 @ W_k @ x2 + b_k (reference DL/nn/Bilinear.scala)."""
+
+    def __init__(self, input_size1: int, input_size2: int, output_size: int,
+                 bias_res: bool = True, name=None):
+        super().__init__(name)
+        self.n1, self.n2, self.out = input_size1, input_size2, output_size
+        self.bias_res = bias_res
+
+    def init(self, rng):
+        k1, k2 = jax.random.split(rng)
+        stdv = 1.0 / math.sqrt(self.n1)
+        p = {"weight": jax.random.uniform(
+            k1, (self.out, self.n1, self.n2), minval=-stdv, maxval=stdv)}
+        if self.bias_res:
+            p["bias"] = jax.random.uniform(k2, (self.out,), minval=-stdv, maxval=stdv)
+        return p
+
+    def apply(self, params, input, ctx):
+        x1, x2 = input[1], input[2]
+        y = jnp.einsum("bi,oij,bj->bo", x1, params["weight"], x2)
+        if self.bias_res:
+            y = y + params["bias"]
+        return y
+
+
+class CMul(Module):
+    """Learned elementwise scale broadcast over the batch (DL/nn/CMul.scala)."""
+
+    def __init__(self, size, name=None):
+        super().__init__(name)
+        self.size = tuple(size)
+
+    def init(self, rng):
+        fan = int(jnp.prod(jnp.array(self.size)))
+        stdv = 1.0 / math.sqrt(fan)
+        return {"weight": jax.random.uniform(rng, self.size, minval=-stdv, maxval=stdv)}
+
+    def apply(self, params, input, ctx):
+        return input * params["weight"]
+
+
+class CAdd(Module):
+    """Learned elementwise bias (DL/nn/CAdd.scala)."""
+
+    def __init__(self, size, name=None):
+        super().__init__(name)
+        self.size = tuple(size)
+
+    def init(self, rng):
+        fan = int(jnp.prod(jnp.array(self.size)))
+        stdv = 1.0 / math.sqrt(fan)
+        return {"bias": jax.random.uniform(rng, self.size, minval=-stdv, maxval=stdv)}
+
+    def apply(self, params, input, ctx):
+        return input + params["bias"]
+
+
+class Mul(Module):
+    """Single learned scalar gain (DL/nn/Mul.scala)."""
+
+    def init(self, rng):
+        return {"weight": jax.random.uniform(rng, (), minval=-1.0, maxval=1.0)}
+
+    def apply(self, params, input, ctx):
+        return input * params["weight"]
+
+
+class Add(Module):
+    """Learned bias vector of size `input_size` (DL/nn/Add.scala)."""
+
+    def __init__(self, input_size: int, name=None):
+        super().__init__(name)
+        self.input_size = input_size
+
+    def init(self, rng):
+        stdv = 1.0 / math.sqrt(self.input_size)
+        return {"bias": jax.random.uniform(rng, (self.input_size,), minval=-stdv, maxval=stdv)}
+
+    def apply(self, params, input, ctx):
+        return input + params["bias"]
+
+
+class MulConstant(Module):
+    def __init__(self, scalar: float, name=None):
+        super().__init__(name)
+        self.scalar = scalar
+
+    def apply(self, params, input, ctx):
+        return input * self.scalar
+
+
+class AddConstant(Module):
+    def __init__(self, constant: float, name=None):
+        super().__init__(name)
+        self.constant = constant
+
+    def apply(self, params, input, ctx):
+        return input + self.constant
+
+
+class Maxout(Module):
+    """Maxout over `maxout_number` linear pieces (DL/nn/Maxout.scala)."""
+
+    def __init__(self, input_size: int, output_size: int, maxout_number: int, name=None):
+        super().__init__(name)
+        self.linear = Linear(input_size, output_size * maxout_number)
+        self.output_size, self.k = output_size, maxout_number
+
+    def init(self, rng):
+        return {"linear": self.linear.init(rng)}
+
+    def apply(self, params, input, ctx):
+        y = self.linear.apply(params["linear"], input, ctx)
+        y = y.reshape(y.shape[:-1] + (self.k, self.output_size))
+        return jnp.max(y, axis=-2)
+
+
+class Highway(Module):
+    """Highway layer: t*g(Wx) + (1-t)*x (reference keras/Highway pattern)."""
+
+    def __init__(self, size: int, with_bias: bool = True, activation=jnp.tanh, name=None):
+        super().__init__(name)
+        self.h = Linear(size, size, with_bias)
+        self.t = Linear(size, size, with_bias)
+        self.activation = activation
+
+    def init(self, rng):
+        k1, k2 = jax.random.split(rng)
+        return {"h": self.h.init(k1), "t": self.t.init(k2)}
+
+    def apply(self, params, input, ctx):
+        h = self.activation(self.h.apply(params["h"], input, ctx))
+        t = jax.nn.sigmoid(self.t.apply(params["t"], input, ctx))
+        return h * t + input * (1.0 - t)
+
+
+class Scale(Module):
+    """CMul followed by CAdd (DL/nn/Scale.scala)."""
+
+    def __init__(self, size, name=None):
+        super().__init__(name)
+        self.size = tuple(size)
+        self.cmul = CMul(size)
+        self.cadd = CAdd(size)
+
+    def init(self, rng):
+        k1, k2 = jax.random.split(rng)
+        return {"cmul": self.cmul.init(k1), "cadd": self.cadd.init(k2)}
+
+    def apply(self, params, input, ctx):
+        return self.cadd.apply(params["cadd"],
+                               self.cmul.apply(params["cmul"], input, ctx), ctx)
+
+
+class Cosine(Module):
+    """Cosine similarity of input to each of `output_size` weight rows
+    (DL/nn/Cosine.scala)."""
+
+    def __init__(self, input_size: int, output_size: int, name=None):
+        super().__init__(name)
+        self.input_size, self.output_size = input_size, output_size
+
+    def init(self, rng):
+        stdv = 1.0 / math.sqrt(self.input_size)
+        return {"weight": jax.random.uniform(
+            rng, (self.output_size, self.input_size), minval=-stdv, maxval=stdv)}
+
+    def apply(self, params, input, ctx):
+        w = params["weight"]
+        xn = input / (jnp.linalg.norm(input, axis=-1, keepdims=True) + 1e-12)
+        wn = w / (jnp.linalg.norm(w, axis=-1, keepdims=True) + 1e-12)
+        return xn @ wn.T
+
+
+class Euclidean(Module):
+    """Pairwise L2 distance to weight rows (DL/nn/Euclidean.scala)."""
+
+    def __init__(self, input_size: int, output_size: int, name=None):
+        super().__init__(name)
+        self.input_size, self.output_size = input_size, output_size
+
+    def init(self, rng):
+        stdv = 1.0 / math.sqrt(self.input_size)
+        return {"weight": jax.random.uniform(
+            rng, (self.output_size, self.input_size), minval=-stdv, maxval=stdv)}
+
+    def apply(self, params, input, ctx):
+        diff = input[:, None, :] - params["weight"][None, :, :]
+        return jnp.sqrt(jnp.sum(diff * diff, axis=-1) + 1e-12)

@@ -1,0 +1,248 @@
+"""Core module contract for the TPU-native framework.
+
+Role parity: reference `AbstractModule` (DL/nn/abstractnn/AbstractModule.scala:59)
+defines a stateful forward/backward contract where every layer hand-writes
+`updateOutput/updateGradInput/accGradParameters`. On TPU the contract is
+functional instead: a `Module` is a *pure function* of an explicit parameter
+pytree — `apply(params, x, ctx)` — and autodiff (`jax.grad`) replaces every
+hand-written backward. Mutable layer state (BatchNorm running stats) lives in a
+separate state pytree threaded through an `ApplyContext`, so the whole model
+stays jit-compilable with XLA.
+
+The stateful Torch-style surface (`forward`, `parameters`, `training`/
+`evaluate`) is kept as a thin facade over the functional core so user code
+reads like the reference API.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from bigdl_tpu.utils.table import Table
+
+Activity = Any  # Tensor | Table | list of Activities (reference Activity.scala:33)
+
+
+class ApplyContext:
+    """Threaded through `apply` to carry training flag, RNG, and layer state.
+
+    Replaces the reference's implicit JVM-object state: BatchNorm running
+    stats, dropout RNG, per-layer timing. State is a flat dict keyed by the
+    module path (a tuple of child names), collected functionally so a jitted
+    train step can return the updated state pytree.
+    """
+
+    def __init__(self, training: bool = False, rng: Optional[jax.Array] = None,
+                 state: Optional[Dict[Tuple[str, ...], Any]] = None):
+        self.training = training
+        self._rng = rng
+        self._rng_count = 0
+        self.state = state or {}
+        self.new_state: Dict[Tuple[str, ...], Any] = {}
+        self._path: List[str] = []
+
+    # -- path scoping (containers push child names) --
+    def push(self, name: str):
+        self._path.append(name)
+
+    def pop(self):
+        self._path.pop()
+
+    @property
+    def path(self) -> Tuple[str, ...]:
+        return tuple(self._path)
+
+    # -- state access for stateful layers (BatchNorm) --
+    def get_state(self, default_fn: Callable[[], Any]) -> Any:
+        key = self.path
+        if key in self.state:
+            return self.state[key]
+        return default_fn()
+
+    def put_state(self, value: Any):
+        self.new_state[self.path] = value
+
+    # -- deterministic per-call RNG (dropout, noise layers) --
+    def make_rng(self) -> jax.Array:
+        if self._rng is None:
+            raise ValueError(
+                "This model needs an RNG (dropout/noise layer) but none was "
+                "provided; pass rng= to forward()/train step.")
+        self._rng_count += 1
+        return jax.random.fold_in(self._rng, self._rng_count)
+
+
+class Module:
+    """Base class for all layers and containers.
+
+    Functional core:
+      init(rng) -> params pytree (nested dicts of jnp arrays)
+      apply(params, input, ctx) -> output
+
+    Stateful facade (for API parity + interactive use):
+      forward(x) — initializes params lazily with a default seed, runs apply.
+    """
+
+    def __init__(self, name: Optional[str] = None):
+        self.name = name or self.__class__.__name__
+        self.training_mode = True
+        self._params: Optional[Dict] = None  # cached stateful params
+        self._state: Dict = {}
+
+    # ------------------------------------------------------------------ #
+    # functional contract
+    # ------------------------------------------------------------------ #
+    def init(self, rng: jax.Array) -> Dict:
+        """Create this module's parameter pytree. Leaf default: no params."""
+        return {}
+
+    def apply(self, params: Dict, input: Activity, ctx: ApplyContext) -> Activity:
+        raise NotImplementedError(f"{self.name}.apply")
+
+    def state_init(self) -> Dict[Tuple[str, ...], Any]:
+        """Initial (path-keyed) state pytree; BatchNorm etc. override
+        `_init_state` and containers aggregate recursively."""
+        out: Dict[Tuple[str, ...], Any] = {}
+        self._collect_state(out, ())
+        return out
+
+    def _collect_state(self, out: Dict, path: Tuple[str, ...]):
+        s = self._init_state()
+        if s is not None:
+            out[path] = s
+
+    def _init_state(self):
+        return None
+
+    # ------------------------------------------------------------------ #
+    # stateful facade
+    # ------------------------------------------------------------------ #
+    def ensure_params(self, rng: Optional[jax.Array] = None) -> Dict:
+        if self._params is None:
+            if rng is None:
+                rng = jax.random.PRNGKey(0)
+            self._params = self.init(rng)
+            self._state = self.state_init()
+        return self._params
+
+    def set_params(self, params: Dict):
+        self._params = params
+
+    def parameters(self) -> Dict:
+        """Reference `AbstractModule.parameters` (AbstractModule.scala:347)."""
+        return self.ensure_params()
+
+    def get_parameters_flat(self) -> jnp.ndarray:
+        """Flatten all params into one 1-D vector — the reference's compact
+        storage trick (`AbstractModule.getParameters:987`) that enabled flat
+        allreduce. On TPU this is only used for param counting/debug; sharded
+        pytrees replace the flat vector in the comm plane."""
+        leaves = jax.tree_util.tree_leaves(self.ensure_params())
+        if not leaves:
+            return jnp.zeros((0,))
+        return jnp.concatenate([jnp.ravel(l) for l in leaves])
+
+    def forward(self, input: Activity, training: Optional[bool] = None,
+                rng: Optional[jax.Array] = None) -> Activity:
+        params = self.ensure_params()
+        t = self.training_mode if training is None else training
+        ctx = ApplyContext(training=t, rng=rng, state=self._state)
+        out = self.apply(params, input, ctx)
+        if ctx.new_state:
+            self._state = {**self._state, **ctx.new_state}
+        return out
+
+    __call__ = forward
+
+    def training(self):
+        self.training_mode = True
+        return self
+
+    def evaluate(self):
+        self.training_mode = False
+        return self
+
+    # ------------------------------------------------------------------ #
+    # graph-building DSL: layer.inputs(node...) like reference Graph
+    # ------------------------------------------------------------------ #
+    def inputs(self, *nodes: "Node") -> "Node":
+        flat: List[Node] = []
+        for n in nodes:
+            if isinstance(n, (list, tuple)):
+                flat.extend(n)
+            else:
+                flat.append(n)
+        return Node(self, flat)
+
+    def __repr__(self):
+        return f"{self.__class__.__name__}({self.name})"
+
+
+
+class Node:
+    """A node in a model graph; wraps a Module plus its input edges.
+
+    Mirrors reference `Node`/`DirectedGraph` (DL/utils/DirectedGraph.scala) in
+    spirit; execution order is a topological sort done once at Graph build."""
+
+    _count = 0
+
+    def __init__(self, module: Module, prev: Sequence["Node"]):
+        Node._count += 1
+        self.id = Node._count
+        self.module = module
+        self.prev = list(prev)
+        self.key = f"{module.name}_{self.id}"
+
+    def __repr__(self):
+        return f"Node({self.key})"
+
+
+def topo_sort(outputs: Sequence[Node]) -> List[Node]:
+    """Topological order of the DAG rooted (reversed) at `outputs`.
+
+    Parity: StaticGraph executes via a pre-computed topo sort
+    (DL/nn/StaticGraph.scala:44,56-84)."""
+    order: List[Node] = []
+    seen = set()
+
+    def visit(n: Node, stack: Tuple[int, ...]):
+        if n.id in stack:
+            raise ValueError("cycle detected in graph")
+        if n.id in seen:
+            return
+        for p in n.prev:
+            visit(p, stack + (n.id,))
+        seen.add(n.id)
+        order.append(n)
+
+    for o in outputs:
+        visit(o, ())
+    return order
+
+
+def functional_apply(module: Module, params: Dict, input: Activity, *,
+                     state: Optional[Dict] = None, training: bool = False,
+                     rng: Optional[jax.Array] = None):
+    """Pure entry point used by jitted train/eval steps.
+
+    Returns (output, new_state). `new_state` contains only updated entries;
+    merge with the old state dict outside."""
+    ctx = ApplyContext(training=training, rng=rng, state=state or {})
+    out = module.apply(params, input, ctx)
+    return out, ctx.new_state
+
+
+def merge_state(old: Dict, new: Dict) -> Dict:
+    merged = dict(old)
+    merged.update(new)
+    return merged
+
+
+def param_count(params: Dict) -> int:
+    return sum(int(np.prod(l.shape)) for l in jax.tree_util.tree_leaves(params))

@@ -1,0 +1,142 @@
+"""Normalization layers.
+
+Parity: BatchNormalization (DL/nn/BatchNormalization.scala),
+SpatialBatchNormalization, Normalize, NormalizeScale. Running stats are kept
+in the ApplyContext state pytree (not in-object mutation) so a jitted train
+step stays pure; the moving-average update matches the reference's
+`momentum` convention (new = (1-m)*old + m*batch).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from bigdl_tpu.nn.module import ApplyContext, Module
+
+
+class BatchNormalization(Module):
+    """BN over the last axis of [B, C] input (reference 1-D BN)."""
+
+    def __init__(self, n_output: int, eps: float = 1e-5, momentum: float = 0.1,
+                 affine: bool = True, name: Optional[str] = None, dtype=jnp.float32):
+        super().__init__(name)
+        self.n_output = n_output
+        self.eps, self.momentum, self.affine = eps, momentum, affine
+        self.dtype = dtype
+        # which axes to reduce over; subclasses override
+        self._axes: Tuple[int, ...] = (0,)
+
+    def init(self, rng):
+        if not self.affine:
+            return {}
+        k1, k2 = jax.random.split(rng)
+        # reference reset(): weight ~ U(0,1), bias = 0 — we use ones/zeros
+        # (the modern and Keras-parity default; reference Keras path also ones)
+        return {"weight": jnp.ones((self.n_output,), self.dtype),
+                "bias": jnp.zeros((self.n_output,), self.dtype)}
+
+    def _init_state(self):
+        return {"mean": jnp.zeros((self.n_output,), self.dtype),
+                "var": jnp.ones((self.n_output,), self.dtype)}
+
+    def apply(self, params, input, ctx: ApplyContext):
+        x = input
+        st = ctx.get_state(self._init_state)
+        if ctx.training:
+            mean = jnp.mean(x, axis=self._axes)
+            var = jnp.var(x, axis=self._axes)
+            n = 1.0
+            for a in self._axes:
+                n *= x.shape[a]
+            unbiased = var * n / max(n - 1.0, 1.0)
+            m = self.momentum
+            ctx.put_state({
+                "mean": (1 - m) * st["mean"] + m * mean,
+                "var": (1 - m) * st["var"] + m * unbiased,
+            })
+        else:
+            mean, var = st["mean"], st["var"]
+        inv = jax.lax.rsqrt(var + self.eps)
+        if self.affine:
+            # fold scale into one fused multiply-add (XLA fuses this with the
+            # surrounding conv under jit)
+            scale = params["weight"] * inv
+            shift = params["bias"] - mean * scale
+        else:
+            scale, shift = inv, -mean * inv
+        return x * scale + shift
+
+
+class SpatialBatchNormalization(BatchNormalization):
+    """BN over NHWC [B, H, W, C] (reference DL/nn/SpatialBatchNormalization
+    is NCHW; we normalize the trailing channel axis, TPU-native layout)."""
+
+    def __init__(self, n_output: int, eps: float = 1e-5, momentum: float = 0.1,
+                 affine: bool = True, data_format: str = "NHWC", name=None):
+        super().__init__(n_output, eps, momentum, affine, name)
+        self.data_format = data_format
+        self._axes = (0, 1, 2)
+
+    def apply(self, params, input, ctx):
+        if self.data_format == "NCHW":
+            x = jnp.transpose(input, (0, 2, 3, 1))
+            y = super().apply(params, x, ctx)
+            return jnp.transpose(y, (0, 3, 1, 2))
+        return super().apply(params, input, ctx)
+
+
+class Normalize(Module):
+    """Lp-normalize along the channel axis (DL/nn/Normalize.scala)."""
+
+    def __init__(self, p: float = 2.0, eps: float = 1e-10, axis: int = -1, name=None):
+        super().__init__(name)
+        self.p, self.eps, self.axis = p, eps, axis
+
+    def apply(self, params, input, ctx):
+        if self.p == float("inf"):
+            norm = jnp.max(jnp.abs(input), axis=self.axis, keepdims=True)
+        else:
+            norm = jnp.power(
+                jnp.sum(jnp.power(jnp.abs(input), self.p), axis=self.axis, keepdims=True),
+                1.0 / self.p)
+        return input / (norm + self.eps)
+
+
+class NormalizeScale(Module):
+    """Normalize + learned per-channel scale (DL/nn/NormalizeScale.scala,
+    the SSD conv4_3 trick)."""
+
+    def __init__(self, p: float = 2.0, scale: float = 1.0, size=None,
+                 eps: float = 1e-10, name=None):
+        super().__init__(name)
+        self.norm = Normalize(p, eps)
+        self.scale_init = scale
+        self.size = tuple(size) if size is not None else None
+
+    def init(self, rng):
+        return {"scale": jnp.full(self.size or (1,), self.scale_init)}
+
+    def apply(self, params, input, ctx):
+        return self.norm.apply({}, input, ctx) * params["scale"]
+
+
+class LayerNormalization(Module):
+    """Layer norm over the last axis — present in the reference's keras2/
+    transformer extensions; included here as a core primitive."""
+
+    def __init__(self, hidden_size: int, eps: float = 1e-5, name=None):
+        super().__init__(name)
+        self.hidden_size, self.eps = hidden_size, eps
+
+    def init(self, rng):
+        return {"weight": jnp.ones((self.hidden_size,)),
+                "bias": jnp.zeros((self.hidden_size,))}
+
+    def apply(self, params, input, ctx):
+        mean = jnp.mean(input, axis=-1, keepdims=True)
+        var = jnp.var(input, axis=-1, keepdims=True)
+        y = (input - mean) * jax.lax.rsqrt(var + self.eps)
+        return y * params["weight"] + params["bias"]

@@ -1,0 +1,240 @@
+"""Pooling and local normalization layers.
+
+Parity: SpatialMaxPooling / SpatialAveragePooling (DL/nn/Spatial*Pooling.scala),
+TemporalMaxPooling, VolumetricMax/AveragePooling, SpatialCrossMapLRN,
+UpSampling1D/2D/3D, ResizeBilinear. All NHWC; `lax.reduce_window` is the
+XLA-native pooling primitive.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from bigdl_tpu.nn.module import Module
+
+
+def _pool_pad(pad_h, pad_w, ceil_mode, ih, iw, kh, kw, sh, sw):
+    if pad_h == -1 or pad_h == "SAME":
+        return "SAME"
+    if not ceil_mode:
+        return [(pad_h, pad_h), (pad_w, pad_w)]
+    # ceil mode: add extra right/bottom padding so the last window fits
+    def extra(i, k, s, p):
+        out = -(-(i + 2 * p - k) // s) + 1  # ceil
+        need = (out - 1) * s + k - (i + 2 * p)
+        return max(0, need)
+    return [(pad_h, pad_h + extra(ih, kh, sh, pad_h)),
+            (pad_w, pad_w + extra(iw, kw, sw, pad_w))]
+
+
+class SpatialMaxPooling(Module):
+    """(DL/nn/SpatialMaxPooling.scala); NHWC."""
+
+    def __init__(self, kw: int, kh: int, dw: Optional[int] = None, dh: Optional[int] = None,
+                 pad_w: int = 0, pad_h: int = 0, ceil_mode: bool = False,
+                 data_format: str = "NHWC", name=None):
+        super().__init__(name)
+        self.kw, self.kh = kw, kh
+        self.dw, self.dh = dw or kw, dh or kh
+        self.pad_w, self.pad_h = pad_w, pad_h
+        self.ceil_mode = ceil_mode
+        self.data_format = data_format
+
+    def ceil(self):
+        self.ceil_mode = True
+        return self
+
+    def apply(self, params, input, ctx):
+        x = input
+        if self.data_format == "NCHW":
+            x = jnp.transpose(x, (0, 2, 3, 1))
+        pad = _pool_pad(self.pad_h, self.pad_w, self.ceil_mode,
+                        x.shape[1], x.shape[2], self.kh, self.kw, self.dh, self.dw)
+        if pad == "SAME":
+            padding = "SAME"
+        else:
+            padding = [(0, 0)] + list(pad) + [(0, 0)]
+        y = lax.reduce_window(
+            x, -jnp.inf, lax.max,
+            window_dimensions=(1, self.kh, self.kw, 1),
+            window_strides=(1, self.dh, self.dw, 1),
+            padding=padding)
+        if self.data_format == "NCHW":
+            y = jnp.transpose(y, (0, 3, 1, 2))
+        return y
+
+
+class SpatialAveragePooling(Module):
+    """(DL/nn/SpatialAveragePooling.scala). `count_include_pad` default True
+    matches the reference."""
+
+    def __init__(self, kw: int, kh: int, dw: Optional[int] = None, dh: Optional[int] = None,
+                 pad_w: int = 0, pad_h: int = 0, ceil_mode: bool = False,
+                 count_include_pad: bool = True, divide: bool = True,
+                 data_format: str = "NHWC", name=None):
+        super().__init__(name)
+        self.kw, self.kh = kw, kh
+        self.dw, self.dh = dw or kw, dh or kh
+        self.pad_w, self.pad_h = pad_w, pad_h
+        self.ceil_mode = ceil_mode
+        self.count_include_pad = count_include_pad
+        self.divide = divide
+        self.data_format = data_format
+
+    def apply(self, params, input, ctx):
+        x = input
+        if self.data_format == "NCHW":
+            x = jnp.transpose(x, (0, 2, 3, 1))
+        pad = _pool_pad(self.pad_h, self.pad_w, self.ceil_mode,
+                        x.shape[1], x.shape[2], self.kh, self.kw, self.dh, self.dw)
+        padding = "SAME" if pad == "SAME" else [(0, 0)] + list(pad) + [(0, 0)]
+        s = lax.reduce_window(
+            x, 0.0, lax.add,
+            window_dimensions=(1, self.kh, self.kw, 1),
+            window_strides=(1, self.dh, self.dw, 1), padding=padding)
+        if self.divide:
+            if self.count_include_pad and pad != "SAME":
+                s = s / float(self.kh * self.kw)
+            else:
+                ones = jnp.ones_like(x)
+                cnt = lax.reduce_window(
+                    ones, 0.0, lax.add,
+                    window_dimensions=(1, self.kh, self.kw, 1),
+                    window_strides=(1, self.dh, self.dw, 1), padding=padding)
+                s = s / cnt
+        y = s
+        if self.data_format == "NCHW":
+            y = jnp.transpose(y, (0, 3, 1, 2))
+        return y
+
+
+class TemporalMaxPooling(Module):
+    """1-D max pooling over [B, T, C] (DL/nn/TemporalMaxPooling.scala)."""
+
+    def __init__(self, kw: int, dw: Optional[int] = None, name=None):
+        super().__init__(name)
+        self.kw, self.dw = kw, dw or kw
+
+    def apply(self, params, input, ctx):
+        return lax.reduce_window(
+            input, -jnp.inf, lax.max,
+            window_dimensions=(1, self.kw, 1),
+            window_strides=(1, self.dw, 1), padding="VALID")
+
+
+class VolumetricMaxPooling(Module):
+    def __init__(self, kt, kw, kh, dt=None, dw=None, dh=None,
+                 pad_t=0, pad_w=0, pad_h=0, name=None):
+        super().__init__(name)
+        self.k = (kt, kh, kw)
+        self.s = (dt or kt, dh or kh, dw or kw)
+        self.p = (pad_t, pad_h, pad_w)
+
+    def apply(self, params, input, ctx):
+        padding = [(0, 0)] + [(pp, pp) for pp in self.p] + [(0, 0)]
+        return lax.reduce_window(
+            input, -jnp.inf, lax.max,
+            window_dimensions=(1,) + self.k + (1,),
+            window_strides=(1,) + self.s + (1,), padding=padding)
+
+
+class VolumetricAveragePooling(Module):
+    def __init__(self, kt, kw, kh, dt=None, dw=None, dh=None,
+                 pad_t=0, pad_w=0, pad_h=0, name=None):
+        super().__init__(name)
+        self.k = (kt, kh, kw)
+        self.s = (dt or kt, dh or kh, dw or kw)
+        self.p = (pad_t, pad_h, pad_w)
+
+    def apply(self, params, input, ctx):
+        padding = [(0, 0)] + [(pp, pp) for pp in self.p] + [(0, 0)]
+        s = lax.reduce_window(
+            input, 0.0, lax.add,
+            window_dimensions=(1,) + self.k + (1,),
+            window_strides=(1,) + self.s + (1,), padding=padding)
+        return s / float(self.k[0] * self.k[1] * self.k[2])
+
+
+class SpatialCrossMapLRN(Module):
+    """Local response normalization across channels
+    (DL/nn/SpatialCrossMapLRN.scala); NHWC channel-last window sum."""
+
+    def __init__(self, size: int = 5, alpha: float = 1.0, beta: float = 0.75,
+                 k: float = 1.0, data_format: str = "NHWC", name=None):
+        super().__init__(name)
+        self.size, self.alpha, self.beta, self.k = size, alpha, beta, k
+        self.data_format = data_format
+
+    def apply(self, params, input, ctx):
+        x = input
+        if self.data_format == "NCHW":
+            x = jnp.transpose(x, (0, 2, 3, 1))
+        sq = x * x
+        half = self.size // 2
+        win = lax.reduce_window(
+            sq, 0.0, lax.add,
+            window_dimensions=(1, 1, 1, self.size),
+            window_strides=(1, 1, 1, 1),
+            padding=[(0, 0), (0, 0), (0, 0), (half, self.size - 1 - half)])
+        y = x / jnp.power(self.k + (self.alpha / self.size) * win, self.beta)
+        if self.data_format == "NCHW":
+            y = jnp.transpose(y, (0, 3, 1, 2))
+        return y
+
+
+class UpSampling2D(Module):
+    """Nearest-neighbour repeat (DL/nn/UpSampling2D.scala); NHWC."""
+
+    def __init__(self, size, name=None):
+        super().__init__(name)
+        self.sh, self.sw = (size, size) if isinstance(size, int) else tuple(size)
+
+    def apply(self, params, input, ctx):
+        x = jnp.repeat(input, self.sh, axis=1)
+        return jnp.repeat(x, self.sw, axis=2)
+
+
+class UpSampling1D(Module):
+    def __init__(self, length: int = 2, name=None):
+        super().__init__(name)
+        self.length = length
+
+    def apply(self, params, input, ctx):
+        return jnp.repeat(input, self.length, axis=1)
+
+
+class UpSampling3D(Module):
+    def __init__(self, size, name=None):
+        super().__init__(name)
+        self.s = (size,) * 3 if isinstance(size, int) else tuple(size)
+
+    def apply(self, params, input, ctx):
+        x = input
+        for ax, r in zip((1, 2, 3), self.s):
+            x = jnp.repeat(x, r, axis=ax)
+        return x
+
+
+class ResizeBilinear(Module):
+    """(DL/nn/ResizeBilinear.scala) via jax.image.resize; NHWC."""
+
+    def __init__(self, output_height: int, output_width: int,
+                 align_corners: bool = False, name=None):
+        super().__init__(name)
+        self.oh, self.ow = output_height, output_width
+        self.align_corners = align_corners
+
+    def apply(self, params, input, ctx):
+        b, h, w, c = input.shape
+        return jax.image.resize(input, (b, self.oh, self.ow, c), method="bilinear")
+
+
+class Pooler(Module):
+    """Global average pool to [B, C] — convenience for model zoo heads."""
+
+    def apply(self, params, input, ctx):
+        return jnp.mean(input, axis=(1, 2))

@@ -1,0 +1,381 @@
+"""Recurrent stack.
+
+Parity: reference Recurrent (DL/nn/Recurrent.scala — unrolls timesteps in a
+JVM while-loop), Cell, RnnCell, LSTM (DL/nn/LSTM.scala), LSTMPeephole, GRU,
+MultiRNNCell, BiRecurrent, RecurrentDecoder, TimeDistributed, ConvLSTMPeephole.
+
+TPU-first: the timestep loop is `jax.lax.scan` — one compiled step body,
+static shapes, XLA pipelines the per-step matmuls onto the MXU. Gate matmuls
+are fused into a single [in+hidden, 4*hidden] GEMM per step instead of the
+reference's per-gate Linear modules.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from bigdl_tpu.nn.module import ApplyContext, Module
+from bigdl_tpu.utils.table import T, Table
+
+
+class Cell(Module):
+    """Recurrent cell contract: step(params, x_t, state) -> (out_t, state).
+
+    `state_shape(batch)` gives zero-state shapes. The reference's Cell
+    (DL/nn/Cell.scala) threads Tables; here state is a pytree tuple.
+    """
+
+    hidden_size: int
+
+    def zero_state(self, batch: int, dtype=jnp.float32):
+        raise NotImplementedError
+
+    def step(self, params, x, state, ctx):
+        raise NotImplementedError
+
+    def apply(self, params, input, ctx):
+        # single-step apply for parity; input = T(x, state)
+        x, state = input[1], input[2]
+        out, new_state = self.step(params, x, state, ctx)
+        return T(out, new_state)
+
+
+def _uniform(rng, shape, stdv):
+    return jax.random.uniform(rng, shape, minval=-stdv, maxval=stdv)
+
+
+class RnnCell(Cell):
+    """Vanilla RNN cell: h' = act(Wx + Uh + b) (DL/nn/RnnCell.scala)."""
+
+    def __init__(self, input_size: int, hidden_size: int, activation=jnp.tanh,
+                 name=None):
+        super().__init__(name)
+        self.input_size, self.hidden_size = input_size, hidden_size
+        self.activation = activation
+
+    def init(self, rng):
+        k1, k2, k3 = jax.random.split(rng, 3)
+        stdv = 1.0 / math.sqrt(self.hidden_size)
+        return {"wi": _uniform(k1, (self.input_size, self.hidden_size), stdv),
+                "wh": _uniform(k2, (self.hidden_size, self.hidden_size), stdv),
+                "bias": _uniform(k3, (self.hidden_size,), stdv)}
+
+    def zero_state(self, batch, dtype=jnp.float32):
+        return jnp.zeros((batch, self.hidden_size), dtype)
+
+    def step(self, params, x, h, ctx):
+        h2 = self.activation(x @ params["wi"] + h @ params["wh"] + params["bias"])
+        return h2, h2
+
+
+class LSTMCell(Cell):
+    """LSTM cell, fused 4-gate GEMM (DL/nn/LSTM.scala). Gate order i,f,g,o;
+    `forget_bias` adds a constant to the forget gate pre-activation
+    (default 0.0, matching the reference's uniform init)."""
+
+    def __init__(self, input_size: int, hidden_size: int, p: float = 0.0,
+                 forget_bias: float = 0.0, name=None):
+        super().__init__(name)
+        self.input_size, self.hidden_size = input_size, hidden_size
+        self.forget_bias = forget_bias
+
+    def init(self, rng):
+        k1, k2, k3 = jax.random.split(rng, 3)
+        stdv = 1.0 / math.sqrt(self.hidden_size)
+        h = self.hidden_size
+        return {"wi": _uniform(k1, (self.input_size, 4 * h), stdv),
+                "wh": _uniform(k2, (h, 4 * h), stdv),
+                "bias": _uniform(k3, (4 * h,), stdv)}
+
+    def zero_state(self, batch, dtype=jnp.float32):
+        return (jnp.zeros((batch, self.hidden_size), dtype),
+                jnp.zeros((batch, self.hidden_size), dtype))
+
+    def step(self, params, x, state, ctx):
+        h_prev, c_prev = state
+        z = x @ params["wi"] + h_prev @ params["wh"] + params["bias"]
+        i, f, g, o = jnp.split(z, 4, axis=-1)
+        i = jax.nn.sigmoid(i)
+        f = jax.nn.sigmoid(f + self.forget_bias)
+        g = jnp.tanh(g)
+        o = jax.nn.sigmoid(o)
+        c = f * c_prev + i * g
+        h = o * jnp.tanh(c)
+        return h, (h, c)
+
+
+# Torch-style alias used by reference model zoo
+LSTM = LSTMCell
+
+
+class LSTMPeepholeCell(Cell):
+    """LSTM with peephole connections (DL/nn/LSTMPeephole.scala)."""
+
+    def __init__(self, input_size: int, hidden_size: int, p: float = 0.0, name=None):
+        super().__init__(name)
+        self.input_size, self.hidden_size = input_size, hidden_size
+
+    def init(self, rng):
+        ks = jax.random.split(rng, 6)
+        stdv = 1.0 / math.sqrt(self.hidden_size)
+        h = self.hidden_size
+        return {"wi": _uniform(ks[0], (self.input_size, 4 * h), stdv),
+                "wh": _uniform(ks[1], (h, 4 * h), stdv),
+                "bias": _uniform(ks[2], (4 * h,), stdv),
+                "peep_i": _uniform(ks[3], (h,), stdv),
+                "peep_f": _uniform(ks[4], (h,), stdv),
+                "peep_o": _uniform(ks[5], (h,), stdv)}
+
+    def zero_state(self, batch, dtype=jnp.float32):
+        return (jnp.zeros((batch, self.hidden_size), dtype),
+                jnp.zeros((batch, self.hidden_size), dtype))
+
+    def step(self, params, x, state, ctx):
+        h_prev, c_prev = state
+        z = x @ params["wi"] + h_prev @ params["wh"] + params["bias"]
+        i, f, g, o = jnp.split(z, 4, axis=-1)
+        i = jax.nn.sigmoid(i + params["peep_i"] * c_prev)
+        f = jax.nn.sigmoid(f + params["peep_f"] * c_prev)
+        g = jnp.tanh(g)
+        c = f * c_prev + i * g
+        o = jax.nn.sigmoid(o + params["peep_o"] * c)
+        h = o * jnp.tanh(c)
+        return h, (h, c)
+
+
+LSTMPeephole = LSTMPeepholeCell
+
+
+class GRUCell(Cell):
+    """GRU (DL/nn/GRU.scala); fused [r,z] GEMM + candidate GEMM."""
+
+    def __init__(self, input_size: int, hidden_size: int, p: float = 0.0, name=None):
+        super().__init__(name)
+        self.input_size, self.hidden_size = input_size, hidden_size
+
+    def init(self, rng):
+        ks = jax.random.split(rng, 6)
+        stdv = 1.0 / math.sqrt(self.hidden_size)
+        h = self.hidden_size
+        return {"wi_rz": _uniform(ks[0], (self.input_size, 2 * h), stdv),
+                "wh_rz": _uniform(ks[1], (h, 2 * h), stdv),
+                "b_rz": _uniform(ks[2], (2 * h,), stdv),
+                "wi_n": _uniform(ks[3], (self.input_size, h), stdv),
+                "wh_n": _uniform(ks[4], (h, h), stdv),
+                "b_n": _uniform(ks[5], (h,), stdv)}
+
+    def zero_state(self, batch, dtype=jnp.float32):
+        return jnp.zeros((batch, self.hidden_size), dtype)
+
+    def step(self, params, x, h_prev, ctx):
+        rz = jax.nn.sigmoid(x @ params["wi_rz"] + h_prev @ params["wh_rz"] + params["b_rz"])
+        r, z = jnp.split(rz, 2, axis=-1)
+        n = jnp.tanh(x @ params["wi_n"] + (r * h_prev) @ params["wh_n"] + params["b_n"])
+        h = (1.0 - z) * n + z * h_prev
+        return h, h
+
+
+GRU = GRUCell
+
+
+class MultiRNNCell(Cell):
+    """Stack of cells (DL/nn/MultiRNNCell.scala)."""
+
+    def __init__(self, cells, name=None):
+        super().__init__(name)
+        self.cells = list(cells)
+        self.hidden_size = self.cells[-1].hidden_size
+
+    def init(self, rng):
+        ks = jax.random.split(rng, len(self.cells))
+        return {f"cell{i}": c.init(k) for i, (c, k) in enumerate(zip(self.cells, ks))}
+
+    def zero_state(self, batch, dtype=jnp.float32):
+        return tuple(c.zero_state(batch, dtype) for c in self.cells)
+
+    def step(self, params, x, state, ctx):
+        new_states = []
+        out = x
+        for i, c in enumerate(self.cells):
+            out, s = c.step(params[f"cell{i}"], out, state[i], ctx)
+            new_states.append(s)
+        return out, tuple(new_states)
+
+
+class ConvLSTMPeephole(Cell):
+    """Convolutional LSTM over NHWC maps (DL/nn/ConvLSTMPeephole.scala)."""
+
+    def __init__(self, input_size: int, output_size: int, kernel_i: int = 3,
+                 kernel_c: int = 3, stride: int = 1, with_peephole: bool = True,
+                 name=None):
+        super().__init__(name)
+        self.c_in, self.c_out = input_size, output_size
+        self.k = kernel_i
+        self.with_peephole = with_peephole
+        self.hidden_size = output_size
+
+    def init(self, rng):
+        ks = jax.random.split(rng, 5)
+        fan = self.k * self.k * (self.c_in + self.c_out)
+        stdv = math.sqrt(2.0 / fan)
+        p = {"wi": stdv * jax.random.normal(ks[0], (self.k, self.k, self.c_in, 4 * self.c_out)),
+             "wh": stdv * jax.random.normal(ks[1], (self.k, self.k, self.c_out, 4 * self.c_out)),
+             "bias": jnp.zeros((4 * self.c_out,))}
+        if self.with_peephole:
+            p["peep_i"] = jnp.zeros((self.c_out,))
+            p["peep_f"] = jnp.zeros((self.c_out,))
+            p["peep_o"] = jnp.zeros((self.c_out,))
+        return p
+
+    def zero_state(self, batch, dtype=jnp.float32):
+        raise NotImplementedError(
+            "ConvLSTM zero state needs spatial dims; use Recurrent with "
+            "explicit initial state or infer from input in scan wrapper")
+
+    def zero_state_hw(self, batch, h, w, dtype=jnp.float32):
+        z = jnp.zeros((batch, h, w, self.c_out), dtype)
+        return (z, z)
+
+    def step(self, params, x, state, ctx):
+        h_prev, c_prev = state
+        conv = lambda inp, w: lax.conv_general_dilated(
+            inp, w, (1, 1), "SAME", dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        z = conv(x, params["wi"]) + conv(h_prev, params["wh"]) + params["bias"]
+        i, f, g, o = jnp.split(z, 4, axis=-1)
+        if self.with_peephole:
+            i = i + params["peep_i"] * c_prev
+            f = f + params["peep_f"] * c_prev
+        i, f, g = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jnp.tanh(g)
+        c = f * c_prev + i * g
+        if self.with_peephole:
+            o = o + params["peep_o"] * c
+        o = jax.nn.sigmoid(o)
+        h = o * jnp.tanh(c)
+        return h, (h, c)
+
+
+class Recurrent(Module):
+    """Run a Cell over [B, T, ...] via lax.scan (reference Recurrent.scala
+    unrolls a while-loop; scan gives one traced body + XLA pipelining)."""
+
+    def __init__(self, cell: Cell, return_sequences: bool = True,
+                 reverse: bool = False, name=None):
+        super().__init__(name)
+        self.cell = cell
+        self.return_sequences = return_sequences
+        self.reverse = reverse
+
+    def init(self, rng):
+        return {"cell": self.cell.init(rng)}
+
+    def _collect_state(self, out, path):
+        self.cell._collect_state(out, path + ("cell",))
+
+    def apply(self, params, input, ctx):
+        x = input  # [B, T, ...]
+        batch = x.shape[0]
+        if isinstance(self.cell, ConvLSTMPeephole):
+            init_state = self.cell.zero_state_hw(batch, x.shape[2], x.shape[3])
+        else:
+            init_state = self.cell.zero_state(batch, x.dtype)
+        xs = jnp.swapaxes(x, 0, 1)  # [T, B, ...]
+        if self.reverse:
+            xs = jnp.flip(xs, axis=0)
+        cell_params = params["cell"]
+        training = ctx.training
+
+        def body(state, x_t):
+            inner_ctx = ApplyContext(training=training)
+            out, new_state = self.cell.step(cell_params, x_t, state, inner_ctx)
+            return new_state, out
+
+        final_state, outs = lax.scan(body, init_state, xs)
+        if not self.return_sequences:
+            # scan-order last step = the backward pass's true final output
+            # when reversed (it consumed x[0] last)
+            return outs[-1]
+        if self.reverse:
+            outs = jnp.flip(outs, axis=0)
+        return jnp.swapaxes(outs, 0, 1)
+
+
+class BiRecurrent(Module):
+    """Bidirectional wrapper (DL/nn/BiRecurrent.scala); merge = concat|sum."""
+
+    def __init__(self, cell_fwd: Cell, cell_bwd: Optional[Cell] = None,
+                 merge: str = "concat", name=None):
+        super().__init__(name)
+        import copy
+        self.fwd = Recurrent(cell_fwd)
+        self.bwd = Recurrent(cell_bwd or copy.deepcopy(cell_fwd), reverse=True)
+        self.merge = merge
+
+    def init(self, rng):
+        k1, k2 = jax.random.split(rng)
+        return {"fwd": self.fwd.init(k1), "bwd": self.bwd.init(k2)}
+
+    def apply(self, params, input, ctx):
+        a = self.fwd.apply(params["fwd"], input, ctx)
+        b = self.bwd.apply(params["bwd"], input, ctx)
+        if self.merge == "concat":
+            return jnp.concatenate([a, b], axis=-1)
+        return a + b
+
+
+class RecurrentDecoder(Module):
+    """Feed output back as next input for `output_length` steps
+    (DL/nn/RecurrentDecoder.scala). Input = initial input [B, ...]."""
+
+    def __init__(self, cell: Cell, output_length: int, name=None):
+        super().__init__(name)
+        self.cell = cell
+        self.output_length = output_length
+
+    def init(self, rng):
+        return {"cell": self.cell.init(rng)}
+
+    def apply(self, params, input, ctx):
+        batch = input.shape[0]
+        state = self.cell.zero_state(batch, input.dtype)
+        cell_params = params["cell"]
+        training = ctx.training
+
+        def body(carry, _):
+            x, state = carry
+            inner_ctx = ApplyContext(training=training)
+            out, new_state = self.cell.step(cell_params, x, state, inner_ctx)
+            return (out, new_state), out
+
+        _, outs = lax.scan(body, (input, state), None, length=self.output_length)
+        return jnp.swapaxes(outs, 0, 1)
+
+
+class TimeDistributed(Module):
+    """Apply a module independently at each timestep
+    (DL/nn/TimeDistributed.scala). Implemented by folding time into batch —
+    one big MXU-friendly GEMM instead of T small ones."""
+
+    def __init__(self, layer: Module, name=None):
+        super().__init__(name)
+        self.layer = layer
+
+    def init(self, rng):
+        return {"layer": self.layer.init(rng)}
+
+    def _collect_state(self, out, path):
+        self.layer._collect_state(out, path + ("layer",))
+
+    def apply(self, params, input, ctx):
+        b, t = input.shape[0], input.shape[1]
+        x = input.reshape((b * t,) + input.shape[2:])
+        ctx.push("layer")
+        try:
+            y = self.layer.apply(params["layer"], x, ctx)
+        finally:
+            ctx.pop()
+        return y.reshape((b, t) + y.shape[1:])

@@ -1,0 +1,373 @@
+"""Shape/indexing layers.
+
+Parity: Reshape, InferReshape, View, Contiguous, Transpose, Squeeze,
+Unsqueeze, Select, Narrow, Index, MaskedSelect, Max, Min, Mean, Sum, Pack,
+Tile, Replicate, Reverse, Padding, SpatialZeroPadding, Cropping2D/3D,
+MM, MV, DotProduct, CosineDistance, PairwiseDistance, Masking
+(DL/nn/*.scala). Axis arguments are 0-based here (the reference is 1-based
+Torch); negative axes follow numpy semantics.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax.numpy as jnp
+
+from bigdl_tpu.nn.module import Module
+from bigdl_tpu.utils.table import T, Table
+
+
+class Reshape(Module):
+    """Reshape non-batch dims (batch_mode=None mimics reference auto)."""
+
+    def __init__(self, size: Sequence[int], batch_mode: Optional[bool] = True, name=None):
+        super().__init__(name)
+        self.size = tuple(size)
+        self.batch_mode = batch_mode
+
+    def apply(self, params, input, ctx):
+        if self.batch_mode is False:
+            return jnp.reshape(input, self.size)
+        return jnp.reshape(input, (input.shape[0],) + self.size)
+
+
+class InferReshape(Module):
+    """Reshape with -1 inference (DL/nn/InferReshape.scala)."""
+
+    def __init__(self, size: Sequence[int], batch_mode: bool = False, name=None):
+        super().__init__(name)
+        self.size = tuple(size)
+        self.batch_mode = batch_mode
+
+    def apply(self, params, input, ctx):
+        if self.batch_mode:
+            return jnp.reshape(input, (input.shape[0],) + self.size)
+        return jnp.reshape(input, self.size)
+
+
+class View(Reshape):
+    pass
+
+
+class Contiguous(Module):
+    def apply(self, params, input, ctx):
+        return input  # jax arrays are always materialized contiguously
+
+
+class Transpose(Module):
+    """Swap listed axis pairs in order (DL/nn/Transpose.scala)."""
+
+    def __init__(self, permutations: Sequence[Tuple[int, int]], name=None):
+        super().__init__(name)
+        self.permutations = [tuple(p) for p in permutations]
+
+    def apply(self, params, input, ctx):
+        perm = list(range(input.ndim))
+        for a, b in self.permutations:
+            perm[a], perm[b] = perm[b], perm[a]
+        return jnp.transpose(input, perm)
+
+
+class Permute(Module):
+    def __init__(self, dims: Sequence[int], name=None):
+        super().__init__(name)
+        self.dims = tuple(dims)
+
+    def apply(self, params, input, ctx):
+        return jnp.transpose(input, self.dims)
+
+
+class Squeeze(Module):
+    def __init__(self, dim: Optional[int] = None, name=None):
+        super().__init__(name)
+        self.dim = dim
+
+    def apply(self, params, input, ctx):
+        return jnp.squeeze(input, axis=self.dim)
+
+
+class Unsqueeze(Module):
+    def __init__(self, pos: int, name=None):
+        super().__init__(name)
+        self.pos = pos
+
+    def apply(self, params, input, ctx):
+        return jnp.expand_dims(input, self.pos)
+
+
+class Select(Module):
+    """Select index along a dim (DL/nn/Select.scala)."""
+
+    def __init__(self, dim: int, index: int, name=None):
+        super().__init__(name)
+        self.dim, self.index = dim, index
+
+    def apply(self, params, input, ctx):
+        return jnp.take(input, self.index, axis=self.dim)
+
+
+class Narrow(Module):
+    def __init__(self, dim: int, offset: int, length: int = 1, name=None):
+        super().__init__(name)
+        self.dim, self.offset, self.length = dim, offset, length
+
+    def apply(self, params, input, ctx):
+        length = self.length
+        if length < 0:
+            length = input.shape[self.dim] - self.offset + self.length + 1
+        idx = [slice(None)] * input.ndim
+        idx[self.dim] = slice(self.offset, self.offset + length)
+        return input[tuple(idx)]
+
+
+class Index(Module):
+    """input = T(tensor, indices); gather along dim (DL/nn/Index.scala)."""
+
+    def __init__(self, dimension: int, name=None):
+        super().__init__(name)
+        self.dimension = dimension
+
+    def apply(self, params, input, ctx):
+        x, idx = input[1], input[2]
+        return jnp.take(x, idx.astype(jnp.int32), axis=self.dimension)
+
+
+class MaskedSelect(Module):
+    """Dynamic-shape op in Torch; on TPU we return masked values zero-filled
+    (static shape) — documented semantic delta from DL/nn/MaskedSelect.scala."""
+
+    def apply(self, params, input, ctx):
+        x, mask = input[1], input[2]
+        return jnp.where(mask.astype(bool), x, 0.0)
+
+
+class Max(Module):
+    def __init__(self, dim: int = -1, num_input_dims: int = 0, name=None):
+        super().__init__(name)
+        self.dim = dim
+
+    def apply(self, params, input, ctx):
+        return jnp.max(input, axis=self.dim)
+
+
+class Min(Module):
+    def __init__(self, dim: int = -1, name=None):
+        super().__init__(name)
+        self.dim = dim
+
+    def apply(self, params, input, ctx):
+        return jnp.min(input, axis=self.dim)
+
+
+class Mean(Module):
+    def __init__(self, dimension: int = 0, n_input_dims: int = -1,
+                 squeeze: bool = True, name=None):
+        super().__init__(name)
+        self.dimension, self.squeeze = dimension, squeeze
+
+    def apply(self, params, input, ctx):
+        return jnp.mean(input, axis=self.dimension, keepdims=not self.squeeze)
+
+
+class Sum(Module):
+    def __init__(self, dimension: int = 0, n_input_dims: int = -1,
+                 size_average: bool = False, squeeze: bool = True, name=None):
+        super().__init__(name)
+        self.dimension, self.size_average, self.squeeze = dimension, size_average, squeeze
+
+    def apply(self, params, input, ctx):
+        y = jnp.sum(input, axis=self.dimension, keepdims=not self.squeeze)
+        if self.size_average:
+            y = y / input.shape[self.dimension]
+        return y
+
+
+class Pack(Module):
+    """Stack table elements along a new dim (DL/nn/Pack.scala)."""
+
+    def __init__(self, dimension: int = 0, name=None):
+        super().__init__(name)
+        self.dimension = dimension
+
+    def apply(self, params, input, ctx):
+        vals = list(input) if isinstance(input, Table) else [input]
+        return jnp.stack(vals, axis=self.dimension)
+
+
+class Tile(Module):
+    def __init__(self, dim: int, copies: int = 2, name=None):
+        super().__init__(name)
+        self.dim, self.copies = dim, copies
+
+    def apply(self, params, input, ctx):
+        reps = [1] * input.ndim
+        reps[self.dim] = self.copies
+        return jnp.tile(input, reps)
+
+
+class Replicate(Module):
+    """Insert a new dim of size nFeatures (DL/nn/Replicate.scala)."""
+
+    def __init__(self, n_features: int, dim: int = 0, name=None):
+        super().__init__(name)
+        self.n_features, self.dim = n_features, dim
+
+    def apply(self, params, input, ctx):
+        return jnp.repeat(jnp.expand_dims(input, self.dim), self.n_features, axis=self.dim)
+
+
+class Reverse(Module):
+    def __init__(self, dimension: int = 0, name=None):
+        super().__init__(name)
+        self.dimension = dimension
+
+    def apply(self, params, input, ctx):
+        return jnp.flip(input, axis=self.dimension)
+
+
+class Padding(Module):
+    """Pad `pad` entries along dim (negative = before) (DL/nn/Padding.scala)."""
+
+    def __init__(self, dim: int, pad: int, n_input_dim: int = 0,
+                 value: float = 0.0, n_index: int = 1, name=None):
+        super().__init__(name)
+        self.dim, self.pad, self.value = dim, pad, value
+
+    def apply(self, params, input, ctx):
+        widths = [(0, 0)] * input.ndim
+        widths[self.dim] = (-self.pad, 0) if self.pad < 0 else (0, self.pad)
+        return jnp.pad(input, widths, constant_values=self.value)
+
+
+class SpatialZeroPadding(Module):
+    """NHWC zero padding (DL/nn/SpatialZeroPadding.scala)."""
+
+    def __init__(self, pad_left: int, pad_right: int = None, pad_top: int = None,
+                 pad_bottom: int = None, name=None):
+        super().__init__(name)
+        self.l = pad_left
+        self.r = pad_right if pad_right is not None else pad_left
+        self.t = pad_top if pad_top is not None else pad_left
+        self.b = pad_bottom if pad_bottom is not None else pad_left
+
+    def apply(self, params, input, ctx):
+        return jnp.pad(input, ((0, 0), (self.t, self.b), (self.l, self.r), (0, 0)))
+
+
+class Cropping2D(Module):
+    def __init__(self, height_crop=(0, 0), width_crop=(0, 0), name=None):
+        super().__init__(name)
+        self.hc, self.wc = tuple(height_crop), tuple(width_crop)
+
+    def apply(self, params, input, ctx):
+        h, w = input.shape[1], input.shape[2]
+        return input[:, self.hc[0]:h - self.hc[1], self.wc[0]:w - self.wc[1], :]
+
+
+class Cropping3D(Module):
+    def __init__(self, dim1_crop=(0, 0), dim2_crop=(0, 0), dim3_crop=(0, 0), name=None):
+        super().__init__(name)
+        self.c1, self.c2, self.c3 = tuple(dim1_crop), tuple(dim2_crop), tuple(dim3_crop)
+
+    def apply(self, params, input, ctx):
+        d, h, w = input.shape[1], input.shape[2], input.shape[3]
+        return input[:, self.c1[0]:d - self.c1[1], self.c2[0]:h - self.c2[1],
+                     self.c3[0]:w - self.c3[1], :]
+
+
+class MM(Module):
+    """Batch/plain matmul of a 2-tensor table (DL/nn/MM.scala)."""
+
+    def __init__(self, trans_a: bool = False, trans_b: bool = False, name=None):
+        super().__init__(name)
+        self.trans_a, self.trans_b = trans_a, trans_b
+
+    def apply(self, params, input, ctx):
+        a, b = input[1], input[2]
+        if self.trans_a:
+            a = jnp.swapaxes(a, -1, -2)
+        if self.trans_b:
+            b = jnp.swapaxes(b, -1, -2)
+        return jnp.matmul(a, b)
+
+
+class MV(Module):
+    def __init__(self, trans: bool = False, name=None):
+        super().__init__(name)
+        self.trans = trans
+
+    def apply(self, params, input, ctx):
+        m, v = input[1], input[2]
+        if self.trans:
+            m = jnp.swapaxes(m, -1, -2)
+        return jnp.einsum("...ij,...j->...i", m, v)
+
+
+class DotProduct(Module):
+    def apply(self, params, input, ctx):
+        a, b = input[1], input[2]
+        return jnp.sum(a * b, axis=-1)
+
+
+class CosineDistance(Module):
+    def apply(self, params, input, ctx):
+        a, b = input[1], input[2]
+        an = a / (jnp.linalg.norm(a, axis=-1, keepdims=True) + 1e-12)
+        bn = b / (jnp.linalg.norm(b, axis=-1, keepdims=True) + 1e-12)
+        return jnp.sum(an * bn, axis=-1)
+
+
+class PairwiseDistance(Module):
+    def __init__(self, norm: int = 2, name=None):
+        super().__init__(name)
+        self.norm = norm
+
+    def apply(self, params, input, ctx):
+        a, b = input[1], input[2]
+        return jnp.linalg.norm(a - b, ord=self.norm, axis=-1)
+
+
+class CrossProduct(Module):
+    """Pairwise dot products between all table entries (DL/nn/CrossProduct.scala)."""
+
+    def apply(self, params, input, ctx):
+        vals = list(input)
+        outs = []
+        for i in range(len(vals)):
+            for j in range(i + 1, len(vals)):
+                outs.append(jnp.sum(vals[i] * vals[j], axis=-1, keepdims=True))
+        return jnp.concatenate(outs, axis=-1)
+
+
+class Masking(Module):
+    """Zero out timesteps equal to mask_value (keras Masking parity)."""
+
+    def __init__(self, mask_value: float = 0.0, name=None):
+        super().__init__(name)
+        self.mask_value = mask_value
+
+    def apply(self, params, input, ctx):
+        keep = jnp.any(input != self.mask_value, axis=-1, keepdims=True)
+        return jnp.where(keep, input, 0.0)
+
+
+class DenseToSparse(Module):
+    """Identity on TPU: sparsity is handled by downstream gather-based layers
+    (documented delta from DL/nn/DenseToSparse.scala, which converts to COO)."""
+
+    def apply(self, params, input, ctx):
+        return input
+
+
+class ActivityRegularization(Module):
+    """L1/L2 activity penalty; stores penalty in state for the loss to pick up."""
+
+    def __init__(self, l1: float = 0.0, l2: float = 0.0, name=None):
+        super().__init__(name)
+        self.l1, self.l2 = l1, l2
+
+    def apply(self, params, input, ctx):
+        penalty = self.l1 * jnp.sum(jnp.abs(input)) + self.l2 * jnp.sum(input * input)
+        ctx.put_state({"loss": penalty})
+        return input

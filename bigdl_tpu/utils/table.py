@@ -1,0 +1,97 @@
+"""Table activity type.
+
+Parity: reference `Table` (DL/utils/Table.scala) — the heterogeneous,
+1-indexed container used as the second half of the `Activity = Tensor | Table`
+union (DL/nn/abstractnn/Activity.scala:33). On TPU a Table is a registered
+JAX pytree so it can flow through jit/grad unchanged.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Iterator
+
+import jax
+
+
+class Table:
+    """1-indexed heterogeneous container, `T(a, b, ...)` in the reference."""
+
+    def __init__(self, *items: Any, **kwitems: Any):
+        self._d: Dict[Any, Any] = {}
+        for i, v in enumerate(items):
+            self._d[i + 1] = v
+        self._d.update(kwitems)
+
+    # -- dict-ish API --
+    def __getitem__(self, k):
+        return self._d[k]
+
+    def __setitem__(self, k, v):
+        self._d[k] = v
+
+    def __contains__(self, k):
+        return k in self._d
+
+    def __len__(self):
+        return len(self._d)
+
+    @staticmethod
+    def _key_order(k):
+        # integer keys sort numerically (1..n table case), before string keys
+        return (0, k, "") if isinstance(k, int) else (1, 0, str(k))
+
+    def __iter__(self) -> Iterator:
+        for k in self.keys():
+            yield self._d[k]
+
+    def keys(self):
+        return sorted(self._d, key=self._key_order)
+
+    def values(self):
+        return [self._d[k] for k in self.keys()]
+
+    def insert(self, v):
+        self._d[len(self._d) + 1] = v
+        return self
+
+    def __eq__(self, other):
+        if not isinstance(other, Table):
+            return NotImplemented
+        if self.keys() != other.keys():
+            return False
+        import numpy as np
+        for k in self.keys():
+            a, b = self._d[k], other._d[k]
+            if isinstance(a, Table) or isinstance(b, Table):
+                if a != b:
+                    return False
+            elif hasattr(a, "shape") or hasattr(b, "shape"):
+                if not np.array_equal(np.asarray(a), np.asarray(b)):
+                    return False
+            elif a != b:
+                return False
+        return True
+
+    def __repr__(self):
+        inner = ", ".join(f"{k}: {self._d[k]!r}" for k in self.keys())
+        return f"T({inner})"
+
+
+def T(*items, **kwitems) -> Table:
+    """Builder mirroring the reference's `T()` constructor."""
+    return Table(*items, **kwitems)
+
+
+def _table_flatten(t: Table):
+    keys = t.keys()
+    return [t[k] for k in keys], tuple(keys)
+
+
+def _table_unflatten(keys, children):
+    t = Table()
+    for k, c in zip(keys, children):
+        t[k] = c
+    return t
+
+
+jax.tree_util.register_pytree_node(Table, _table_flatten, _table_unflatten)

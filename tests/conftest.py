@@ -1,0 +1,25 @@
+"""Test configuration.
+
+Mirrors the reference's key testing trick (SURVEY.md §4.4): the reference
+emulates a 4-node cluster in one JVM via local-mode Spark; we emulate an
+8-chip TPU pod on CPU via XLA's host-platform device-count flag. Must be set
+before jax initializes its backends.
+"""
+
+import os
+
+# Force CPU even when the session env points at a TPU (JAX_PLATFORMS=axon):
+# unit tests need f32 determinism and the virtual 8-device mesh.
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+# The axon TPU plugin (sitecustomize) force-prepends itself to jax_platforms;
+# override it back to cpu-only for the test suite.
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", False)
+jax.config.update("jax_default_matmul_precision", "highest")
