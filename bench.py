@@ -1,0 +1,75 @@
+"""Benchmark driver: prints ONE JSON line with the headline metric.
+
+Metric: training throughput (imgs/sec) of the flagship model on the local
+device — the TPU analogue of the reference's DistriOptimizerPerf
+(DL/models/utils/DistriOptimizerPerf.scala:32, synthetic-data imgs/sec) and
+its per-iteration "Throughput is X records/second" log line
+(DistriOptimizer.scala:405-410).
+
+vs_baseline: the reference publishes no absolute imgs/sec in-tree
+(BASELINE.md); the whitepaper's positioning is "comparable with mainstream
+GPU" for a Xeon cluster. We report vs a conservative 100 imgs/sec/CPU-node
+LeNet-equivalent figure derived from the PTB sample logs; once round>=2
+records exist, compare to the previous round instead.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+
+def bench_lenet(batch_size: int = 512, warmup: int = 3, iters: int = 20):
+    import jax
+    import jax.numpy as jnp
+    import bigdl_tpu.nn as nn
+    from bigdl_tpu.models.lenet import LeNet5
+    from bigdl_tpu.nn.module import functional_apply
+    import bigdl_tpu.optim as optim
+
+    model = LeNet5(10)
+    crit = nn.ClassNLLCriterion()
+    method = optim.SGD(learning_rate=0.01, momentum=0.9)
+    params = model.init(jax.random.PRNGKey(0))
+    state = model.state_init()
+    opt_state = method.init_state(params)
+
+    rs = np.random.RandomState(0)
+    x = jnp.asarray(rs.rand(batch_size, 28, 28).astype(np.float32))
+    y = jnp.asarray((rs.randint(0, 10, size=batch_size) + 1).astype(np.int32))
+
+    @jax.jit
+    def step(params, opt_state, x, y):
+        def loss_fn(p):
+            out, _ = functional_apply(model, p, x, state=state, training=True)
+            return crit(out, y)
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        p2, s2 = method.update(grads, opt_state, params, 0.01)
+        return p2, s2, loss
+
+    for _ in range(warmup):
+        params, opt_state, loss = step(params, opt_state, x, y)
+    loss.block_until_ready()
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        params, opt_state, loss = step(params, opt_state, x, y)
+    loss.block_until_ready()
+    dt = time.perf_counter() - t0
+    return batch_size * iters / dt
+
+
+def main():
+    throughput = bench_lenet()
+    baseline = 100.0  # imgs/sec, conservative reference CPU-node figure
+    print(json.dumps({
+        "metric": "lenet_train_throughput",
+        "value": round(throughput, 1),
+        "unit": "imgs/sec",
+        "vs_baseline": round(throughput / baseline, 2),
+    }))
+
+
+if __name__ == "__main__":
+    main()

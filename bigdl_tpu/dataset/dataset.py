@@ -1,0 +1,109 @@
+"""DataSet abstractions.
+
+Parity: DL/dataset/DataSet.scala — AbstractDataSet (:49) with `data(train)`,
+`size`, `shuffle`; LocalDataSet (:113) over in-memory arrays;
+DistributedDataSet (:167) over RDDs. The TPU build's "distributed" dataset is
+a per-host shard feeding `jax.device_put` — the Spark-RDD role (host-side
+storage + shuffle) without the JVM. Data stays numpy until the train step.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Iterable, Iterator, List, Optional, Sequence
+
+import numpy as np
+
+from bigdl_tpu.dataset.sample import MiniBatch, Sample
+from bigdl_tpu.dataset.transformer import Transformer
+
+
+class AbstractDataSet:
+    def data(self, train: bool) -> Iterator:
+        raise NotImplementedError
+
+    def size(self) -> int:
+        raise NotImplementedError
+
+    def shuffle(self):
+        pass
+
+    def transform(self, transformer: Transformer) -> "AbstractDataSet":
+        return _TransformedDataSet(self, transformer)
+
+    def __rshift__(self, transformer: Transformer):
+        return self.transform(transformer)
+
+
+class LocalDataSet(AbstractDataSet):
+    """In-memory dataset; `train=True` iteration is infinite-with-reshuffle
+    like the reference's looped iterator (DataSet.scala:139-158)."""
+
+    def __init__(self, items: Sequence, seed: int = 1):
+        self.items = list(items)
+        self._rng = np.random.RandomState(seed)
+
+    def data(self, train: bool) -> Iterator:
+        if not train:
+            return iter(self.items)
+
+        def looped():
+            while True:
+                idx = self._rng.permutation(len(self.items))
+                for i in idx:
+                    yield self.items[i]
+
+        return looped()
+
+    def size(self) -> int:
+        return len(self.items)
+
+    def shuffle(self):
+        self._rng.shuffle(self.items)
+
+
+class DistributedDataSet(LocalDataSet):
+    """Host-sharded dataset: this process sees shard `host_index` of
+    `num_hosts`. With one host it degenerates to LocalDataSet — mirroring how
+    reference tests run 'distributed' on local[N] Spark (SURVEY.md §4.4)."""
+
+    def __init__(self, items: Sequence, host_index: int = 0, num_hosts: int = 1,
+                 seed: int = 1):
+        shard = [x for i, x in enumerate(items) if i % num_hosts == host_index]
+        super().__init__(shard, seed)
+        self.global_size = len(items)
+        self.host_index, self.num_hosts = host_index, num_hosts
+
+
+class _TransformedDataSet(AbstractDataSet):
+    def __init__(self, base: AbstractDataSet, transformer: Transformer):
+        self.base = base
+        self.transformer = transformer
+        # forward host-shard accounting so epoch triggers see global progress
+        for attr in ("global_size", "num_hosts", "host_index"):
+            if hasattr(base, attr):
+                setattr(self, attr, getattr(base, attr))
+
+    def data(self, train: bool) -> Iterator:
+        return self.transformer(self.base.data(train))
+
+    def size(self) -> int:
+        return self.base.size()
+
+    def shuffle(self):
+        self.base.shuffle()
+
+
+class DataSet:
+    """Factory namespace mirroring the reference's `DataSet` object."""
+
+    @staticmethod
+    def array(items: Sequence, host_index: int = 0, num_hosts: int = 1) -> LocalDataSet:
+        if num_hosts > 1:
+            return DistributedDataSet(items, host_index, num_hosts)
+        return LocalDataSet(items)
+
+    @staticmethod
+    def from_arrays(features: np.ndarray, labels: Optional[np.ndarray] = None) -> LocalDataSet:
+        items = [Sample(features[i], labels[i] if labels is not None else None)
+                 for i in range(len(features))]
+        return LocalDataSet(items)

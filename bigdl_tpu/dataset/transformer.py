@@ -1,0 +1,81 @@
+"""Composable data transformers.
+
+Parity: DL/dataset/Transformer.scala:44 — a Transformer[A, B] maps an
+iterator of A to an iterator of B and composes with `->` (here: `chain` or
+`>>`). SampleToMiniBatch (Transformer.scala:309) batches Samples with
+optional padding.
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Callable, Iterable, Iterator, List, Optional
+
+import numpy as np
+
+from bigdl_tpu.dataset.sample import MiniBatch, PaddingParam, Sample
+
+
+class Transformer:
+    """Iterator -> iterator mapper; compose with a >> b."""
+
+    def apply(self, it: Iterator) -> Iterator:
+        raise NotImplementedError
+
+    def __call__(self, it: Iterable) -> Iterator:
+        return self.apply(iter(it))
+
+    def __rshift__(self, other: "Transformer") -> "Transformer":
+        return _Chained(self, other)
+
+
+class _Chained(Transformer):
+    def __init__(self, first: Transformer, second: Transformer):
+        self.first, self.second = first, second
+
+    def apply(self, it):
+        return self.second(self.first(it))
+
+
+def chain(*transformers: Transformer) -> Transformer:
+    out = transformers[0]
+    for t in transformers[1:]:
+        out = out >> t
+    return out
+
+
+class FuncTransformer(Transformer):
+    """Wrap an element-wise function."""
+
+    def __init__(self, fn: Callable):
+        self.fn = fn
+
+    def apply(self, it):
+        return (self.fn(x) for x in it)
+
+
+class SampleToMiniBatch(Transformer):
+    """(Transformer.scala:309) group Samples into MiniBatches. Drops the last
+    partial batch only if drop_remainder (the distributed plane needs equal
+    batch shapes for SPMD; the reference instead padded the tail batch)."""
+
+    def __init__(self, batch_size: int,
+                 feature_padding: Optional[PaddingParam] = None,
+                 label_padding: Optional[PaddingParam] = None,
+                 drop_remainder: bool = False):
+        self.batch_size = batch_size
+        self.feature_padding = feature_padding
+        self.label_padding = label_padding
+        self.drop_remainder = drop_remainder
+
+    def apply(self, it):
+        buf: List[Sample] = []
+        for s in it:
+            buf.append(s)
+            if len(buf) == self.batch_size:
+                yield MiniBatch.from_samples(buf, self.feature_padding,
+                                             self.label_padding)
+                buf = []
+        if buf and not self.drop_remainder:
+            yield MiniBatch.from_samples(buf, self.feature_padding,
+                                         self.label_padding)

@@ -1,0 +1,18 @@
+"""MNIST autoencoder.
+
+Parity: DL/models/autoencoder/Autoencoder.scala — 784 -> 32 -> 784 with
+sigmoid output trained against the input (MSE).
+"""
+
+from __future__ import annotations
+
+import bigdl_tpu.nn as nn
+
+
+def Autoencoder(class_num: int = 32) -> nn.Sequential:
+    return (nn.Sequential(name="Autoencoder")
+            .add(nn.Reshape((784,)))
+            .add(nn.Linear(784, class_num))
+            .add(nn.ReLU())
+            .add(nn.Linear(class_num, 784))
+            .add(nn.Sigmoid()))

@@ -1,0 +1,68 @@
+"""Inception v1 (GoogLeNet).
+
+Parity: DL/models/inception/Inception_v1.scala — the branchy Concat graph
+(1x1 / 3x3reduce+3x3 / 5x5reduce+5x5 / pool+proj per module), NoAuxLoss
+variant. Channel concat rides the NHWC channel axis.
+"""
+
+from __future__ import annotations
+
+import bigdl_tpu.nn as nn
+from bigdl_tpu.nn.initialization import Xavier
+
+
+def _conv(n_in, n_out, k, stride=1, pad=0, name=None):
+    return (nn.Sequential()
+            .add(nn.SpatialConvolution(n_in, n_out, k, k, stride, stride,
+                                       pad_w=pad, pad_h=pad,
+                                       weight_init=Xavier(), name=name))
+            .add(nn.ReLU()))
+
+
+def inception_module(n_in, c1, c3r, c3, c5r, c5, pool_proj, name=""):
+    """One Inception block (Inception_v1.scala inception())."""
+    concat = nn.Concat(axis=3, name=name)  # NHWC channel axis
+    concat.add(_conv(n_in, c1, 1, name=f"{name}1x1"))
+    concat.add(nn.Sequential()
+               .add(_conv(n_in, c3r, 1, name=f"{name}3x3reduce"))
+               .add(_conv(c3r, c3, 3, pad=1, name=f"{name}3x3")))
+    concat.add(nn.Sequential()
+               .add(_conv(n_in, c5r, 1, name=f"{name}5x5reduce"))
+               .add(_conv(c5r, c5, 5, pad=2, name=f"{name}5x5")))
+    concat.add(nn.Sequential()
+               .add(nn.SpatialMaxPooling(3, 3, 1, 1, pad_w=1, pad_h=1))
+               .add(_conv(n_in, pool_proj, 1, name=f"{name}pool_proj")))
+    return concat
+
+
+def Inception_v1_NoAuxClassifier(class_num: int = 1000,
+                                 has_dropout: bool = True) -> nn.Sequential:
+    m = (nn.Sequential(name="Inception_v1")
+         .add(_conv(3, 64, 7, 2, 3, name="conv1/7x7_s2"))
+         .add(nn.SpatialMaxPooling(3, 3, 2, 2).ceil())
+         .add(nn.SpatialCrossMapLRN(5, 0.0001, 0.75))
+         .add(_conv(64, 64, 1, name="conv2/3x3_reduce"))
+         .add(_conv(64, 192, 3, pad=1, name="conv2/3x3"))
+         .add(nn.SpatialCrossMapLRN(5, 0.0001, 0.75))
+         .add(nn.SpatialMaxPooling(3, 3, 2, 2).ceil())
+         .add(inception_module(192, 64, 96, 128, 16, 32, 32, "inception_3a/"))
+         .add(inception_module(256, 128, 128, 192, 32, 96, 64, "inception_3b/"))
+         .add(nn.SpatialMaxPooling(3, 3, 2, 2).ceil())
+         .add(inception_module(480, 192, 96, 208, 16, 48, 64, "inception_4a/"))
+         .add(inception_module(512, 160, 112, 224, 24, 64, 64, "inception_4b/"))
+         .add(inception_module(512, 128, 128, 256, 24, 64, 64, "inception_4c/"))
+         .add(inception_module(512, 112, 144, 288, 32, 64, 64, "inception_4d/"))
+         .add(inception_module(528, 256, 160, 320, 32, 128, 128, "inception_4e/"))
+         .add(nn.SpatialMaxPooling(3, 3, 2, 2).ceil())
+         .add(inception_module(832, 256, 160, 320, 32, 128, 128, "inception_5a/"))
+         .add(inception_module(832, 384, 192, 384, 48, 128, 128, "inception_5b/"))
+         .add(nn.SpatialAveragePooling(7, 7, 1, 1)))
+    if has_dropout:
+        m.add(nn.Dropout(0.4))
+    (m.add(nn.Reshape((1024,)))
+      .add(nn.Linear(1024, class_num, name="loss3/classifier"))
+      .add(nn.LogSoftMax()))
+    return m
+
+
+Inception_v1 = Inception_v1_NoAuxClassifier

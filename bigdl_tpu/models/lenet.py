@@ -1,0 +1,43 @@
+"""LeNet-5.
+
+Parity: DL/models/lenet/LeNet5.scala — conv(1->6,5x5) tanh pool conv(6->12)
+tanh pool fc(100) tanh fc(classNum) logsoftmax, on 28x28 MNIST. NHWC here.
+"""
+
+from __future__ import annotations
+
+import bigdl_tpu.nn as nn
+
+
+def LeNet5(class_num: int = 10) -> nn.Sequential:
+    return (nn.Sequential(name="LeNet5")
+            .add(nn.Reshape((28, 28, 1)))
+            .add(nn.SpatialConvolution(1, 6, 5, 5, name="conv1_5x5"))
+            .add(nn.Tanh())
+            .add(nn.SpatialMaxPooling(2, 2, 2, 2))
+            .add(nn.SpatialConvolution(6, 12, 5, 5, name="conv2_5x5"))
+            .add(nn.Tanh())
+            .add(nn.SpatialMaxPooling(2, 2, 2, 2))
+            .add(nn.Reshape((12 * 4 * 4,)))
+            .add(nn.Linear(12 * 4 * 4, 100, name="fc_1"))
+            .add(nn.Tanh())
+            .add(nn.Linear(100, class_num, name="fc_2"))
+            .add(nn.LogSoftMax()))
+
+
+def lenet_graph(class_num: int = 10) -> "nn.Graph":
+    """Graph-container variant (reference LeNet5.graph)."""
+    inp = nn.InputNode()
+    x = nn.Reshape((28, 28, 1)).inputs(inp)
+    x = nn.SpatialConvolution(1, 6, 5, 5).inputs(x)
+    x = nn.Tanh().inputs(x)
+    x = nn.SpatialMaxPooling(2, 2, 2, 2).inputs(x)
+    x = nn.SpatialConvolution(6, 12, 5, 5).inputs(x)
+    x = nn.Tanh().inputs(x)
+    x = nn.SpatialMaxPooling(2, 2, 2, 2).inputs(x)
+    x = nn.Reshape((12 * 4 * 4,)).inputs(x)
+    x = nn.Linear(12 * 4 * 4, 100).inputs(x)
+    x = nn.Tanh().inputs(x)
+    x = nn.Linear(100, class_num).inputs(x)
+    out = nn.LogSoftMax().inputs(x)
+    return nn.Graph([inp], [out])

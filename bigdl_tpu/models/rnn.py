@@ -1,0 +1,36 @@
+"""Sequence models: PTB LSTM language model and SimpleRNN.
+
+Parity: DL/models/rnn/PTBModel.scala (embedding -> stacked LSTM ->
+TimeDistributed(Linear) -> logsoftmax over vocab) and SimpleRNN.scala.
+The timestep loop is lax.scan (SURVEY.md §5.7: reference unrolls on the JVM).
+"""
+
+from __future__ import annotations
+
+import bigdl_tpu.nn as nn
+
+
+def PTBModel(input_size: int = 10000, hidden_size: int = 200,
+             output_size: int = 10000, num_layers: int = 2,
+             keep_prob: float = 1.0) -> nn.Sequential:
+    cells = [nn.LSTMCell(hidden_size if i else hidden_size, hidden_size)
+             for i in range(num_layers)]
+    m = (nn.Sequential(name="PTBModel")
+         .add(nn.LookupTable(input_size, hidden_size)))
+    if keep_prob < 1.0:
+        m.add(nn.Dropout(1.0 - keep_prob))
+    m.add(nn.Recurrent(nn.MultiRNNCell(cells)))
+    if keep_prob < 1.0:
+        m.add(nn.Dropout(1.0 - keep_prob))
+    (m.add(nn.TimeDistributed(nn.Linear(hidden_size, output_size)))
+      .add(nn.TimeDistributed(nn.LogSoftMax())))
+    return m
+
+
+def SimpleRNN(input_size: int = 4, hidden_size: int = 40,
+              output_size: int = 4) -> nn.Sequential:
+    """DL/models/rnn/SimpleRNN.scala."""
+    return (nn.Sequential(name="SimpleRNN")
+            .add(nn.Recurrent(nn.RnnCell(input_size, hidden_size)))
+            .add(nn.TimeDistributed(nn.Linear(hidden_size, output_size)))
+            .add(nn.TimeDistributed(nn.LogSoftMax())))

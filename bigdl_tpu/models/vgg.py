@@ -1,0 +1,77 @@
+"""VGG-16/19 (ImageNet) and the CIFAR VGG variant.
+
+Parity: DL/models/vgg/Vgg_16.scala, Vgg_19.scala, VggForCifar10.scala.
+"""
+
+from __future__ import annotations
+
+import bigdl_tpu.nn as nn
+
+
+def _block(n_in, n_out, convs):
+    seq = nn.Sequential()
+    for i in range(convs):
+        seq.add(nn.SpatialConvolution(n_in if i == 0 else n_out, n_out, 3, 3,
+                                      pad_w=1, pad_h=1))
+        seq.add(nn.ReLU())
+    seq.add(nn.SpatialMaxPooling(2, 2, 2, 2))
+    return seq
+
+
+def _vgg(cfg, class_num):
+    m = nn.Sequential(name=f"VGG")
+    n_in = 3
+    for n_out, convs in cfg:
+        m.add(_block(n_in, n_out, convs))
+        n_in = n_out
+    (m.add(nn.Reshape((512 * 7 * 7,)))
+      .add(nn.Linear(512 * 7 * 7, 4096))
+      .add(nn.ReLU())
+      .add(nn.Dropout(0.5))
+      .add(nn.Linear(4096, 4096))
+      .add(nn.ReLU())
+      .add(nn.Dropout(0.5))
+      .add(nn.Linear(4096, class_num))
+      .add(nn.LogSoftMax()))
+    return m
+
+
+def Vgg_16(class_num: int = 1000):
+    return _vgg([(64, 2), (128, 2), (256, 3), (512, 3), (512, 3)], class_num)
+
+
+def Vgg_19(class_num: int = 1000):
+    return _vgg([(64, 2), (128, 2), (256, 4), (512, 4), (512, 4)], class_num)
+
+
+def VggForCifar10(class_num: int = 10, has_dropout: bool = True):
+    """DL/models/vgg/VggForCifar10.scala — conv+BN stacks for 32x32."""
+    def conv_bn(n_in, n_out, dropout=None):
+        seq = (nn.Sequential()
+               .add(nn.SpatialConvolution(n_in, n_out, 3, 3, pad_w=1, pad_h=1))
+               .add(nn.SpatialBatchNormalization(n_out, eps=1e-3))
+               .add(nn.ReLU()))
+        if dropout and has_dropout:
+            seq.add(nn.Dropout(dropout))
+        return seq
+
+    m = nn.Sequential(name="VggForCifar10")
+    spec = [(3, 64, 0.3), (64, 64, None), ("pool",), (64, 128, 0.4),
+            (128, 128, None), ("pool",), (128, 256, 0.4), (256, 256, 0.4),
+            (256, 256, None), ("pool",), (256, 512, 0.4), (512, 512, 0.4),
+            (512, 512, None), ("pool",), (512, 512, 0.4), (512, 512, 0.4),
+            (512, 512, None), ("pool",)]
+    for s in spec:
+        if s[0] == "pool":
+            m.add(nn.SpatialMaxPooling(2, 2, 2, 2))
+        else:
+            m.add(conv_bn(s[0], s[1], s[2]))
+    (m.add(nn.Reshape((512,)))
+      .add(nn.Dropout(0.5) if has_dropout else nn.Identity())
+      .add(nn.Linear(512, 512))
+      .add(nn.BatchNormalization(512))
+      .add(nn.ReLU())
+      .add(nn.Dropout(0.5) if has_dropout else nn.Identity())
+      .add(nn.Linear(512, class_num))
+      .add(nn.LogSoftMax()))
+    return m

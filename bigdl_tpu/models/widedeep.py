@@ -1,0 +1,69 @@
+"""Wide & Deep recommender.
+
+Parity: not a model file in the reference tree — BASELINE.md instructs to
+compose it from the sparse building blocks (nn/SparseLinear,
+nn/SparseJoinTable, nn/LookupTableSparse) the way the pyspark API does.
+
+Input: Table(
+  1: wide_indices  [B, Lw]  (sparse one/multi-hot feature ids, -1 pad)
+  2: wide_values   [B, Lw]
+  3: deep_cat_ids  [B, C]   (one id per categorical column, 1-based)
+  4: deep_cont     [B, D]   (continuous features)
+)
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence
+
+import jax
+import jax.numpy as jnp
+
+import bigdl_tpu.nn as nn
+from bigdl_tpu.utils.table import T, Table
+
+
+class WideAndDeep(nn.Module):
+    def __init__(self, class_num: int = 2, wide_dim: int = 5000,
+                 embed_vocabs: Sequence[int] = (100, 100, 100),
+                 embed_dim: int = 8, cont_dim: int = 13,
+                 hidden: Sequence[int] = (100, 50), model_type: str = "wide_n_deep",
+                 name=None):
+        super().__init__(name or "WideAndDeep")
+        self.model_type = model_type
+        self.class_num = class_num
+        self.wide = nn.SparseLinear(wide_dim, class_num)
+        self.embeds = [nn.LookupTable(v, embed_dim) for v in embed_vocabs]
+        deep_in = embed_dim * len(embed_vocabs) + cont_dim
+        layers: List[nn.Module] = []
+        last = deep_in
+        for h in hidden:
+            layers += [nn.Linear(last, h), nn.ReLU()]
+            last = h
+        layers.append(nn.Linear(last, class_num))
+        self.deep = nn.Sequential()
+        for l in layers:
+            self.deep.add(l)
+
+    def init(self, rng):
+        ks = jax.random.split(rng, 2 + len(self.embeds))
+        return {
+            "wide": self.wide.init(ks[0]),
+            "deep": self.deep.init(ks[1]),
+            **{f"embed{i}": e.init(k)
+               for i, (e, k) in enumerate(zip(self.embeds, ks[2:]))},
+        }
+
+    def apply(self, params, input, ctx):
+        wide_idx, wide_val = input[1], input[2]
+        cat_ids, cont = input[3], input[4]
+        logits = 0.0
+        if self.model_type in ("wide", "wide_n_deep"):
+            logits = logits + self.wide.apply(params["wide"],
+                                              T(wide_idx, wide_val), ctx)
+        if self.model_type in ("deep", "wide_n_deep"):
+            embs = [e.apply(params[f"embed{i}"], cat_ids[:, i], ctx)
+                    for i, e in enumerate(self.embeds)]
+            deep_in = jnp.concatenate(embs + [cont], axis=-1)
+            logits = logits + self.deep.apply(params["deep"], deep_in, ctx)
+        return jax.nn.log_softmax(logits, axis=-1)

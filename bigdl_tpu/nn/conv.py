@@ -79,12 +79,26 @@ class SpatialConvolution(Module):
         x = input
         if self.data_format == "NCHW":
             x = jnp.transpose(x, (0, 2, 3, 1))
-        y = lax.conv_general_dilated(
-            x, params["weight"],
-            window_strides=(self.sh, self.sw),
-            padding=_padding2d(self.pad_h, self.pad_w),
-            feature_group_count=self.groups,
-            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        if self.groups == 1 and self.n_in <= 4:
+            # im2col + GEMM for tiny input channel counts (stem layers):
+            # numerically identical, and avoids a pathological XLA backward-
+            # filter compile for C_in=1 with large batch (minutes vs seconds,
+            # observed on TPU v5e); the GEMM feeds the MXU directly.
+            patches = lax.conv_general_dilated_patches(
+                x, (self.kh, self.kw), (self.sh, self.sw),
+                padding=_padding2d(self.pad_h, self.pad_w),
+                dimension_numbers=("NHWC", "HWIO", "NHWC"))
+            # patch features are (C, kh, kw)-ordered
+            w = jnp.transpose(params["weight"], (2, 0, 1, 3)).reshape(
+                (-1, self.n_out))
+            y = patches @ w
+        else:
+            y = lax.conv_general_dilated(
+                x, params["weight"],
+                window_strides=(self.sh, self.sw),
+                padding=_padding2d(self.pad_h, self.pad_w),
+                feature_group_count=self.groups,
+                dimension_numbers=("NHWC", "HWIO", "NHWC"))
         if self.with_bias:
             y = y + params["bias"]
         if self.data_format == "NCHW":

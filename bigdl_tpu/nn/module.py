@@ -182,6 +182,19 @@ class Module:
     def __repr__(self):
         return f"{self.__class__.__name__}({self.name})"
 
+    # sugar mirrored from reference AbstractModule.predict/evaluate
+    def predict(self, dataset, batch_size: int = 32):
+        from bigdl_tpu.optim.predictor import LocalPredictor
+        return LocalPredictor(self, batch_size=batch_size).predict(dataset)
+
+    def predict_class(self, dataset, batch_size: int = 32):
+        from bigdl_tpu.optim.predictor import LocalPredictor
+        return LocalPredictor(self, batch_size=batch_size).predict_class(dataset)
+
+    def evaluate_on(self, dataset, methods, batch_size: int = 32):
+        from bigdl_tpu.optim.evaluator import Evaluator
+        return Evaluator(self, batch_size=batch_size).test(dataset, methods)
+
 
 
 class Node:

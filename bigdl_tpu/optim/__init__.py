@@ -1,0 +1,30 @@
+"""bigdl_tpu.optim — optimization methods, training loops, validation."""
+
+from bigdl_tpu.optim.optim_method import (SGD, Adadelta, Adagrad, Adam, Adamax,
+                                          Ftrl, LBFGS, OptimMethod,
+                                          ParallelAdam, RMSprop)
+from bigdl_tpu.optim import schedules
+from bigdl_tpu.optim.schedules import (Default, EpochDecay,
+                                       EpochDecayWithWarmUp, EpochSchedule,
+                                       EpochStep, Exponential,
+                                       LearningRateSchedule, MultiStep,
+                                       NaturalExp, Plateau, Poly, Regime,
+                                       SequentialSchedule, Step, Warmup)
+from bigdl_tpu.optim.regularizer import (L1L2Regularizer, L1Regularizer,
+                                         L2Regularizer, Regularizer)
+from bigdl_tpu.optim import trigger as Trigger
+from bigdl_tpu.optim.trigger import (and_, every_epoch, max_epoch,
+                                     max_iteration, max_score, min_loss, or_,
+                                     several_iteration)
+from bigdl_tpu.optim.validation import (AccuracyResult, ContiguousResult,
+                                        HitRatio, Loss, LossResult, MAE, NDCG,
+                                        Top1Accuracy, Top5Accuracy,
+                                        TreeNNAccuracy, ValidationMethod,
+                                        ValidationResult)
+from bigdl_tpu.optim.metrics import Metrics, Timer
+from bigdl_tpu.optim.local_optimizer import LocalOptimizer
+from bigdl_tpu.optim.distri_optimizer import DistriOptimizer
+from bigdl_tpu.optim.optimizer import Optimizer
+from bigdl_tpu.optim.predictor import (LocalPredictor, PredictionService,
+                                       Predictor)
+from bigdl_tpu.optim.evaluator import DistriValidator, Evaluator, LocalValidator

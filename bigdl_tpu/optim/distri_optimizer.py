@@ -1,0 +1,213 @@
+"""Distributed (SPMD) training loop — the heart.
+
+Parity: DL/optim/DistriOptimizer.scala:696 + the AllReduceParameter plane
+(DL/parameters/AllReduceParameter.scala, SURVEY.md §5.8). Architecture
+translation, not port:
+
+  reference (Spark BlockManager PS)          TPU-native (this file)
+  -----------------------------------        ------------------------------
+  flat 1-D compacted parameter vector        pytree of jax.Arrays on a Mesh
+  getWeights: pull N fp16 chunks (netty)     weights never leave HBM
+  putGradients + aggregateGradientPartition  psum over ICI, inserted by XLA
+  per-partition optimMethod.optimize         update runs sharded per device
+  fp16 wire compression (truncate fp32)      bf16 compute dtype (native)
+  2 Spark jobs per iteration                 1 jitted step per iteration
+  straggler dropping (drop-slowest tasks)    obsolete: SPMD lockstep has no
+                                             stragglers inside a step —
+                                             documented semantic delta
+  job retry + reload newest snapshot         same, around the step loop
+
+The train step is jit-compiled with the batch sharded over the mesh 'data'
+axis and params placed per ShardingRules ('model' axis = tensor parallel,
+beyond reference parity). Because the loss is a mean over the global batch,
+XLA's SPMD partitioner inserts the gradient all-reduce (the psum) on ICI —
+the entire C15/C16/C23 parameter plane reduces to compiler-placed
+collectives.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from bigdl_tpu.dataset.sample import MiniBatch
+from bigdl_tpu.nn.criterion import Criterion
+from bigdl_tpu.nn.module import Module, functional_apply, merge_state
+from bigdl_tpu.optim.local_optimizer import BaseOptimizer, _to_device
+from bigdl_tpu.optim.metrics import Timer
+from bigdl_tpu.optim.trigger import Trigger
+from bigdl_tpu.parallel.mesh import build_mesh, shard_batch
+from bigdl_tpu.parallel.sharding import ShardingRules, infer_param_specs
+from bigdl_tpu.utils.table import Table
+
+logger = logging.getLogger("bigdl_tpu.optim")
+
+
+class DistriOptimizer(BaseOptimizer):
+    """Synchronous data-parallel (+ optional tensor-parallel) SGD on a mesh.
+
+    Failure handling parity (DistriOptimizer.scala:862-943): `optimize`
+    wraps the step loop in a retry that reloads the newest checkpoint
+    (bigdl.failure.retryTimes equivalent = `retry_times`).
+    """
+
+    def __init__(self, model: Module, dataset, criterion: Criterion,
+                 mesh: Optional[Mesh] = None,
+                 sharding_rules: Optional[ShardingRules] = None,
+                 retry_times: int = 5, retry_interval_s: float = 1.0):
+        super().__init__(model, dataset, criterion)
+        self.mesh = mesh or build_mesh()
+        self.rules = sharding_rules or ShardingRules()
+        self.retry_times = retry_times
+        self.retry_interval_s = retry_interval_s
+        self._step = None
+        self._param_shardings = None
+
+    # ------------------------------------------------------------------ #
+    def _place(self, params, model_state, opt_state):
+        mesh = self.mesh
+        specs = infer_param_specs(params, mesh, self.rules)
+        self._param_specs = specs
+        put = lambda leaf, spec: jax.device_put(leaf, NamedSharding(mesh, spec))
+        params = jax.tree_util.tree_map(put, params, specs)
+        # model state (BN stats) is small: replicate. Optimizer slots are
+        # created from the already-placed params in optimize(), so
+        # jnp.zeros_like inherits each param's sharding automatically —
+        # the analogue of the reference's per-partition optimMethod state.
+        model_state = jax.tree_util.tree_map(
+            lambda leaf: jax.device_put(leaf, NamedSharding(mesh, P())),
+            model_state)
+        return params, model_state
+
+    def _build_step(self):
+        model, criterion = self.model, self.criterion
+        optim = self.optim_method
+        clip = self._clip_grads_expr
+
+        def step(params, opt_state, model_state, x, y, lr, rng):
+            def loss_fn(p):
+                out, new_ms = functional_apply(model, p, x, state=model_state,
+                                               training=True, rng=rng)
+                return criterion.apply(out, y), new_ms
+
+            (loss, new_ms), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+            grads = clip(grads)
+            new_params, new_opt = optim.update(grads, opt_state, params, lr)
+            return new_params, new_opt, new_ms, loss
+
+        # jit with sharding propagated from the placed inputs; XLA SPMD
+        # partitions the computation and inserts the ICI collectives
+        return jax.jit(step, donate_argnums=(0, 1))
+
+    # ------------------------------------------------------------------ #
+    def optimize(self) -> Module:
+        attempt = 0
+        last_failure = time.time()
+        while True:
+            try:
+                return self._optimize_impl()
+            except (KeyboardInterrupt, SystemExit):
+                raise
+            except Exception as e:  # retry from newest checkpoint
+                attempt += 1
+                # space failures: reset count if they are far apart
+                if time.time() - last_failure > 120:
+                    attempt = 1
+                last_failure = time.time()
+                if attempt > self.retry_times or self.checkpoint_path is None:
+                    raise
+                logger.warning(
+                    f"Optimization failed ({e!r}); retry {attempt}/"
+                    f"{self.retry_times} from latest checkpoint")
+                from bigdl_tpu.serialization.checkpoint import (
+                    latest_checkpoint, load_checkpoint, restore_optim_method)
+                ck = latest_checkpoint(self.checkpoint_path)
+                if ck is not None:
+                    params, mstate, oblob = load_checkpoint(ck)
+                    self.model.set_params(params)
+                    self.model._state = mstate
+                    restore_optim_method(self.optim_method, oblob)
+                    # resume Adam moments / SGD velocity, not just counters
+                    self._resume_slots = oblob.get("slots")
+                time.sleep(self.retry_interval_s)
+
+    def _optimize_impl(self) -> Module:
+        mesh = self.mesh
+        params = self.model.ensure_params()
+        model_state = self.model._state
+        params, model_state = self._place(params, model_state, None)
+        resume_slots = getattr(self, "_resume_slots", None)
+        if resume_slots is not None:
+            # restore checkpointed optimizer moments, placed like the params
+            opt_state = jax.tree_util.tree_map(jnp.asarray, resume_slots)
+            self._resume_slots = None
+        else:
+            opt_state = self.optim_method.init_state(params)
+        step = self._build_step()
+        driver_state = self.optim_method.state
+        # per-host shard feeds this loop; scale records by host count so
+        # epoch triggers fire on global progress
+        num_hosts = getattr(self.dataset, "num_hosts", 1)
+        epoch_size = getattr(self.dataset, "global_size", None) or \
+            self.dataset.size() * num_hosts
+        data_iter = self.dataset.data(train=True)
+        n_dev = int(np.prod(mesh.devices.shape))
+
+        while not self.end_trigger(driver_state):
+            with Timer(self.metrics, "data fetch time"):
+                batch: MiniBatch = next(data_iter)
+            with Timer(self.metrics, "put batch on mesh"):
+                x = batch.get_input()
+                y = batch.get_target()
+                x = (Table(*[shard_batch(mesh, v) for v in x])
+                     if isinstance(x, list) else shard_batch(mesh, x))
+                y = (Table(*[shard_batch(mesh, v) for v in y])
+                     if isinstance(y, list) else shard_batch(mesh, y))
+            lr = self.optim_method.current_lr()
+            self.rng, step_rng = jax.random.split(self.rng)
+            with Timer(self.metrics, "computing time average"):
+                params, opt_state, new_ms, loss = step(
+                    params, opt_state, model_state, x, y, lr, step_rng)
+                loss = float(loss)
+            model_state = merge_state(model_state, new_ms)
+
+            n = batch.size() * num_hosts  # global records this step
+            driver_state["neval"] += 1
+            driver_state["recordsProcessedThisEpoch"] += n
+            driver_state["loss"] = loss
+            t = self.metrics.get("computing time average") / 1e9
+            throughput = n / max(t, 1e-9)
+            logger.info(
+                f"[Epoch {driver_state['epoch'] + 1} "
+                f"{driver_state['recordsProcessedThisEpoch']}/{epoch_size}]"
+                f"[Iteration {driver_state['neval']}] Training cost {loss}. "
+                f"Throughput is {throughput} records/second. "
+                f"({n_dev} devices)")
+            if self.train_summary is not None:
+                it = driver_state["neval"]
+                self.train_summary.add_scalar("Loss", loss, it)
+                self.train_summary.add_scalar("LearningRate", lr, it)
+                self.train_summary.add_scalar("Throughput", throughput, it)
+
+            if driver_state["recordsProcessedThisEpoch"] >= epoch_size:
+                driver_state["epoch"] += 1
+                driver_state["recordsProcessedThisEpoch"] = 0
+                self.dataset.shuffle()
+
+            self._validate(params, model_state, driver_state)
+            if self.checkpoint_trigger and self.checkpoint_trigger(driver_state):
+                with Timer(self.metrics, "checkpoint time"):
+                    self._save_checkpoint(params, model_state,
+                                          tag=f"iter{driver_state['neval']}",
+                                          opt_slots=opt_state)
+
+        # gather back to host (reference getModel:646 pulls partitions)
+        self.model.set_params(jax.device_get(params))
+        self.model._state = jax.device_get(model_state)
+        return self.model

@@ -1,0 +1,48 @@
+"""Distributed/local evaluation.
+
+Parity: DL/optim/Evaluator.scala + DistriValidator/LocalValidator — broadcast
+model, mapPartitions over batches, apply ValidationMethods, reduce results
+with `+`. Here: one jitted forward per batch, host-side result reduction.
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from bigdl_tpu.dataset.sample import MiniBatch
+from bigdl_tpu.nn.module import Module, functional_apply
+from bigdl_tpu.optim.predictor import LocalPredictor
+from bigdl_tpu.optim.validation import ValidationMethod, ValidationResult
+from bigdl_tpu.utils.table import Table
+
+
+class Evaluator:
+    def __init__(self, model: Module, batch_size: int = 32):
+        self.model = model
+        self.batch_size = batch_size
+        self._pred = LocalPredictor(model, batch_size)
+
+    def test(self, dataset, methods: Sequence[ValidationMethod]
+             ) -> List[ValidationResult]:
+        params = self.model.ensure_params()
+        state = self.model._state
+        results: List[ValidationResult] = [None] * len(methods)
+        for batch in self._pred._batches(dataset):
+            x = batch.get_input()
+            x = Table(*[jnp.asarray(v) for v in x]) if isinstance(x, list) else jnp.asarray(x)
+            t = batch.get_target()
+            t = Table(*[jnp.asarray(v) for v in t]) if isinstance(t, list) else jnp.asarray(t)
+            out = self._pred._forward(params, state, x)
+            for i, m in enumerate(methods):
+                r = m.apply(out, t)
+                results[i] = r if results[i] is None else results[i] + r
+        return results
+
+
+# parity aliases for the reference's validator classes
+LocalValidator = Evaluator
+DistriValidator = Evaluator

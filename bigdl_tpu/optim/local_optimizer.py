@@ -1,0 +1,269 @@
+"""Single-chip training loop.
+
+Parity: DL/optim/LocalOptimizer.scala:45 — the in-process optimizer. The
+reference clones N thread-replicas with shared weights and sums their
+gradients (LocalOptimizer.scala:64-82); on TPU the replicas disappear: one
+jitted train step consumes the whole batch, XLA owns the parallelism. The
+driver loop (triggers, LR schedule, checkpoint, validation, summary,
+throughput logging) mirrors the reference's structure so behavior and logs
+line up with DistriOptimizer.scala:405-410.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Callable, Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from bigdl_tpu.dataset.dataset import AbstractDataSet
+from bigdl_tpu.dataset.sample import MiniBatch
+from bigdl_tpu.nn.criterion import Criterion
+from bigdl_tpu.nn.module import Module, functional_apply, merge_state
+from bigdl_tpu.optim.metrics import Metrics, Timer
+from bigdl_tpu.optim.optim_method import OptimMethod, SGD
+from bigdl_tpu.optim.trigger import Trigger, every_epoch
+from bigdl_tpu.optim.validation import ValidationMethod
+from bigdl_tpu.utils.table import Table
+
+logger = logging.getLogger("bigdl_tpu.optim")
+
+
+def _to_device(x):
+    if isinstance(x, (list, tuple)):
+        return Table(*[jnp.asarray(v) for v in x])
+    return jnp.asarray(x)
+
+
+class BaseOptimizer:
+    """Shared driver-loop machinery for Local/Distri optimizers."""
+
+    def __init__(self, model: Module, dataset, criterion: Criterion):
+        self.model = model
+        self.dataset = dataset
+        self.criterion = criterion
+        self.optim_method: OptimMethod = SGD()
+        self.end_trigger: Trigger = every_epoch()
+        self.checkpoint_trigger: Optional[Trigger] = None
+        self.checkpoint_path: Optional[str] = None
+        self.overwrite_checkpoint = True
+        self.validation_trigger: Optional[Trigger] = None
+        self.validation_dataset = None
+        self.validation_methods: List[ValidationMethod] = []
+        self.train_summary = None
+        self.validation_summary = None
+        self.grad_clip_norm: Optional[float] = None
+        self.grad_clip_const: Optional[tuple] = None
+        self.metrics = Metrics()
+        self.rng = jax.random.PRNGKey(0)
+
+    # fluent setters (Optimizer.scala:93-452)
+    def set_optim_method(self, method: OptimMethod):
+        self.optim_method = method
+        return self
+
+    setOptimMethod = set_optim_method
+
+    def set_end_when(self, trigger: Trigger):
+        self.end_trigger = trigger
+        return self
+
+    setEndWhen = set_end_when
+
+    def set_checkpoint(self, path: str, trigger: Trigger):
+        self.checkpoint_path = path
+        self.checkpoint_trigger = trigger
+        return self
+
+    setCheckpoint = set_checkpoint
+
+    def set_validation(self, trigger: Trigger, dataset, methods: Sequence[ValidationMethod],
+                       batch_size: Optional[int] = None):
+        self.validation_trigger = trigger
+        self.validation_dataset = dataset
+        self.validation_methods = list(methods)
+        self.validation_batch_size = batch_size or 32
+        return self
+
+    setValidation = set_validation
+
+    def set_train_summary(self, summary):
+        self.train_summary = summary
+        return self
+
+    setTrainSummary = set_train_summary
+
+    def set_validation_summary(self, summary):
+        self.validation_summary = summary
+        return self
+
+    setValidationSummary = set_validation_summary
+
+    def set_gradient_clipping_by_l2_norm(self, clip_norm: float):
+        self.grad_clip_norm = clip_norm
+        return self
+
+    setGradientClippingByl2Norm = set_gradient_clipping_by_l2_norm
+
+    def set_constant_gradient_clipping(self, min_v: float, max_v: float):
+        self.grad_clip_const = (min_v, max_v)
+        return self
+
+    setConstantGradientClipping = set_constant_gradient_clipping
+
+    def disable_gradient_clipping(self):
+        self.grad_clip_norm = None
+        self.grad_clip_const = None
+        return self
+
+    # -- helpers --
+    def _clip_grads_expr(self, grads):
+        """Build the clipping expression (traced under jit). Parity:
+        ParameterOperations.scala:71 (constant) and :89 (global L2 norm)."""
+        if self.grad_clip_const is not None:
+            lo, hi = self.grad_clip_const
+            grads = jax.tree_util.tree_map(lambda g: jnp.clip(g, lo, hi), grads)
+        if self.grad_clip_norm is not None:
+            leaves = jax.tree_util.tree_leaves(grads)
+            total = jnp.sqrt(sum(jnp.sum(l.astype(jnp.float32) ** 2) for l in leaves))
+            scale = jnp.minimum(1.0, self.grad_clip_norm / (total + 1e-12))
+            grads = jax.tree_util.tree_map(lambda g: g * scale, grads)
+        return grads
+
+    def _save_checkpoint(self, params, model_state, tag, opt_slots=None):
+        if self.checkpoint_path is None:
+            return
+        from bigdl_tpu.serialization.checkpoint import save_checkpoint
+        save_checkpoint(self.checkpoint_path, self.model, params, model_state,
+                        self.optim_method, opt_slots=opt_slots, tag=tag,
+                        overwrite=self.overwrite_checkpoint)
+
+    def _validation_batches(self):
+        """Yield MiniBatches whether the dataset holds Samples or batches."""
+        from bigdl_tpu.dataset.sample import Sample
+        from bigdl_tpu.dataset.transformer import SampleToMiniBatch
+        it = iter(self.validation_dataset.data(train=False)
+                  if hasattr(self.validation_dataset, "data")
+                  else self.validation_dataset)
+        first = next(it, None)
+        if first is None:
+            return
+        import itertools
+        chained = itertools.chain([first], it)
+        if isinstance(first, Sample):
+            bs = getattr(self, "validation_batch_size", 32)
+            yield from SampleToMiniBatch(bs)(chained)
+        else:
+            yield from chained
+
+    def _validate(self, params, model_state, driver_state):
+        if not (self.validation_trigger and self.validation_dataset
+                and self.validation_trigger(driver_state)):
+            return None
+        results = [None] * len(self.validation_methods)
+        for batch in self._validation_batches():
+            x = _to_device(batch.get_input())
+            y = _to_device(batch.get_target())
+            out, _ = functional_apply(self.model, params, x,
+                                      state=model_state, training=False)
+            for i, m in enumerate(self.validation_methods):
+                r = m.apply(out, y)
+                results[i] = r if results[i] is None else results[i] + r
+        for m, r in zip(self.validation_methods, results):
+            logger.info(f"{m!r} is {r!r}")
+            if self.validation_summary is not None and r is not None:
+                val, _ = r.result()
+                self.validation_summary.add_scalar(
+                    repr(m), val, driver_state["neval"])
+        if results and results[0] is not None:
+            driver_state["score"] = results[0].result()[0]
+            # feed Plateau-style schedules
+            sched = getattr(self.optim_method, "schedule", None)
+            if sched is not None and hasattr(sched, "record"):
+                sched.record(driver_state["score"], self.optim_method)
+        return results
+
+
+class LocalOptimizer(BaseOptimizer):
+    """Train on the local device (one TPU chip / CPU)."""
+
+    def __init__(self, model: Module, dataset, criterion: Criterion,
+                 batch_size: int = 32):
+        super().__init__(model, dataset, criterion)
+        self.batch_size = batch_size
+
+    def _build_step(self):
+        model, criterion = self.model, self.criterion
+        optim = self.optim_method
+        clip = self._clip_grads_expr
+
+        def step(params, opt_state, model_state, x, y, lr, rng):
+            def loss_fn(p):
+                out, new_ms = functional_apply(model, p, x, state=model_state,
+                                               training=True, rng=rng)
+                return criterion.apply(out, y), new_ms
+
+            (loss, new_ms), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+            grads = clip(grads)
+            new_params, new_opt = optim.update(grads, opt_state, params, lr)
+            return new_params, new_opt, new_ms, loss
+
+        return jax.jit(step)
+
+    def optimize(self) -> Module:
+        params = self.model.ensure_params()
+        model_state = self.model._state
+        opt_state = self.optim_method.init_state(params)
+        step = self._build_step()
+        state = self.optim_method.state  # epoch/neval bookkeeping
+        driver_state = state
+        epoch_size = self.dataset.size()
+        data_iter = self.dataset.data(train=True)
+
+        while not self.end_trigger(driver_state):
+            with Timer(self.metrics, "data fetch time"):
+                batch: MiniBatch = next(data_iter)
+                x = _to_device(batch.get_input())
+                y = _to_device(batch.get_target())
+            lr = self.optim_method.current_lr()
+            self.rng, step_rng = jax.random.split(self.rng)
+            with Timer(self.metrics, "computing time average"):
+                params, opt_state, new_ms, loss = step(
+                    params, opt_state, model_state, x, y, lr, step_rng)
+                loss = float(loss)  # blocks: includes device execution
+            model_state = merge_state(model_state, new_ms)
+
+            n = batch.size()
+            driver_state["neval"] += 1
+            driver_state["recordsProcessedThisEpoch"] += n
+            driver_state["loss"] = loss
+            t = self.metrics.get("computing time average") / 1e9
+            throughput = n / max(t, 1e-9)
+            logger.info(
+                f"[Epoch {driver_state['epoch'] + 1} "
+                f"{driver_state['recordsProcessedThisEpoch']}/{epoch_size}]"
+                f"[Iteration {driver_state['neval']}] Training cost {loss}. "
+                f"Throughput is {throughput} records/second. ")
+            if self.train_summary is not None:
+                it = driver_state["neval"]
+                self.train_summary.add_scalar("Loss", loss, it)
+                self.train_summary.add_scalar("LearningRate", lr, it)
+                self.train_summary.add_scalar("Throughput", throughput, it)
+
+            if driver_state["recordsProcessedThisEpoch"] >= epoch_size:
+                driver_state["epoch"] += 1
+                driver_state["recordsProcessedThisEpoch"] = 0
+                self.dataset.shuffle()
+
+            self._validate(params, model_state, driver_state)
+            if self.checkpoint_trigger and self.checkpoint_trigger(driver_state):
+                self._save_checkpoint(params, model_state,
+                                      tag=f"iter{driver_state['neval']}",
+                                      opt_slots=opt_state)
+
+        self.model.set_params(params)
+        self.model._state = model_state
+        return self.model

@@ -1,0 +1,59 @@
+"""Named phase metrics.
+
+Parity: DL/optim/Metrics.scala:36-103 — named counters populated every
+iteration by the optimizers ("computing time average", "aggregate gradient
+time", ...) and dumped via summary(). Same table exists here so the
+BASELINE.md phase breakdown can be compared 1:1; entries are host wall-times
+around the jitted phases.
+"""
+
+from __future__ import annotations
+
+import time
+from collections import defaultdict
+from typing import Dict
+
+
+class Metrics:
+    def __init__(self):
+        self._sum: Dict[str, float] = defaultdict(float)
+        self._count: Dict[str, int] = defaultdict(int)
+
+    def add(self, name: str, value: float):
+        self._sum[name] += value
+        self._count[name] += 1
+
+    def set(self, name: str, value: float):
+        self._sum[name] = value
+        self._count[name] = 1
+
+    def get(self, name: str) -> float:
+        c = self._count.get(name, 0)
+        return self._sum[name] / c if c else 0.0
+
+    def summary(self, unit_scale: float = 1e9) -> str:
+        lines = ["========== Metrics Summary =========="]
+        for name in sorted(self._sum):
+            lines.append(f"{name} : {self.get(name) / unit_scale} s")
+        lines.append("=====================================")
+        return "\n".join(lines)
+
+    def reset(self):
+        self._sum.clear()
+        self._count.clear()
+
+
+class Timer:
+    """with Timer(metrics, name): ... — records nanoseconds like the
+    reference's System.nanoTime() deltas."""
+
+    def __init__(self, metrics: Metrics, name: str):
+        self.metrics, self.name = metrics, name
+
+    def __enter__(self):
+        self.t0 = time.perf_counter_ns()
+        return self
+
+    def __exit__(self, *exc):
+        self.metrics.add(self.name, time.perf_counter_ns() - self.t0)
+        return False

@@ -1,0 +1,381 @@
+"""Optimization methods.
+
+Parity: reference OptimMethod (DL/optim/OptimMethod.scala) and its
+implementations SGD/Adam/Adagrad/Adadelta/Adamax/RMSprop/Ftrl/ParallelAdam
+(one file each under DL/optim/). TPU-first: each method is a pure pytree
+update — `init_state(params)` + `update(grads, state, params, lr)` — applied
+inside a jitted train step, so the whole weight update fuses into the step's
+XLA computation. The reference's `ParallelAdam` (multi-threaded chunked
+update) is unnecessary: XLA already vectorizes the update across the VPU, and
+under pjit the update runs sharded per-device like the reference's
+per-partition optimMethod (DistriOptimizer.scala:383).
+
+Mutable bookkeeping that the reference keeps in `state` Tables (neval, epoch,
+loss) lives in `self.state` on the host, so LR schedules (SGD.scala:233-683)
+run on the driver exactly like the reference and feed a scalar lr into the
+jitted update.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from bigdl_tpu.optim.regularizer import Regularizer
+
+
+def _tree(fn, *trees):
+    return jax.tree_util.tree_map(fn, *trees)
+
+
+class OptimMethod:
+    """Base optimization method.
+
+    Host-side `state` dict mirrors the reference's state Table: epoch, neval,
+    recordsProcessedThisEpoch etc. Device-side slot state (moments) is a
+    pytree returned by init_state and threaded through update.
+    """
+
+    def __init__(self, learning_rate: float = 1e-3,
+                 weight_decay: float = 0.0):
+        self.learning_rate = learning_rate
+        self.weight_decay = weight_decay
+        self.state: Dict[str, Any] = {"epoch": 0, "neval": 0,
+                                      "recordsProcessedThisEpoch": 0}
+
+    # -- functional API used by the train step --
+    def init_state(self, params) -> Any:
+        return ()
+
+    def update(self, grads, opt_state, params, lr):
+        """Return (new_params, new_opt_state). Pure; called under jit."""
+        raise NotImplementedError
+
+    def _decay(self, grads, params):
+        if self.weight_decay:
+            wd = self.weight_decay
+            return _tree(lambda g, p: g + wd * p, grads, params)
+        return grads
+
+    # -- host-side hyperparameter plumbing (reference updateHyperParameter) --
+    def get_learning_rate(self) -> float:
+        return float(self.learning_rate)
+
+    def current_lr(self) -> float:
+        return self.get_learning_rate()
+
+    def load_from_table(self, table: Dict):
+        self.state.update(table)
+        return self
+
+    def get_hyper_parameter(self) -> str:
+        return f"Current learning rate is {self.current_lr()}."
+
+
+class SGD(OptimMethod):
+    """SGD with momentum/nesterov/dampening + pluggable LR schedule
+    (DL/optim/SGD.scala). The schedule object updates `current_lr` on the
+    host before each jitted step, mirroring
+    `LearningRateSchedule.updateHyperParameter`."""
+
+    def __init__(self, learning_rate: float = 1e-3,
+                 learning_rate_decay: float = 0.0,
+                 weight_decay: float = 0.0, momentum: float = 0.0,
+                 dampening: Optional[float] = None, nesterov: bool = False,
+                 learning_rate_schedule: Optional["LearningRateSchedule"] = None):
+        super().__init__(learning_rate, weight_decay)
+        self.learning_rate_decay = learning_rate_decay
+        self.momentum = momentum
+        self.dampening = momentum if dampening is None else dampening
+        self.nesterov = nesterov
+        if nesterov and (momentum <= 0 or self.dampening != 0):
+            raise ValueError(
+                "Nesterov momentum requires momentum > 0 and dampening = 0")
+        from bigdl_tpu.optim.schedules import Default
+        self.schedule = learning_rate_schedule or Default()
+        self._clr = self.learning_rate
+
+    def init_state(self, params):
+        if self.momentum > 0:
+            return {"velocity": _tree(jnp.zeros_like, params)}
+        return {}
+
+    def current_lr(self) -> float:
+        # schedule computes a NEGATIVE clr in the reference (SGD.scala); we
+        # keep it positive and subtract
+        self._clr = self.schedule.compute(self)
+        return self._clr
+
+    def update(self, grads, opt_state, params, lr):
+        grads = self._decay(grads, params)
+        if self.momentum > 0:
+            v = _tree(lambda vel, g: self.momentum * vel + (1 - self.dampening) * g,
+                      opt_state["velocity"], grads)
+            if self.nesterov:
+                step = _tree(lambda g, vel: g + self.momentum * vel, grads, v)
+            else:
+                step = v
+            new_params = _tree(lambda p, s: p - lr * s, params, step)
+            return new_params, {"velocity": v}
+        new_params = _tree(lambda p, g: p - lr * g, params, grads)
+        return new_params, opt_state
+
+
+class Adam(OptimMethod):
+    """(DL/optim/Adam.scala)."""
+
+    def __init__(self, learning_rate: float = 1e-3,
+                 learning_rate_decay: float = 0.0,
+                 beta1: float = 0.9, beta2: float = 0.999, epsilon: float = 1e-8,
+                 weight_decay: float = 0.0):
+        super().__init__(learning_rate, weight_decay)
+        self.learning_rate_decay = learning_rate_decay
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+
+    def init_state(self, params):
+        return {"m": _tree(jnp.zeros_like, params),
+                "v": _tree(jnp.zeros_like, params),
+                "t": jnp.zeros((), jnp.int32)}
+
+    def current_lr(self):
+        n = self.state["neval"]
+        return self.learning_rate / (1 + n * self.learning_rate_decay)
+
+    def update(self, grads, opt_state, params, lr):
+        grads = self._decay(grads, params)
+        t = opt_state["t"] + 1
+        b1, b2 = self.beta1, self.beta2
+        m = _tree(lambda m_, g: b1 * m_ + (1 - b1) * g, opt_state["m"], grads)
+        v = _tree(lambda v_, g: b2 * v_ + (1 - b2) * g * g, opt_state["v"], grads)
+        tf = t.astype(jnp.float32)
+        bc1 = 1.0 - jnp.power(b1, tf)
+        bc2 = 1.0 - jnp.power(b2, tf)
+        def upd(p, m_, v_):
+            mhat = m_ / bc1
+            vhat = v_ / bc2
+            return p - lr * mhat / (jnp.sqrt(vhat) + self.epsilon)
+        return _tree(upd, params, m, v), {"m": m, "v": v, "t": t}
+
+
+# The reference's ParallelAdam only parallelizes the update loop over threads;
+# under XLA the update is already data-parallel — same math, same name kept
+# for API parity.
+ParallelAdam = Adam
+
+
+class Adagrad(OptimMethod):
+    def __init__(self, learning_rate: float = 1e-3,
+                 learning_rate_decay: float = 0.0, weight_decay: float = 0.0):
+        super().__init__(learning_rate, weight_decay)
+        self.learning_rate_decay = learning_rate_decay
+
+    def init_state(self, params):
+        return {"accum": _tree(jnp.zeros_like, params)}
+
+    def current_lr(self):
+        n = self.state["neval"]
+        return self.learning_rate / (1 + n * self.learning_rate_decay)
+
+    def update(self, grads, opt_state, params, lr):
+        grads = self._decay(grads, params)
+        acc = _tree(lambda a, g: a + g * g, opt_state["accum"], grads)
+        new_params = _tree(lambda p, g, a: p - lr * g / (jnp.sqrt(a) + 1e-10),
+                           params, grads, acc)
+        return new_params, {"accum": acc}
+
+
+class Adadelta(OptimMethod):
+    def __init__(self, decay_rate: float = 0.9, epsilon: float = 1e-10,
+                 weight_decay: float = 0.0):
+        super().__init__(1.0, weight_decay)
+        self.rho, self.epsilon = decay_rate, epsilon
+
+    def init_state(self, params):
+        return {"accum": _tree(jnp.zeros_like, params),
+                "delta_accum": _tree(jnp.zeros_like, params)}
+
+    def update(self, grads, opt_state, params, lr):
+        grads = self._decay(grads, params)
+        rho, eps = self.rho, self.epsilon
+        acc = _tree(lambda a, g: rho * a + (1 - rho) * g * g,
+                    opt_state["accum"], grads)
+        step = _tree(lambda g, a, d: g * jnp.sqrt(d + eps) / jnp.sqrt(a + eps),
+                     grads, acc, opt_state["delta_accum"])
+        dacc = _tree(lambda d, s: rho * d + (1 - rho) * s * s,
+                     opt_state["delta_accum"], step)
+        return (_tree(lambda p, s: p - lr * s, params, step),
+                {"accum": acc, "delta_accum": dacc})
+
+
+class Adamax(OptimMethod):
+    def __init__(self, learning_rate: float = 0.002, beta1: float = 0.9,
+                 beta2: float = 0.999, epsilon: float = 1e-38,
+                 weight_decay: float = 0.0):
+        super().__init__(learning_rate, weight_decay)
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+
+    def init_state(self, params):
+        return {"m": _tree(jnp.zeros_like, params),
+                "u": _tree(jnp.zeros_like, params),
+                "t": jnp.zeros((), jnp.int32)}
+
+    def update(self, grads, opt_state, params, lr):
+        grads = self._decay(grads, params)
+        b1, b2 = self.beta1, self.beta2
+        t = opt_state["t"] + 1
+        m = _tree(lambda m_, g: b1 * m_ + (1 - b1) * g, opt_state["m"], grads)
+        u = _tree(lambda u_, g: jnp.maximum(b2 * u_, jnp.abs(g) + self.epsilon),
+                  opt_state["u"], grads)
+        bc = 1.0 - jnp.power(b1, t.astype(jnp.float32))
+        new_params = _tree(lambda p, m_, u_: p - (lr / bc) * m_ / u_, params, m, u)
+        return new_params, {"m": m, "u": u, "t": t}
+
+
+class RMSprop(OptimMethod):
+    def __init__(self, learning_rate: float = 1e-2,
+                 learning_rate_decay: float = 0.0, decay_rate: float = 0.99,
+                 epsilon: float = 1e-8, weight_decay: float = 0.0):
+        super().__init__(learning_rate, weight_decay)
+        self.learning_rate_decay = learning_rate_decay
+        self.rho, self.epsilon = decay_rate, epsilon
+
+    def init_state(self, params):
+        return {"accum": _tree(jnp.zeros_like, params)}
+
+    def current_lr(self):
+        n = self.state["neval"]
+        return self.learning_rate / (1 + n * self.learning_rate_decay)
+
+    def update(self, grads, opt_state, params, lr):
+        grads = self._decay(grads, params)
+        rho = self.rho
+        acc = _tree(lambda a, g: rho * a + (1 - rho) * g * g,
+                    opt_state["accum"], grads)
+        new_params = _tree(lambda p, g, a: p - lr * g / (jnp.sqrt(a) + self.epsilon),
+                           params, grads, acc)
+        return new_params, {"accum": acc}
+
+
+class Ftrl(OptimMethod):
+    """Follow-the-regularized-leader (DL/optim/Ftrl.scala)."""
+
+    def __init__(self, learning_rate: float = 1e-3,
+                 learning_rate_power: float = -0.5,
+                 initial_accumulator_value: float = 0.1,
+                 l1_regularization_strength: float = 0.0,
+                 l2_regularization_strength: float = 0.0,
+                 l2_shrinkage_regularization_strength: float = 0.0):
+        super().__init__(learning_rate)
+        self.lr_power = learning_rate_power
+        self.init_accum = initial_accumulator_value
+        self.l1 = l1_regularization_strength
+        self.l2 = l2_regularization_strength
+        self.l2_shrinkage = l2_shrinkage_regularization_strength
+
+    def init_state(self, params):
+        return {"accum": _tree(lambda p: jnp.full_like(p, self.init_accum), params),
+                "linear": _tree(jnp.zeros_like, params)}
+
+    def update(self, grads, opt_state, params, lr):
+        lp, l1, l2 = self.lr_power, self.l1, self.l2
+
+        def upd(p, g, a, lin):
+            gs = g + 2 * self.l2_shrinkage * p if self.l2_shrinkage else g
+            a2 = a + g * g
+            sigma = (jnp.power(a2, -lp) - jnp.power(a, -lp)) / lr
+            lin2 = lin + gs - sigma * p
+            quad = jnp.power(a2, -lp) / lr + 2 * l2
+            pre = jnp.clip(lin2, -l1, l1) - lin2
+            return pre / quad, a2, lin2
+
+        out = _tree(upd, params, grads, opt_state["accum"], opt_state["linear"])
+        # _tree with multi-output fn returns pytree of tuples; unzip
+        leaves, treedef = jax.tree_util.tree_flatten(
+            out, is_leaf=lambda x: isinstance(x, tuple))
+        new_p = treedef.unflatten([l[0] for l in leaves])
+        new_a = treedef.unflatten([l[1] for l in leaves])
+        new_l = treedef.unflatten([l[2] for l in leaves])
+        return new_p, {"accum": new_a, "linear": new_l}
+
+
+class LBFGS(OptimMethod):
+    """Limited-memory BFGS (DL/optim/LBFGS.scala). Used by the reference only
+    for full-batch toy problems; implemented host-side with a closure over
+    the jitted loss/grad fn via jax.scipy-style two-loop recursion."""
+
+    def __init__(self, max_iter: int = 20, max_eval: Optional[float] = None,
+                 tol_fun: float = 1e-5, tol_x: float = 1e-9,
+                 n_correction: int = 100, learning_rate: float = 1.0):
+        super().__init__(learning_rate)
+        self.max_iter = max_iter
+        self.tol_fun, self.tol_x = tol_fun, tol_x
+        self.n_correction = n_correction
+
+    def init_state(self, params):
+        return {"history": []}
+
+    def update(self, grads, opt_state, params, lr):
+        # simple gradient step fallback inside jitted paths; full two-loop
+        # recursion is exposed via `optimize_full_batch`
+        return _tree(lambda p, g: p - lr * g, params, grads), opt_state
+
+    def optimize_full_batch(self, loss_and_grad, params):
+        """Run max_iter L-BFGS iterations; loss_and_grad(params)->(loss,grads)."""
+        flat, treedef = jax.tree_util.tree_flatten(params)
+        shapes = [l.shape for l in flat]
+
+        def pack(leaves):
+            return jnp.concatenate([jnp.ravel(l) for l in leaves])
+
+        def unpack(vec):
+            out, off = [], 0
+            for s in shapes:
+                n = 1
+                for d in s:
+                    n *= d
+                out.append(vec[off:off + n].reshape(s))
+                off += n
+            return treedef.unflatten(out)
+
+        x = pack(flat)
+        s_hist, y_hist = [], []
+        f_prev = None
+        for it in range(self.max_iter):
+            loss, grads = loss_and_grad(unpack(x))
+            g = pack(jax.tree_util.tree_leaves(grads))
+            if f_prev is not None and abs(float(loss) - f_prev) < self.tol_fun:
+                break
+            f_prev = float(loss)
+            q = g
+            alphas = []
+            for s, y in reversed(list(zip(s_hist, y_hist))):
+                rho = 1.0 / (jnp.dot(y, s) + 1e-10)
+                a = rho * jnp.dot(s, q)
+                q = q - a * y
+                alphas.append((rho, a))
+            if y_hist:
+                gamma = jnp.dot(s_hist[-1], y_hist[-1]) / (
+                    jnp.dot(y_hist[-1], y_hist[-1]) + 1e-10)
+                q = gamma * q
+            for (s, y), (rho, a) in zip(zip(s_hist, y_hist), reversed(alphas)):
+                b = rho * jnp.dot(y, q)
+                q = q + (a - b) * s
+            d = -q
+            step = self.learning_rate
+            x_new = x + step * d
+            _, g_new_tree = loss_and_grad(unpack(x_new))
+            g_new = pack(jax.tree_util.tree_leaves(g_new_tree))
+            s_vec, y_vec = x_new - x, g_new - g
+            if float(jnp.dot(s_vec, y_vec)) > 1e-10:
+                s_hist.append(s_vec)
+                y_hist.append(y_vec)
+                if len(s_hist) > self.n_correction:
+                    s_hist.pop(0)
+                    y_hist.pop(0)
+            if float(jnp.max(jnp.abs(step * d))) < self.tol_x:
+                x = x_new
+                break
+            x = x_new
+        return unpack(x)

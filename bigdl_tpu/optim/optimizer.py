@@ -1,0 +1,56 @@
+"""Optimizer factory.
+
+Parity: DL/optim/Optimizer.scala:602-693 — `Optimizer(model, dataset,
+criterion, batchSize)` picks Local vs Distri from the environment. Here:
+one visible device -> LocalOptimizer; several -> DistriOptimizer on a data
+mesh. Accepts numpy arrays, Sample datasets, or AbstractDataSet.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Union
+
+import jax
+import numpy as np
+
+from bigdl_tpu.dataset.dataset import AbstractDataSet, DataSet, LocalDataSet
+from bigdl_tpu.dataset.sample import Sample
+from bigdl_tpu.dataset.transformer import SampleToMiniBatch
+from bigdl_tpu.nn.criterion import Criterion
+from bigdl_tpu.nn.module import Module
+from bigdl_tpu.optim.distri_optimizer import DistriOptimizer
+from bigdl_tpu.optim.local_optimizer import LocalOptimizer
+
+
+def Optimizer(model: Module, training_set, criterion: Criterion,
+              batch_size: int = 32, local: Optional[bool] = None,
+              drop_remainder: Optional[bool] = None, **kw):
+    """Build the right optimizer for the current device topology."""
+    n_dev = len(jax.devices())
+    if local is None:
+        local = n_dev <= 1
+    if drop_remainder is None:
+        drop_remainder = not local  # SPMD needs equal shards per step
+    dataset = _as_batched_dataset(training_set, batch_size, drop_remainder)
+    if local:
+        return LocalOptimizer(model, dataset, criterion, batch_size=batch_size)
+    return DistriOptimizer(model, dataset, criterion, **kw)
+
+
+def _as_batched_dataset(training_set, batch_size: int, drop_remainder: bool):
+    if isinstance(training_set, AbstractDataSet):
+        base = training_set
+    elif isinstance(training_set, (list, tuple)) and len(training_set) == 2 \
+            and isinstance(training_set[0], np.ndarray):
+        base = DataSet.from_arrays(training_set[0], training_set[1])
+    elif isinstance(training_set, (list, tuple)) and training_set \
+            and isinstance(training_set[0], Sample):
+        base = LocalDataSet(list(training_set))
+    else:
+        raise TypeError(f"cannot build dataset from {type(training_set)}")
+    first = next(iter(base.data(train=False)), None)
+    from bigdl_tpu.dataset.sample import MiniBatch
+    if isinstance(first, MiniBatch):
+        return base
+    return base.transform(
+        SampleToMiniBatch(batch_size, drop_remainder=drop_remainder))

@@ -1,0 +1,105 @@
+"""Batch inference.
+
+Parity: DL/optim/Predictor.scala (distributed RDD predict), LocalPredictor,
+PredictionService (thread-safe serving, PredictionService.scala:56). On TPU
+one jitted forward handles a batch; the reference's per-executor model
+broadcast + instance pool collapses into XLA's compiled executable reuse.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Iterable, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from bigdl_tpu.dataset.sample import MiniBatch, Sample
+from bigdl_tpu.dataset.transformer import SampleToMiniBatch
+from bigdl_tpu.nn.module import Module, functional_apply
+from bigdl_tpu.utils.table import Table
+
+
+class LocalPredictor:
+    def __init__(self, model: Module, batch_size: int = 32):
+        self.model = model
+        self.batch_size = batch_size
+        self._jitted = None
+
+    def _forward(self, params, state, x):
+        if self._jitted is None:
+            model = self.model
+
+            def fwd(params, state, x):
+                out, _ = functional_apply(model, params, x, state=state,
+                                          training=False)
+                return out
+
+            self._jitted = jax.jit(fwd)
+        return self._jitted(params, state, x)
+
+    def predict(self, dataset) -> List[np.ndarray]:
+        """dataset: AbstractDataSet of Samples, iterable of Samples, or
+        iterable of MiniBatches. Returns per-sample outputs."""
+        params = self.model.ensure_params()
+        state = self.model._state
+        outs: List[np.ndarray] = []
+        for batch in self._batches(dataset):
+            x = batch.get_input()
+            x = Table(*[jnp.asarray(v) for v in x]) if isinstance(x, list) else jnp.asarray(x)
+            y = self._forward(params, state, x)
+            if isinstance(y, Table):
+                y = y[1]
+            outs.extend(np.asarray(y))
+        return outs
+
+    def predict_class(self, dataset) -> List[int]:
+        """1-based class predictions (reference predictClass)."""
+        return [int(np.argmax(o)) + 1 for o in self.predict(dataset)]
+
+    def _batches(self, dataset) -> Iterable[MiniBatch]:
+        if hasattr(dataset, "data"):
+            it = dataset.data(train=False)
+        else:
+            it = iter(dataset)
+        it = iter(it)
+        try:
+            first = next(it)
+        except StopIteration:
+            return
+        import itertools
+        chained = itertools.chain([first], it)
+        if isinstance(first, MiniBatch):
+            yield from chained
+        else:
+            yield from SampleToMiniBatch(self.batch_size)(chained)
+
+
+# Distributed predict = local predict on each host's shard; alias for parity.
+Predictor = LocalPredictor
+
+
+class PredictionService:
+    """Thread-safe serving (PredictionService.scala:56-67). The reference
+    needed an instance pool because module objects mutate during forward;
+    XLA compiled executables are immutable and thread-safe, so concurrent
+    predict() calls just share one executable — no pool, no lock. Only the
+    one-time compile is guarded."""
+
+    def __init__(self, model: Module, batch_size: int = 32):
+        self.predictor = LocalPredictor(model, batch_size)
+        self.model = model
+        self._compile_lock = threading.Lock()
+
+    def predict(self, sample: Sample) -> np.ndarray:
+        params = self.model.ensure_params()
+        x = jnp.asarray(sample.feature)[None]
+        if self.predictor._jitted is None:
+            with self._compile_lock:
+                self.predictor._forward(params, self.model._state, x)
+        y = self.predictor._forward(params, self.model._state, x)
+        if isinstance(y, Table):
+            y = y[1]
+        return np.asarray(y)[0]

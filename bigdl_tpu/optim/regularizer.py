@@ -1,0 +1,50 @@
+"""Regularizers.
+
+Parity: DL/optim/Regularizer.scala — L1, L2, L1L2 applied to gradients per
+layer. In the TPU build, L2 is typically folded into the OptimMethod's
+weight_decay; these classes exist for per-layer regularizer parity (the
+reference attaches wRegularizer/bRegularizer per layer).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+class Regularizer:
+    def grad_update(self, param, grad):
+        return grad
+
+    def loss(self, param):
+        return 0.0
+
+
+class L1L2Regularizer(Regularizer):
+    def __init__(self, l1: float = 0.0, l2: float = 0.0):
+        self.l1, self.l2 = l1, l2
+
+    def grad_update(self, param, grad):
+        g = grad
+        if self.l1:
+            g = g + self.l1 * jnp.sign(param)
+        if self.l2:
+            g = g + self.l2 * param
+        return g
+
+    def loss(self, param):
+        out = 0.0
+        if self.l1:
+            out = out + self.l1 * jnp.sum(jnp.abs(param))
+        if self.l2:
+            out = out + 0.5 * self.l2 * jnp.sum(param * param)
+        return out
+
+
+class L1Regularizer(L1L2Regularizer):
+    def __init__(self, l1: float):
+        super().__init__(l1=l1)
+
+
+class L2Regularizer(L1L2Regularizer):
+    def __init__(self, l2: float):
+        super().__init__(l2=l2)

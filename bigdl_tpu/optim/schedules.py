@@ -1,0 +1,239 @@
+"""Learning-rate schedules.
+
+Parity: the 13 schedules nested in the reference's SGD
+(DL/optim/SGD.scala:233-683): Default, EpochSchedule(Regime), Poly, Step,
+MultiStep, EpochDecay, EpochStep, NaturalExp, Exponential, Plateau, Warmup,
+SequentialSchedule, EpochDecayWithWarmUp. Host-side pure computations from
+the optimizer's state dict (epoch/neval/score), exactly like the reference's
+driver-side `updateHyperParameter`.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import List, Optional, Sequence
+
+
+class LearningRateSchedule:
+    def compute(self, optim: "SGD") -> float:  # noqa: F821
+        raise NotImplementedError
+
+
+class Default(LearningRateSchedule):
+    """lr / (1 + neval * lr_decay) — reference SGD.Default."""
+
+    def compute(self, optim):
+        n = optim.state["neval"]
+        return optim.learning_rate / (1 + n * optim.learning_rate_decay)
+
+
+class Poly(LearningRateSchedule):
+    """lr * (1 - iter/max)^power (SGD.scala Poly)."""
+
+    def __init__(self, power: float, max_iteration: int):
+        self.power, self.max_iteration = power, max_iteration
+
+    def compute(self, optim):
+        n = optim.state["neval"]
+        if n > self.max_iteration:
+            return 0.0
+        return optim.learning_rate * math.pow(
+            1.0 - n / self.max_iteration, self.power)
+
+
+class Step(LearningRateSchedule):
+    """lr * gamma^(floor(iter/stepSize)) (SGD.scala Step)."""
+
+    def __init__(self, step_size: int, gamma: float):
+        self.step_size, self.gamma = step_size, gamma
+
+    def compute(self, optim):
+        return optim.learning_rate * math.pow(
+            self.gamma, optim.state["neval"] // self.step_size)
+
+
+class MultiStep(LearningRateSchedule):
+    def __init__(self, step_sizes: Sequence[int], gamma: float):
+        self.step_sizes, self.gamma = list(step_sizes), gamma
+
+    def compute(self, optim):
+        n = optim.state["neval"]
+        k = 0
+        for s in self.step_sizes:
+            if n >= s:
+                k += 1
+        return optim.learning_rate * math.pow(self.gamma, k)
+
+
+class EpochDecay(LearningRateSchedule):
+    def __init__(self, decay_fn):
+        self.decay_fn = decay_fn
+
+    def compute(self, optim):
+        return optim.learning_rate * math.pow(
+            0.1, self.decay_fn(optim.state["epoch"]))
+
+
+class EpochStep(LearningRateSchedule):
+    def __init__(self, step_size: int, gamma: float):
+        self.step_size, self.gamma = step_size, gamma
+
+    def compute(self, optim):
+        return optim.learning_rate * math.pow(
+            self.gamma, optim.state["epoch"] // self.step_size)
+
+
+class NaturalExp(LearningRateSchedule):
+    def __init__(self, decay_step: int, gamma: float):
+        self.decay_step, self.gamma = decay_step, gamma
+
+    def compute(self, optim):
+        return optim.learning_rate * math.exp(
+            -self.gamma * (optim.state["neval"] // self.decay_step))
+
+
+class Exponential(LearningRateSchedule):
+    def __init__(self, decay_step: int, decay_rate: float, staircase: bool = False):
+        self.decay_step, self.decay_rate, self.staircase = decay_step, decay_rate, staircase
+
+    def compute(self, optim):
+        p = optim.state["neval"] / self.decay_step
+        if self.staircase:
+            p = math.floor(p)
+        return optim.learning_rate * math.pow(self.decay_rate, p)
+
+
+class Regime:
+    def __init__(self, start_epoch: int, end_epoch: int, config: dict):
+        self.start_epoch, self.end_epoch, self.config = start_epoch, end_epoch, config
+
+
+class EpochSchedule(LearningRateSchedule):
+    """Per-epoch-range hyperparameter regimes (SGD.scala EpochSchedule).
+    Regime config keys use the reference's camelCase names and are mapped
+    onto the OptimMethod's attributes; all keys apply, lr is returned."""
+
+    _KEY_MAP = {
+        "learningRate": "learning_rate",
+        "learningRateDecay": "learning_rate_decay",
+        "weightDecay": "weight_decay",
+        "momentum": "momentum",
+        "dampening": "dampening",
+        "nesterov": "nesterov",
+    }
+
+    def __init__(self, regimes: Sequence[Regime]):
+        self.regimes = list(regimes)
+
+    def compute(self, optim):
+        epoch = optim.state["epoch"] + 1  # reference epochs are 1-based
+        lr = optim.learning_rate
+        for r in self.regimes:
+            if r.start_epoch <= epoch <= r.end_epoch:
+                for k, v in r.config.items():
+                    attr = self._KEY_MAP.get(k, k)
+                    if attr == "learning_rate":
+                        lr = v
+                    elif hasattr(optim, attr):
+                        setattr(optim, attr, v)
+                    else:
+                        raise ValueError(
+                            f"unknown regime hyperparameter {k!r}")
+                break
+        return lr
+
+
+class Plateau(LearningRateSchedule):
+    """Reduce on metric plateau (SGD.scala Plateau). Call `record(score)`
+    after each validation (the LocalOptimizer does this)."""
+
+    def __init__(self, monitor: str = "score", factor: float = 0.1,
+                 patience: int = 10, mode: str = "min", epsilon: float = 1e-4,
+                 cooldown: int = 0, min_lr: float = 0.0):
+        self.monitor, self.factor, self.patience = monitor, factor, patience
+        self.mode, self.epsilon, self.cooldown, self.min_lr = mode, epsilon, cooldown, min_lr
+        self.best: Optional[float] = None
+        self.wait = 0
+        self.cooldown_counter = 0
+        self._lr: Optional[float] = None
+
+    def record(self, value: float, optim=None):
+        if self._lr is None:
+            self._lr = optim.learning_rate if optim else 0.01
+        improved = (self.best is None or
+                    (self.mode == "min" and value < self.best - self.epsilon) or
+                    (self.mode == "max" and value > self.best + self.epsilon))
+        if self.cooldown_counter > 0:
+            self.cooldown_counter -= 1
+            self.wait = 0
+        if improved:
+            self.best = value
+            self.wait = 0
+        elif self.cooldown_counter == 0:
+            self.wait += 1
+            if self.wait >= self.patience:
+                self._lr = max(self._lr * self.factor, self.min_lr)
+                self.cooldown_counter = self.cooldown
+                self.wait = 0
+
+    def compute(self, optim):
+        if self._lr is None:
+            self._lr = optim.learning_rate
+        return self._lr
+
+
+class Warmup(LearningRateSchedule):
+    """Linear ramp by `delta` per iteration (SGD.scala Warmup); usually the
+    first stage of a SequentialSchedule."""
+
+    def __init__(self, delta: float):
+        self.delta = delta
+
+    def compute(self, optim):
+        return optim.learning_rate + self.delta * optim.state["neval"]
+
+
+class SequentialSchedule(LearningRateSchedule):
+    """Chain schedules, each active for `max_iteration` steps
+    (SGD.scala SequentialSchedule)."""
+
+    def __init__(self, iteration_per_epoch: int = 1):
+        self.iteration_per_epoch = iteration_per_epoch
+        self.schedules: List[LearningRateSchedule] = []
+        self.durations: List[int] = []
+
+    def add(self, schedule: LearningRateSchedule, max_iteration: int):
+        self.schedules.append(schedule)
+        self.durations.append(max_iteration)
+        return self
+
+    def compute(self, optim):
+        n = optim.state["neval"]
+        offset = 0
+        for sched, dur in zip(self.schedules, self.durations):
+            if n < offset + dur or sched is self.schedules[-1]:
+                saved = optim.state["neval"]
+                optim.state["neval"] = n - offset
+                try:
+                    return sched.compute(optim)
+                finally:
+                    optim.state["neval"] = saved
+            offset += dur
+        return optim.learning_rate
+
+
+class EpochDecayWithWarmUp(LearningRateSchedule):
+    """Linear warmup then step decay by epoch (SGD.scala
+    EpochDecayWithWarmUp — the ImageNet ResNet-50 recipe)."""
+
+    def __init__(self, warmup_iteration: int, warmup_delta: float, decay_type):
+        self.warmup_iteration = warmup_iteration
+        self.warmup_delta = warmup_delta
+        self.decay_type = decay_type
+
+    def compute(self, optim):
+        n = optim.state["neval"]
+        if n < self.warmup_iteration:
+            return optim.learning_rate + self.warmup_delta * n
+        max_lr = optim.learning_rate + self.warmup_delta * self.warmup_iteration
+        return max_lr * math.pow(0.1, self.decay_type(optim.state["epoch"]))

@@ -1,0 +1,74 @@
+"""Trigger algebra.
+
+Parity: DL/optim/Trigger.scala — everyEpoch, severalIteration, maxEpoch,
+maxIteration, maxScore, minLoss + and/or composition. A trigger is a
+predicate over the driver-side training state dict.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict
+
+
+class Trigger:
+    def __init__(self, fn: Callable[[Dict], bool]):
+        self._fn = fn
+
+    def __call__(self, state: Dict) -> bool:
+        return self._fn(state)
+
+
+def every_epoch() -> Trigger:
+    """Fires when an epoch boundary was just crossed."""
+
+    class _T(Trigger):
+        def __init__(self):
+            self.last = 0
+            super().__init__(self._check)
+
+        def _check(self, state):
+            e = state.get("epoch", 0)
+            if e > self.last:
+                self.last = e
+                return True
+            return False
+
+    return _T()
+
+
+def several_iteration(interval: int) -> Trigger:
+    return Trigger(lambda s: s.get("neval", 0) % interval == 0
+                   and s.get("neval", 0) > 0)
+
+
+def max_epoch(n: int) -> Trigger:
+    return Trigger(lambda s: s.get("epoch", 0) >= n)
+
+
+def max_iteration(n: int) -> Trigger:
+    return Trigger(lambda s: s.get("neval", 0) >= n)
+
+
+def max_score(v: float) -> Trigger:
+    return Trigger(lambda s: s.get("score", float("-inf")) > v)
+
+
+def min_loss(v: float) -> Trigger:
+    return Trigger(lambda s: s.get("loss", float("inf")) < v)
+
+
+def and_(*triggers: Trigger) -> Trigger:
+    return Trigger(lambda s: all(t(s) for t in triggers))
+
+
+def or_(*triggers: Trigger) -> Trigger:
+    return Trigger(lambda s: any(t(s) for t in triggers))
+
+
+# CamelCase aliases mirroring the reference's Trigger object members
+everyEpoch = every_epoch
+severalIteration = several_iteration
+maxEpoch = max_epoch
+maxIteration = max_iteration
+maxScore = max_score
+minLoss = min_loss

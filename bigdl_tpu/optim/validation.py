@@ -1,0 +1,188 @@
+"""Validation methods and results.
+
+Parity: DL/optim/ValidationMethod.scala — Top1Accuracy, Top5Accuracy, Loss,
+MAE, HitRatio, NDCG, TreeNNAccuracy; results aggregate with `+` like the
+reference's ValidationResult. Computations are jnp so they run on device and
+only the small (correct, count) pair hits the host.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+
+class ValidationResult:
+    def result(self):
+        raise NotImplementedError
+
+    def __add__(self, other):
+        raise NotImplementedError
+
+
+class AccuracyResult(ValidationResult):
+    def __init__(self, correct: float, count: float):
+        self.correct, self.count = float(correct), float(count)
+
+    def result(self):
+        return (self.correct / max(self.count, 1.0), int(self.count))
+
+    def __add__(self, other):
+        return AccuracyResult(self.correct + other.correct, self.count + other.count)
+
+    def __repr__(self):
+        acc, n = self.result()
+        return f"Accuracy(correct={int(self.correct)}, count={n}, accuracy={acc})"
+
+
+class LossResult(ValidationResult):
+    def __init__(self, loss: float, count: float):
+        self.loss, self.count = float(loss), float(count)
+
+    def result(self):
+        return (self.loss / max(self.count, 1.0), int(self.count))
+
+    def __add__(self, other):
+        return LossResult(self.loss + other.loss, self.count + other.count)
+
+    def __repr__(self):
+        l, n = self.result()
+        return f"Loss(loss={self.loss}, count={n}, average={l})"
+
+
+class ContiguousResult(LossResult):
+    pass
+
+
+class ValidationMethod:
+    """apply(output, target) -> ValidationResult for one batch."""
+
+    def apply(self, output, target) -> ValidationResult:
+        raise NotImplementedError
+
+    def __call__(self, output, target):
+        return self.apply(output, target)
+
+
+class Top1Accuracy(ValidationMethod):
+    """1-based integer targets like the reference."""
+
+    def __init__(self, zero_based: bool = False):
+        self.zero_based = zero_based
+
+    def apply(self, output, target):
+        pred = jnp.argmax(output, axis=-1)
+        t = jnp.asarray(target).astype(jnp.int32).reshape((-1,))
+        if not self.zero_based:
+            t = t - 1
+        correct = jnp.sum((pred.reshape((-1,)) == t).astype(jnp.float32))
+        return AccuracyResult(float(correct), t.shape[0])
+
+    def __repr__(self):
+        return "Top1Accuracy"
+
+
+class Top5Accuracy(ValidationMethod):
+    def __init__(self, zero_based: bool = False):
+        self.zero_based = zero_based
+
+    def apply(self, output, target):
+        t = jnp.asarray(target).astype(jnp.int32).reshape((-1,))
+        if not self.zero_based:
+            t = t - 1
+        o = output.reshape((t.shape[0], -1))
+        top5 = jnp.argsort(o, axis=-1)[:, -5:]
+        correct = jnp.sum(jnp.any(top5 == t[:, None], axis=-1).astype(jnp.float32))
+        return AccuracyResult(float(correct), t.shape[0])
+
+    def __repr__(self):
+        return "Top5Accuracy"
+
+
+class Loss(ValidationMethod):
+    def __init__(self, criterion=None):
+        if criterion is None:
+            from bigdl_tpu.nn.criterion import ClassNLLCriterion
+            criterion = ClassNLLCriterion()
+        self.criterion = criterion
+
+    def apply(self, output, target):
+        l = self.criterion.loss(output, target)
+        n = output.shape[0] if hasattr(output, "shape") else 1
+        return LossResult(float(l) * n, n)
+
+    def __repr__(self):
+        return "Loss"
+
+
+class MAE(ValidationMethod):
+    def apply(self, output, target):
+        # reference compares the 1-based max index to the target
+        # (ValidationMethod.scala MAE)
+        pred = jnp.argmax(output, -1).astype(jnp.float32) + 1.0
+        err = jnp.mean(jnp.abs(pred - jnp.asarray(target).reshape((-1,))))
+        return LossResult(float(err) * output.shape[0], output.shape[0])
+
+    def __repr__(self):
+        return "MAE"
+
+
+def _positive_rank(output, target, neg_num):
+    """Rank of the positive item per group. The reference locates the
+    positive via target == 1 (ValidationMethod.scala HitRatio);
+    target=None falls back to the column-0 convention."""
+    o = jnp.asarray(output).reshape((-1, neg_num + 1))
+    if target is None:
+        pos = o[:, 0]
+    else:
+        t = jnp.asarray(target).reshape(o.shape)
+        pos = jnp.sum(o * (t == 1), axis=-1)
+    return o, jnp.sum((o > pos[:, None]).astype(jnp.int32), axis=-1) + 1
+
+
+class HitRatio(ValidationMethod):
+    """HR@k for recommendation (DL/optim/ValidationMethod.scala HitRatio):
+    output = scores for 1 positive + neg_num negatives per user; target
+    marks the positive with 1."""
+
+    def __init__(self, k: int = 10, neg_num: int = 100):
+        self.k, self.neg_num = k, neg_num
+
+    def apply(self, output, target):
+        o, rank = _positive_rank(output, target, self.neg_num)
+        hits = jnp.sum((rank <= self.k).astype(jnp.float32))
+        return AccuracyResult(float(hits), o.shape[0])
+
+    def __repr__(self):
+        return f"HitRate@{self.k}"
+
+
+class NDCG(ValidationMethod):
+    def __init__(self, k: int = 10, neg_num: int = 100):
+        self.k, self.neg_num = k, neg_num
+
+    def apply(self, output, target):
+        o, rank = _positive_rank(output, target, self.neg_num)
+        gain = jnp.where(rank <= self.k, 1.0 / jnp.log2(rank + 1.0), 0.0)
+        return AccuracyResult(float(jnp.sum(gain)), o.shape[0])
+
+    def __repr__(self):
+        return f"NDCG@{self.k}"
+
+
+class TreeNNAccuracy(ValidationMethod):
+    """Accuracy on the root prediction of a tree output [B, N, C]
+    (reference TreeNNAccuracy — uses the first node's scores)."""
+
+    def apply(self, output, target):
+        o = output[:, 0, :] if output.ndim == 3 else output
+        t = jnp.asarray(target)
+        t = t[:, 0] if t.ndim >= 2 else t
+        pred = jnp.argmax(o, axis=-1)
+        correct = jnp.sum((pred == t.astype(jnp.int32) - 1).astype(jnp.float32))
+        return AccuracyResult(float(correct), o.shape[0])
+
+    def __repr__(self):
+        return "TreeNNAccuracy"

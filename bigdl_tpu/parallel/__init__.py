@@ -1,0 +1,3 @@
+from bigdl_tpu.parallel.mesh import (build_mesh, data_sharding,
+                                     replicate_sharding)
+from bigdl_tpu.parallel.sharding import (ShardingRules, infer_param_specs)

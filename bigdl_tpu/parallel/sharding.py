@@ -1,0 +1,77 @@
+"""Parameter sharding rules (tensor parallelism).
+
+Beyond-parity: the reference has exactly one strategy — synchronous data
+parallelism (SURVEY.md §2, "Parallelism strategies"). This module adds
+mesh-axis param partitioning so big layers can shard over the 'model' axis;
+XLA then inserts the all-gathers/reduce-scatters (scaling-book recipe: pick
+a mesh, annotate shardings, let the compiler place collectives).
+
+Rules: a param leaf path is matched against layer-type heuristics —
+  Linear weight (in, out)        -> P(None, 'model')   (column parallel)
+  Conv kernel HWIO               -> P(None, None, None, 'model')
+  Embedding table (vocab, dim)   -> P('model', None)   (row/vocab parallel)
+  biases / norms / scalars       -> replicated
+Large-dim thresholds keep small layers replicated (sharding a 64-wide layer
+wastes ICI latency for no HBM win).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+class ShardingRules:
+    def __init__(self, min_shard_dim: int = 256, shard_embeddings: bool = True):
+        self.min_shard_dim = min_shard_dim
+        self.shard_embeddings = shard_embeddings
+
+    def spec_for(self, path: Tuple[str, ...], shape: Tuple[int, ...],
+                 model_axis_size: int) -> P:
+        if model_axis_size <= 1:
+            return P()
+        leaf = path[-1] if path else ""
+        nd = len(shape)
+        if leaf in ("bias", "mean", "var", "b_rz", "b_n") or nd <= 1:
+            return P()
+        def ok(dim):
+            return shape[dim] >= self.min_shard_dim and shape[dim] % model_axis_size == 0
+        lower = [p.lower() for p in path]
+        is_embed = any("lookup" in p or "embed" in p for p in lower)
+        if nd == 2:
+            if is_embed and self.shard_embeddings and ok(0):
+                return P("model", None)
+            if ok(1):
+                return P(None, "model")  # column-parallel linear
+            return P()
+        if nd == 4 and ok(3):  # HWIO conv kernel: shard output channels
+            return P(None, None, None, "model")
+        if nd == 3 and ok(2):
+            return P(None, None, "model")
+        return P()
+
+
+def infer_param_specs(params: Dict, mesh: Mesh,
+                      rules: Optional[ShardingRules] = None):
+    """Pytree of PartitionSpec matching `params`."""
+    rules = rules or ShardingRules()
+    model_size = dict(zip(mesh.axis_names, mesh.devices.shape)).get("model", 1)
+
+    flat, treedef = jax.tree_util.tree_flatten_with_path(params)
+    specs = []
+    for path, leaf in flat:
+        strs = []
+        for p in path:
+            strs.append(str(getattr(p, "key", getattr(p, "idx", p))))
+        specs.append(rules.spec_for(tuple(strs), leaf.shape, model_size))
+    return jax.tree_util.tree_unflatten(treedef, specs)
+
+
+def shard_params(params, mesh: Mesh, rules: Optional[ShardingRules] = None):
+    """Place params on the mesh per the inferred specs."""
+    specs = infer_param_specs(params, mesh, rules)
+    return jax.tree_util.tree_map(
+        lambda leaf, spec: jax.device_put(leaf, NamedSharding(mesh, spec)),
+        params, specs), specs

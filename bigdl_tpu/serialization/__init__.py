@@ -1,0 +1,3 @@
+from bigdl_tpu.serialization.checkpoint import (load_checkpoint,
+                                                save_checkpoint,
+                                                latest_checkpoint)

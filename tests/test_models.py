@@ -1,0 +1,121 @@
+"""Model zoo tests (reference DL/models parity: shapes + one train step)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import bigdl_tpu.nn as nn
+from bigdl_tpu.models import (Autoencoder, Inception_v1, LeNet5, PTBModel,
+                              ResNet, ResNet50, SimpleRNN, Vgg_16,
+                              VggForCifar10, WideAndDeep, lenet_graph)
+from bigdl_tpu.nn.module import functional_apply, param_count
+from bigdl_tpu.utils.table import T
+
+KEY = jax.random.PRNGKey(0)
+
+
+class TestShapes:
+    def test_lenet(self):
+        m = LeNet5(10)
+        y = m.forward(jnp.ones((2, 28, 28)))
+        assert y.shape == (2, 10)
+        g = lenet_graph(10)
+        assert g.forward(jnp.ones((2, 28, 28))).shape == (2, 10)
+
+    def test_resnet50_imagenet(self):
+        m = ResNet50(1000)
+        p = m.init(KEY)
+        n = param_count(p)
+        # torchvision resnet50: 25.557M params
+        assert abs(n - 25_557_032) / 25_557_032 < 0.01, n
+        y, _ = functional_apply(m, p, jnp.ones((1, 224, 224, 3)),
+                                state=m.state_init())
+        assert y.shape == (1, 1000)
+
+    def test_resnet_cifar(self):
+        m = ResNet(10, depth=20, data_set="cifar10")
+        y = m.forward(jnp.ones((2, 32, 32, 3)))
+        assert y.shape == (2, 10)
+
+    def test_inception_v1(self):
+        m = Inception_v1(1000)
+        p = m.init(KEY)
+        n = param_count(p)
+        # GoogLeNet no-aux ~ 6.6M params (caffe bvlc_googlenet: 6,998,552
+        # incl aux heads; no-aux ~5.98M + fc 1.025M)
+        assert 5_000_000 < n < 8_000_000, n
+        y = m.forward(jnp.ones((1, 224, 224, 3)), training=False)
+        assert y.shape == (1, 1000)
+
+    def test_vgg16(self):
+        m = Vgg_16(1000)
+        n = param_count(m.init(KEY))
+        assert abs(n - 138_357_544) / 138_357_544 < 0.01, n  # torchvision vgg16
+
+    def test_vgg_cifar(self):
+        m = VggForCifar10(10)
+        y = m.forward(jnp.ones((2, 32, 32, 3)), training=False)
+        assert y.shape == (2, 10)
+
+    def test_ptb_model(self):
+        m = PTBModel(input_size=100, hidden_size=32, output_size=100)
+        x = jnp.ones((2, 7), jnp.int32)
+        y = m.forward(x)
+        assert y.shape == (2, 7, 100)
+
+    def test_simple_rnn(self):
+        m = SimpleRNN(4, 16, 4)
+        assert m.forward(jnp.ones((2, 5, 4))).shape == (2, 5, 4)
+
+    def test_autoencoder(self):
+        m = Autoencoder(32)
+        assert m.forward(jnp.ones((2, 28, 28))).shape == (2, 784)
+
+    def test_wide_and_deep(self):
+        m = WideAndDeep(2, wide_dim=100, embed_vocabs=(10, 10), embed_dim=4,
+                        cont_dim=3)
+        inp = T(jnp.array([[0, 5, -1]]), jnp.array([[1.0, 1.0, 0.0]]),
+                jnp.array([[1, 2]]), jnp.ones((1, 3)))
+        y = m.forward(inp)
+        assert y.shape == (1, 2)
+
+
+class TestTrainStep:
+    @pytest.mark.parametrize("build,x_shape,classes", [
+        (lambda: ResNet(4, depth=18), (4, 32, 32, 3), 4),
+        (lambda: Inception_v1(4), (2, 224, 224, 3), 4),
+    ], ids=["resnet18", "inception"])
+    def test_one_train_step(self, build, x_shape, classes):
+        m = build()
+        crit = nn.ClassNLLCriterion()
+        params = m.init(KEY)
+        state = m.state_init()
+        x = jnp.asarray(np.random.RandomState(0).rand(*x_shape), jnp.float32)
+        y = jnp.asarray(np.random.RandomState(1).randint(1, classes + 1,
+                                                         x_shape[0]))
+
+        def loss_fn(p):
+            out, new_s = functional_apply(m, p, x, state=state, training=True,
+                                          rng=KEY)
+            return crit(out, y), new_s
+
+        (loss, _), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+        assert np.isfinite(float(loss))
+        gnorm = sum(float(jnp.sum(jnp.abs(g))) for g in
+                    jax.tree_util.tree_leaves(grads))
+        assert gnorm > 0
+
+    def test_ptb_lstm_train_step(self):
+        m = PTBModel(input_size=50, hidden_size=16, output_size=50)
+        crit = nn.TimeDistributedCriterion(nn.ClassNLLCriterion())
+        params = m.init(KEY)
+        x = jnp.asarray(np.random.RandomState(0).randint(1, 51, (4, 9)))
+        y = jnp.asarray(np.random.RandomState(1).randint(1, 51, (4, 9)))
+
+        def loss_fn(p):
+            out, _ = functional_apply(m, p, x)
+            return crit(out, y)
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        assert np.isfinite(float(loss))
