@@ -1,0 +1,42 @@
+"""bigdl_tpu.keras — Keras-1.2.2-style API (reference DL/nn/keras, 71 files).
+
+Sequential/Model with compile/fit/evaluate/predict over the TPU-native layer
+library; shapes are inferred at `add()` time (InferShape parity) so the whole
+model jit-compiles as a single XLA computation.
+"""
+
+from bigdl_tpu.keras.topology import (CategoricalCrossEntropy, Input, KTensor,
+                                      KerasLayer, KerasModel, Model,
+                                      Sequential, activation_module,
+                                      input_tensor, resolve_loss,
+                                      resolve_metric, resolve_optim_method)
+from bigdl_tpu.keras.layers import (Activation, BatchNormalization, Dense,
+                                    Dropout, ELU, Embedding, Flatten,
+                                    GaussianDropout, GaussianNoise, Highway,
+                                    LeakyReLU, Masking, MaxoutDense, Merge,
+                                    Permute, RepeatVector, Reshape, SReLU,
+                                    SoftMax, SpatialDropout1D, SpatialDropout2D,
+                                    SpatialDropout3D, ThresholdedReLU,
+                                    TimeDistributed, merge)
+from bigdl_tpu.keras.convolutional import (AtrousConvolution1D,
+                                           AtrousConvolution2D,
+                                           AveragePooling1D, AveragePooling2D,
+                                           AveragePooling3D, Convolution1D,
+                                           Convolution2D, Convolution3D,
+                                           Cropping1D, Cropping2D, Cropping3D,
+                                           Deconvolution2D,
+                                           GlobalAveragePooling1D,
+                                           GlobalAveragePooling2D,
+                                           GlobalAveragePooling3D,
+                                           GlobalMaxPooling1D,
+                                           GlobalMaxPooling2D,
+                                           GlobalMaxPooling3D,
+                                           LocallyConnected1D,
+                                           LocallyConnected2D, MaxPooling1D,
+                                           MaxPooling2D, MaxPooling3D,
+                                           SeparableConvolution2D,
+                                           UpSampling1D, UpSampling2D,
+                                           UpSampling3D, ZeroPadding1D,
+                                           ZeroPadding2D, ZeroPadding3D)
+from bigdl_tpu.keras.recurrent import (Bidirectional, ConvLSTM2D, GRU, LSTM,
+                                       SimpleRNN)

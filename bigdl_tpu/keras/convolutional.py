@@ -1,0 +1,546 @@
+"""Keras convolution/pooling layers (DL/nn/keras/*.scala, channel-last).
+
+Shape math follows Keras 1.2.2 `border_mode` in {'valid','same'}; all labors
+are the nn conv/pool modules in NHWC (TPU-natural layout).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Sequence, Tuple
+
+import jax.numpy as jnp
+
+import bigdl_tpu.nn as nn
+from bigdl_tpu.keras.topology import KerasLayer, activation_module
+from bigdl_tpu.keras.layers import _with_activation
+
+
+def _conv_len(x: int, k: int, s: int, border: str, dilation: int = 1) -> int:
+    ke = (k - 1) * dilation + 1
+    if border == "same":
+        return (x + s - 1) // s
+    return (x - ke) // s + 1
+
+
+def _check_border(border_mode):
+    if border_mode not in ("valid", "same"):
+        raise ValueError(f"border_mode must be valid|same, got {border_mode}")
+
+
+class Convolution2D(KerasLayer):
+    """(DL/nn/keras/Convolution2D.scala) input (H, W, C)."""
+
+    def __init__(self, nb_filter: int, nb_row: int, nb_col: int,
+                 activation=None, border_mode: str = "valid",
+                 subsample: Tuple[int, int] = (1, 1), bias: bool = True,
+                 input_shape=None, name=None):
+        super().__init__(input_shape, name)
+        _check_border(border_mode)
+        self.nb_filter, self.nb_row, self.nb_col = nb_filter, nb_row, nb_col
+        self.activation, self.border = activation, border_mode
+        self.subsample, self.bias = subsample, bias
+
+    def _build_labor(self, input_shape):
+        h, w, c = input_shape
+        pad = -1 if self.border == "same" else 0  # -1 = SAME (TF style)
+        conv = nn.SpatialConvolution(
+            int(c), self.nb_filter, self.nb_col, self.nb_row,
+            self.subsample[1], self.subsample[0], pad, pad,
+            with_bias=self.bias)
+        return _with_activation(conv, self.activation)
+
+    def compute_output_shape(self, input_shape):
+        h, w, c = input_shape
+        return (_conv_len(int(h), self.nb_row, self.subsample[0], self.border),
+                _conv_len(int(w), self.nb_col, self.subsample[1], self.border),
+                self.nb_filter)
+
+
+class Convolution1D(KerasLayer):
+    """(DL/nn/keras/Convolution1D.scala) input (steps, dim)."""
+
+    def __init__(self, nb_filter: int, filter_length: int, activation=None,
+                 border_mode: str = "valid", subsample_length: int = 1,
+                 bias: bool = True, input_shape=None, name=None):
+        super().__init__(input_shape, name)
+        _check_border(border_mode)
+        self.nb_filter, self.k = nb_filter, filter_length
+        self.activation, self.border = activation, border_mode
+        self.stride, self.bias = subsample_length, bias
+
+    def _build_labor(self, input_shape):
+        steps, dim = input_shape
+        conv = nn.TemporalConvolution(int(dim), self.nb_filter, self.k,
+                                      self.stride,
+                                      pad=(-1 if self.border == "same" else 0),
+                                      with_bias=self.bias)
+        return _with_activation(conv, self.activation)
+
+    def compute_output_shape(self, input_shape):
+        steps, dim = input_shape
+        return (_conv_len(int(steps), self.k, self.stride, self.border),
+                self.nb_filter)
+
+
+class Convolution3D(KerasLayer):
+    """input (D, H, W, C) — labor is VolumetricConvolution (NDHWC)."""
+
+    def __init__(self, nb_filter: int, kernel_dim1: int, kernel_dim2: int,
+                 kernel_dim3: int, activation=None, border_mode: str = "valid",
+                 subsample: Tuple[int, int, int] = (1, 1, 1), bias: bool = True,
+                 input_shape=None, name=None):
+        super().__init__(input_shape, name)
+        _check_border(border_mode)
+        self.nb_filter = nb_filter
+        self.kd = (kernel_dim1, kernel_dim2, kernel_dim3)
+        self.activation, self.border = activation, border_mode
+        self.subsample, self.bias = subsample, bias
+
+    def _build_labor(self, input_shape):
+        d, h, w, c = input_shape
+        kt, kh, kw = self.kd
+        st, sh, sw = self.subsample
+        p = -1 if self.border == "same" else 0
+        conv = nn.VolumetricConvolution(
+            int(c), self.nb_filter, kt, kw, kh, st, sw, sh,
+            pad_t=p, pad_w=p, pad_h=p, with_bias=self.bias)
+        return _with_activation(conv, self.activation)
+
+    def compute_output_shape(self, input_shape):
+        d, h, w, c = input_shape
+        kt, kh, kw = self.kd
+        st, sh, sw = self.subsample
+        return (_conv_len(int(d), kt, st, self.border),
+                _conv_len(int(h), kh, sh, self.border),
+                _conv_len(int(w), kw, sw, self.border),
+                self.nb_filter)
+
+
+class AtrousConvolution2D(Convolution2D):
+    """(DL/nn/keras/AtrousConvolution2D.scala) dilated conv, border valid."""
+
+    def __init__(self, nb_filter, nb_row, nb_col, activation=None,
+                 subsample=(1, 1), atrous_rate=(1, 1), bias: bool = True,
+                 input_shape=None, name=None):
+        super().__init__(nb_filter, nb_row, nb_col, activation=activation,
+                         border_mode="valid", subsample=subsample, bias=bias,
+                         input_shape=input_shape, name=name)
+        self.atrous_rate = atrous_rate
+
+    def _build_labor(self, input_shape):
+        h, w, c = input_shape
+        conv = nn.SpatialDilatedConvolution(
+            int(c), self.nb_filter, self.nb_col, self.nb_row,
+            self.subsample[1], self.subsample[0], 0, 0,
+            dilation_w=self.atrous_rate[1], dilation_h=self.atrous_rate[0],
+            with_bias=self.bias)
+        return _with_activation(conv, self.activation)
+
+    def compute_output_shape(self, input_shape):
+        h, w, c = input_shape
+        return (_conv_len(int(h), self.nb_row, self.subsample[0], "valid",
+                          self.atrous_rate[0]),
+                _conv_len(int(w), self.nb_col, self.subsample[1], "valid",
+                          self.atrous_rate[1]),
+                self.nb_filter)
+
+
+class AtrousConvolution1D(Convolution1D):
+    def __init__(self, nb_filter, filter_length, activation=None,
+                 subsample_length: int = 1, atrous_rate: int = 1,
+                 bias: bool = True, input_shape=None, name=None):
+        super().__init__(nb_filter, filter_length, activation=activation,
+                         border_mode="valid",
+                         subsample_length=subsample_length, bias=bias,
+                         input_shape=input_shape, name=name)
+        self.atrous_rate = atrous_rate
+
+    def _build_labor(self, input_shape):
+        steps, dim = input_shape
+        conv = nn.TemporalConvolution(int(dim), self.nb_filter, self.k,
+                                      self.stride, dilation=self.atrous_rate,
+                                      with_bias=self.bias)
+        return _with_activation(conv, self.activation)
+
+    def compute_output_shape(self, input_shape):
+        steps, dim = input_shape
+        return (_conv_len(int(steps), self.k, self.stride, "valid",
+                          self.atrous_rate), self.nb_filter)
+
+
+class Deconvolution2D(KerasLayer):
+    """(DL/nn/keras/Deconvolution2D.scala) transpose conv."""
+
+    def __init__(self, nb_filter: int, nb_row: int, nb_col: int,
+                 activation=None, subsample=(1, 1), bias: bool = True,
+                 input_shape=None, name=None):
+        super().__init__(input_shape, name)
+        self.nb_filter, self.nb_row, self.nb_col = nb_filter, nb_row, nb_col
+        self.activation, self.subsample, self.bias = activation, subsample, bias
+
+    def _build_labor(self, input_shape):
+        h, w, c = input_shape
+        conv = nn.SpatialFullConvolution(
+            int(c), self.nb_filter, self.nb_col, self.nb_row,
+            self.subsample[1], self.subsample[0], with_bias=self.bias)
+        return _with_activation(conv, self.activation)
+
+    def compute_output_shape(self, input_shape):
+        h, w, c = input_shape
+        return ((int(h) - 1) * self.subsample[0] + self.nb_row,
+                (int(w) - 1) * self.subsample[1] + self.nb_col,
+                self.nb_filter)
+
+
+class SeparableConvolution2D(KerasLayer):
+    def __init__(self, nb_filter: int, nb_row: int, nb_col: int,
+                 activation=None, border_mode: str = "valid",
+                 subsample=(1, 1), depth_multiplier: int = 1,
+                 bias: bool = True, input_shape=None, name=None):
+        super().__init__(input_shape, name)
+        _check_border(border_mode)
+        self.nb_filter, self.nb_row, self.nb_col = nb_filter, nb_row, nb_col
+        self.activation, self.border = activation, border_mode
+        self.subsample, self.mult, self.bias = subsample, depth_multiplier, bias
+
+    def _build_labor(self, input_shape):
+        h, w, c = input_shape
+        pad = -1 if self.border == "same" else 0
+        conv = nn.SpatialSeparableConvolution(
+            int(c), self.nb_filter, self.mult, self.nb_col, self.nb_row,
+            self.subsample[1], self.subsample[0], pad, pad,
+            with_bias=self.bias)
+        return _with_activation(conv, self.activation)
+
+    def compute_output_shape(self, input_shape):
+        h, w, c = input_shape
+        return (_conv_len(int(h), self.nb_row, self.subsample[0], self.border),
+                _conv_len(int(w), self.nb_col, self.subsample[1], self.border),
+                self.nb_filter)
+
+
+class LocallyConnected2D(KerasLayer):
+    def __init__(self, nb_filter: int, nb_row: int, nb_col: int,
+                 activation=None, subsample=(1, 1), bias: bool = True,
+                 input_shape=None, name=None):
+        super().__init__(input_shape, name)
+        self.nb_filter, self.nb_row, self.nb_col = nb_filter, nb_row, nb_col
+        self.activation, self.subsample, self.bias = activation, subsample, bias
+
+    def _build_labor(self, input_shape):
+        h, w, c = input_shape
+        conv = nn.LocallyConnected2D(
+            int(c), int(w), int(h), self.nb_filter, self.nb_col, self.nb_row,
+            self.subsample[1], self.subsample[0], with_bias=self.bias)
+        return _with_activation(conv, self.activation)
+
+    def compute_output_shape(self, input_shape):
+        h, w, c = input_shape
+        return (_conv_len(int(h), self.nb_row, self.subsample[0], "valid"),
+                _conv_len(int(w), self.nb_col, self.subsample[1], "valid"),
+                self.nb_filter)
+
+
+class LocallyConnected1D(KerasLayer):
+    def __init__(self, nb_filter: int, filter_length: int, activation=None,
+                 subsample_length: int = 1, bias: bool = True,
+                 input_shape=None, name=None):
+        super().__init__(input_shape, name)
+        self.nb_filter, self.k = nb_filter, filter_length
+        self.activation, self.stride, self.bias = activation, subsample_length, bias
+
+    def _build_labor(self, input_shape):
+        steps, dim = input_shape
+        # treat the sequence as a H=steps, W=1 image
+        inner = nn.LocallyConnected2D(
+            int(dim), 1, int(steps), self.nb_filter, 1, self.k,
+            1, self.stride, with_bias=self.bias)
+        seq = (nn.Sequential()
+               .add(nn.Unsqueeze(2))          # (B, steps, 1, dim)
+               .add(inner)
+               .add(nn.Squeeze(2)))
+        return _with_activation(seq, self.activation)
+
+    def compute_output_shape(self, input_shape):
+        steps, dim = input_shape
+        return (_conv_len(int(steps), self.k, self.stride, "valid"),
+                self.nb_filter)
+
+
+# --------------------------------------------------------------------------- #
+# pooling
+# --------------------------------------------------------------------------- #
+
+class _Pool2D(KerasLayer):
+    def __init__(self, pool_size=(2, 2), strides=None, border_mode="valid",
+                 input_shape=None, name=None):
+        super().__init__(input_shape, name)
+        _check_border(border_mode)
+        self.pool_size = pool_size
+        self.strides = strides or pool_size
+        self.border = border_mode
+
+    def compute_output_shape(self, input_shape):
+        h, w, c = input_shape
+        return (_conv_len(int(h), self.pool_size[0], self.strides[0], self.border),
+                _conv_len(int(w), self.pool_size[1], self.strides[1], self.border),
+                int(c))
+
+
+class MaxPooling2D(_Pool2D):
+    def _build_labor(self, input_shape):
+        pad = -1 if self.border == "same" else 0  # -1 = SAME
+        return nn.SpatialMaxPooling(self.pool_size[1], self.pool_size[0],
+                                    self.strides[1], self.strides[0],
+                                    pad_w=pad, pad_h=pad)
+
+
+class AveragePooling2D(_Pool2D):
+    def _build_labor(self, input_shape):
+        pad = -1 if self.border == "same" else 0
+        return nn.SpatialAveragePooling(self.pool_size[1], self.pool_size[0],
+                                        self.strides[1], self.strides[0],
+                                        pad_w=pad, pad_h=pad)
+
+
+class MaxPooling1D(KerasLayer):
+    def __init__(self, pool_length: int = 2, stride: Optional[int] = None,
+                 border_mode: str = "valid", input_shape=None, name=None):
+        super().__init__(input_shape, name)
+        _check_border(border_mode)
+        self.pool_length = pool_length
+        self.stride = stride or pool_length
+        self.border = border_mode
+
+    def _build_labor(self, input_shape):
+        return nn.TemporalMaxPooling(
+            self.pool_length, self.stride,
+            padding=("SAME" if self.border == "same" else "VALID"))
+
+    def compute_output_shape(self, input_shape):
+        steps, dim = input_shape
+        return (_conv_len(int(steps), self.pool_length, self.stride,
+                          self.border), int(dim))
+
+
+class AveragePooling1D(MaxPooling1D):
+    def _build_labor(self, input_shape):
+        # sequence as H=steps, W=1 image
+        pad = -1 if self.border == "same" else 0
+        return (nn.Sequential()
+                .add(nn.Unsqueeze(2))
+                .add(nn.SpatialAveragePooling(1, self.pool_length,
+                                              1, self.stride,
+                                              pad_w=pad, pad_h=pad))
+                .add(nn.Squeeze(2)))
+
+
+class _Pool3D(KerasLayer):
+    def __init__(self, pool_size=(2, 2, 2), strides=None,
+                 input_shape=None, name=None):
+        super().__init__(input_shape, name)
+        self.pool_size = pool_size
+        self.strides = strides or pool_size
+
+    def compute_output_shape(self, input_shape):
+        d, h, w, c = input_shape
+        pt, ph, pw = self.pool_size
+        st, sh, sw = self.strides
+        return ((int(d) - pt) // st + 1, (int(h) - ph) // sh + 1,
+                (int(w) - pw) // sw + 1, int(c))
+
+
+class MaxPooling3D(_Pool3D):
+    def _build_labor(self, input_shape):
+        pt, ph, pw = self.pool_size
+        st, sh, sw = self.strides
+        return nn.VolumetricMaxPooling(pt, pw, ph, st, sw, sh)
+
+
+class AveragePooling3D(_Pool3D):
+    def _build_labor(self, input_shape):
+        pt, ph, pw = self.pool_size
+        st, sh, sw = self.strides
+        return nn.VolumetricAveragePooling(pt, pw, ph, st, sw, sh)
+
+
+class _GlobalPool(KerasLayer):
+    reduce = "max"
+
+    def _build_labor(self, input_shape):
+        axes = tuple(range(0, len(input_shape) - 1))  # all but channel (no batch)
+        seq = nn.Sequential()
+        for ax in sorted(axes, reverse=True):  # highest first: indices stay valid
+            if self.reduce == "max":
+                seq.add(nn.Max(dim=ax + 1))
+            else:
+                seq.add(nn.Mean(dimension=ax + 1))
+        return seq
+
+    def compute_output_shape(self, input_shape):
+        return (int(input_shape[-1]),)
+
+
+class GlobalMaxPooling1D(_GlobalPool):
+    reduce = "max"
+
+
+class GlobalAveragePooling1D(_GlobalPool):
+    reduce = "mean"
+
+
+class GlobalMaxPooling2D(_GlobalPool):
+    reduce = "max"
+
+
+class GlobalAveragePooling2D(_GlobalPool):
+    reduce = "mean"
+
+
+class GlobalMaxPooling3D(_GlobalPool):
+    reduce = "max"
+
+
+class GlobalAveragePooling3D(_GlobalPool):
+    reduce = "mean"
+
+
+# --------------------------------------------------------------------------- #
+# resize / pad / crop
+# --------------------------------------------------------------------------- #
+
+class UpSampling1D(KerasLayer):
+    def __init__(self, length: int = 2, input_shape=None, name=None):
+        super().__init__(input_shape, name)
+        self.length = length
+
+    def _build_labor(self, input_shape):
+        return nn.UpSampling1D(self.length)
+
+    def compute_output_shape(self, input_shape):
+        steps, dim = input_shape
+        return (int(steps) * self.length, int(dim))
+
+
+class UpSampling2D(KerasLayer):
+    def __init__(self, size=(2, 2), input_shape=None, name=None):
+        super().__init__(input_shape, name)
+        self.size = size
+
+    def _build_labor(self, input_shape):
+        return nn.UpSampling2D(self.size)
+
+    def compute_output_shape(self, input_shape):
+        h, w, c = input_shape
+        return (int(h) * self.size[0], int(w) * self.size[1], int(c))
+
+
+class UpSampling3D(KerasLayer):
+    def __init__(self, size=(2, 2, 2), input_shape=None, name=None):
+        super().__init__(input_shape, name)
+        self.size = size
+
+    def _build_labor(self, input_shape):
+        return nn.UpSampling3D(self.size)
+
+    def compute_output_shape(self, input_shape):
+        d, h, w, c = input_shape
+        return (int(d) * self.size[0], int(h) * self.size[1],
+                int(w) * self.size[2], int(c))
+
+
+class ZeroPadding2D(KerasLayer):
+    def __init__(self, padding=(1, 1), input_shape=None, name=None):
+        super().__init__(input_shape, name)
+        self.padding = padding
+
+    def _build_labor(self, input_shape):
+        ph, pw = self.padding
+        return nn.SpatialZeroPadding(pw, pw, ph, ph)
+
+    def compute_output_shape(self, input_shape):
+        h, w, c = input_shape
+        return (int(h) + 2 * self.padding[0], int(w) + 2 * self.padding[1],
+                int(c))
+
+
+class ZeroPadding1D(KerasLayer):
+    def __init__(self, padding: int = 1, input_shape=None, name=None):
+        super().__init__(input_shape, name)
+        self.padding = padding
+
+    def _build_labor(self, input_shape):
+        p = self.padding
+        return (nn.Sequential()
+                .add(nn.Unsqueeze(2))
+                .add(nn.SpatialZeroPadding(0, 0, p, p))
+                .add(nn.Squeeze(2)))
+
+    def compute_output_shape(self, input_shape):
+        steps, dim = input_shape
+        return (int(steps) + 2 * self.padding, int(dim))
+
+
+class ZeroPadding3D(KerasLayer):
+    def __init__(self, padding=(1, 1, 1), input_shape=None, name=None):
+        super().__init__(input_shape, name)
+        self.padding = padding
+
+    def _build_labor(self, input_shape):
+        pd, ph, pw = self.padding
+
+        class _Pad3D(nn.Module):
+            def apply(self, params, x, ctx):
+                return jnp.pad(x, ((0, 0), (pd, pd), (ph, ph), (pw, pw), (0, 0)))
+        return _Pad3D()
+
+    def compute_output_shape(self, input_shape):
+        d, h, w, c = input_shape
+        pd, ph, pw = self.padding
+        return (int(d) + 2 * pd, int(h) + 2 * ph, int(w) + 2 * pw, int(c))
+
+
+class Cropping2D(KerasLayer):
+    def __init__(self, cropping=((0, 0), (0, 0)), input_shape=None, name=None):
+        super().__init__(input_shape, name)
+        self.cropping = cropping
+
+    def _build_labor(self, input_shape):
+        return nn.Cropping2D(self.cropping[0], self.cropping[1])
+
+    def compute_output_shape(self, input_shape):
+        h, w, c = input_shape
+        (t, b), (l, r) = self.cropping
+        return (int(h) - t - b, int(w) - l - r, int(c))
+
+
+class Cropping1D(KerasLayer):
+    def __init__(self, cropping=(1, 1), input_shape=None, name=None):
+        super().__init__(input_shape, name)
+        self.cropping = cropping
+
+    def _build_labor(self, input_shape):
+        a, b = self.cropping
+        steps = int(input_shape[0])
+        return nn.Narrow(1, a, steps - a - b)
+
+    def compute_output_shape(self, input_shape):
+        steps, dim = input_shape
+        return (int(steps) - self.cropping[0] - self.cropping[1], int(dim))
+
+
+class Cropping3D(KerasLayer):
+    def __init__(self, cropping=((1, 1), (1, 1), (1, 1)), input_shape=None,
+                 name=None):
+        super().__init__(input_shape, name)
+        self.cropping = cropping
+
+    def _build_labor(self, input_shape):
+        return nn.Cropping3D(self.cropping[0], self.cropping[1],
+                             self.cropping[2])
+
+    def compute_output_shape(self, input_shape):
+        d, h, w, c = input_shape
+        (d0, d1), (h0, h1), (w0, w1) = self.cropping
+        return (int(d) - d0 - d1, int(h) - h0 - h1, int(w) - w0 - w1, int(c))

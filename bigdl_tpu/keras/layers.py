@@ -1,0 +1,375 @@
+"""Keras core layers (reference DL/nn/keras/*.scala, Keras-1.2.2 semantics).
+
+Each layer is a thin shape-aware wrapper building an nn "labor" module
+(KerasLayer.scala pattern). Shapes exclude batch; channel-last layouts.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Sequence, Tuple, Union
+
+import jax.numpy as jnp
+
+import bigdl_tpu.nn as nn
+from bigdl_tpu.keras.topology import KerasLayer, Shape, activation_module
+
+
+def _with_activation(labor, activation):
+    act = activation_module(activation)
+    if act is None:
+        return labor
+    return nn.Sequential().add(labor).add(act)
+
+
+def _activation_fn(activation):
+    """Resolve an activation to a plain jnp function (for layers whose math
+    embeds the activation, e.g. Highway gates)."""
+    from bigdl_tpu.nn.module import ApplyContext, Module
+    if callable(activation) and not isinstance(activation, (str, Module)):
+        return activation
+    mod = activation_module(activation)
+    if mod is None:
+        return lambda x: x
+    if hasattr(mod, "fn"):
+        return mod.fn
+    return lambda x: mod.apply({}, x, ApplyContext())
+
+
+class Dense(KerasLayer):
+    """(DL/nn/keras/Dense.scala) Fully connected over the last dim."""
+
+    def __init__(self, output_dim: int, activation=None, bias: bool = True,
+                 W_regularizer=None, b_regularizer=None,
+                 input_shape=None, input_dim: Optional[int] = None, name=None):
+        if input_dim is not None:
+            input_shape = (input_dim,)
+        super().__init__(input_shape, name)
+        self.output_dim = output_dim
+        self.activation = activation
+        self.bias = bias
+
+    def _build_labor(self, input_shape):
+        lin = nn.Linear(int(input_shape[-1]), self.output_dim,
+                        with_bias=self.bias)
+        if len(input_shape) > 1:
+            lin = nn.Bottle(lin, 2)
+        return _with_activation(lin, self.activation)
+
+    def compute_output_shape(self, input_shape):
+        return tuple(input_shape[:-1]) + (self.output_dim,)
+
+
+class Activation(KerasLayer):
+    def __init__(self, activation, input_shape=None, name=None):
+        super().__init__(input_shape, name)
+        self.activation = activation
+
+    def _build_labor(self, input_shape):
+        return activation_module(self.activation) or nn.Identity()
+
+
+class Dropout(KerasLayer):
+    def __init__(self, p: float, input_shape=None, name=None):
+        super().__init__(input_shape, name)
+        self.p = p
+
+    def _build_labor(self, input_shape):
+        return nn.Dropout(self.p)
+
+
+class GaussianDropout(KerasLayer):
+    def __init__(self, p: float, input_shape=None, name=None):
+        super().__init__(input_shape, name)
+        self.p = p
+
+    def _build_labor(self, input_shape):
+        return nn.GaussianDropout(self.p)
+
+
+class GaussianNoise(KerasLayer):
+    def __init__(self, sigma: float, input_shape=None, name=None):
+        super().__init__(input_shape, name)
+        self.sigma = sigma
+
+    def _build_labor(self, input_shape):
+        return nn.GaussianNoise(self.sigma)
+
+
+class SpatialDropout1D(KerasLayer):
+    def __init__(self, p: float = 0.5, input_shape=None, name=None):
+        super().__init__(input_shape, name)
+        self.p = p
+
+    def _build_labor(self, input_shape):
+        return nn.SpatialDropout1D(self.p)
+
+
+class SpatialDropout2D(KerasLayer):
+    def __init__(self, p: float = 0.5, input_shape=None, name=None):
+        super().__init__(input_shape, name)
+        self.p = p
+
+    def _build_labor(self, input_shape):
+        return nn.SpatialDropout2D(self.p)
+
+
+class SpatialDropout3D(KerasLayer):
+    def __init__(self, p: float = 0.5, input_shape=None, name=None):
+        super().__init__(input_shape, name)
+        self.p = p
+
+    def _build_labor(self, input_shape):
+        return nn.SpatialDropout3D(self.p)
+
+
+class Flatten(KerasLayer):
+    def _build_labor(self, input_shape):
+        n = int(np_prod(input_shape))
+        return nn.Reshape((n,))
+
+    def compute_output_shape(self, input_shape):
+        return (int(np_prod(input_shape)),)
+
+
+def np_prod(shape) -> int:
+    out = 1
+    for s in shape:
+        out *= int(s)
+    return out
+
+
+class Reshape(KerasLayer):
+    def __init__(self, target_shape: Shape, input_shape=None, name=None):
+        super().__init__(input_shape, name)
+        self.target_shape = tuple(target_shape)
+
+    def _resolved(self, input_shape):
+        tgt = list(self.target_shape)
+        if -1 in tgt:
+            i = tgt.index(-1)
+            known = np_prod([t for t in tgt if t != -1])
+            tgt[i] = np_prod(input_shape) // known
+        return tuple(tgt)
+
+    def _build_labor(self, input_shape):
+        return nn.Reshape(self._resolved(input_shape))
+
+    def compute_output_shape(self, input_shape):
+        return self._resolved(input_shape)
+
+
+class Permute(KerasLayer):
+    """dims are 1-based over the non-batch axes (Keras semantics)."""
+
+    def __init__(self, dims: Sequence[int], input_shape=None, name=None):
+        super().__init__(input_shape, name)
+        self.dims = tuple(dims)
+
+    def _build_labor(self, input_shape):
+        perm = (0,) + tuple(d for d in self.dims)  # batch + 1-based = 0-based+1
+        return nn.Permute(perm)
+
+    def compute_output_shape(self, input_shape):
+        return tuple(input_shape[d - 1] for d in self.dims)
+
+
+class RepeatVector(KerasLayer):
+    def __init__(self, n: int, input_shape=None, name=None):
+        super().__init__(input_shape, name)
+        self.n = n
+
+    def _build_labor(self, input_shape):
+        return nn.Replicate(self.n, dim=1)
+
+    def compute_output_shape(self, input_shape):
+        return (self.n,) + tuple(input_shape)
+
+
+class Masking(KerasLayer):
+    def __init__(self, mask_value: float = 0.0, input_shape=None, name=None):
+        super().__init__(input_shape, name)
+        self.mask_value = mask_value
+
+    def _build_labor(self, input_shape):
+        return nn.Masking(self.mask_value)
+
+
+class Embedding(KerasLayer):
+    """(DL/nn/keras/Embedding.scala) 0-based int indices -> dense vectors."""
+
+    def __init__(self, input_dim: int, output_dim: int, input_length=None,
+                 input_shape=None, name=None):
+        if input_length is not None and input_shape is None:
+            input_shape = (input_length,)
+        super().__init__(input_shape, name)
+        self.input_dim = input_dim
+        self.output_dim = output_dim
+
+    def _build_labor(self, input_shape):
+        return nn.LookupTable(self.input_dim, self.output_dim, one_based=False)
+
+    def compute_output_shape(self, input_shape):
+        return tuple(input_shape) + (self.output_dim,)
+
+
+class Highway(KerasLayer):
+    def __init__(self, activation="tanh", bias: bool = True,
+                 input_shape=None, name=None):
+        super().__init__(input_shape, name)
+        self.activation = activation
+        self.bias = bias
+
+    def _build_labor(self, input_shape):
+        return nn.Highway(int(input_shape[-1]), with_bias=self.bias,
+                          activation=_activation_fn(self.activation))
+
+
+class MaxoutDense(KerasLayer):
+    def __init__(self, output_dim: int, nb_feature: int = 4,
+                 input_shape=None, name=None):
+        super().__init__(input_shape, name)
+        self.output_dim = output_dim
+        self.nb_feature = nb_feature
+
+    def _build_labor(self, input_shape):
+        return nn.Maxout(int(input_shape[-1]), self.output_dim, self.nb_feature)
+
+    def compute_output_shape(self, input_shape):
+        return (self.output_dim,)
+
+
+class BatchNormalization(KerasLayer):
+    """(DL/nn/keras/BatchNormalization.scala). mode=0 per-feature; for 4-D
+    inputs normalizes the channel (last) axis."""
+
+    def __init__(self, epsilon: float = 1e-3, momentum: float = 0.99,
+                 input_shape=None, name=None):
+        super().__init__(input_shape, name)
+        self.epsilon = epsilon
+        self.momentum = momentum
+
+    def _build_labor(self, input_shape):
+        n = int(input_shape[-1])
+        # reference keras momentum is the decay of the running average;
+        # nn.BatchNormalization momentum is the update fraction.
+        m = 1.0 - self.momentum
+        if len(input_shape) == 3:
+            return nn.SpatialBatchNormalization(n, eps=self.epsilon, momentum=m)
+        return nn.BatchNormalization(n, eps=self.epsilon, momentum=m)
+
+
+class Merge(KerasLayer):
+    """(DL/nn/keras/Merge.scala) merge a list of inputs: sum/mul/max/ave/dot/
+    cosine/concat."""
+
+    def __init__(self, mode: str = "sum", concat_axis: int = -1,
+                 input_shape=None, name=None):
+        super().__init__(input_shape, name)
+        self.mode = mode
+        self.concat_axis = concat_axis
+
+    def _build_labor(self, input_shape):
+        m = self.mode
+        if m == "sum":
+            return nn.CAddTable()
+        if m == "mul":
+            return nn.CMulTable()
+        if m == "max":
+            return nn.CMaxTable()
+        if m == "ave":
+            return nn.CAveTable()
+        if m == "dot":
+            return nn.DotProduct()
+        if m == "cosine":
+            return nn.CosineDistance()
+        if m == "concat":
+            # concat_axis indexes the batch-INCLUSIVE shape (reference
+            # Merge.scala): 1 = first non-batch dim; negative counts from
+            # the end of the full-rank shape. Both pass straight through to
+            # jnp.concatenate on the full-rank arrays.
+            return nn.JoinTable(self.concat_axis)
+        raise ValueError(f"unknown merge mode '{m}'")
+
+    def compute_output_shape(self, input_shape):
+        shapes = input_shape
+        if not isinstance(shapes[0], (tuple, list)):
+            return tuple(shapes)
+        first = list(shapes[0])
+        if self.mode == "concat":
+            ax = self.concat_axis
+            # to batch-EXCLUSIVE index
+            ax = (ax - 1) if ax > 0 else len(first) + ax
+            if ax < 0 or ax >= len(first):
+                raise ValueError(
+                    f"concat_axis {self.concat_axis} out of range (batch "
+                    "concat is not supported)")
+            first[ax] = sum(int(s[ax]) for s in shapes)
+            return tuple(first)
+        if self.mode in ("dot", "cosine"):
+            return (1,)
+        return tuple(first)
+
+
+def merge(inputs, mode="sum", concat_axis=-1, name=None):
+    """Functional-API merge over KTensors."""
+    return Merge(mode=mode, concat_axis=concat_axis, name=name)(list(inputs))
+
+
+# ---------------------------------------------------------------------------
+# advanced activations (DL/nn/keras/{ELU,LeakyReLU,SReLU,ThresholdedReLU}.scala)
+# ---------------------------------------------------------------------------
+
+class ELU(KerasLayer):
+    def __init__(self, alpha: float = 1.0, input_shape=None, name=None):
+        super().__init__(input_shape, name)
+        self.alpha = alpha
+
+    def _build_labor(self, input_shape):
+        return nn.ELU(self.alpha)
+
+
+class LeakyReLU(KerasLayer):
+    def __init__(self, alpha: float = 0.3, input_shape=None, name=None):
+        super().__init__(input_shape, name)
+        self.alpha = alpha
+
+    def _build_labor(self, input_shape):
+        return nn.LeakyReLU(self.alpha)
+
+
+class SReLU(KerasLayer):
+    def __init__(self, input_shape=None, name=None):
+        super().__init__(input_shape, name)
+
+    def _build_labor(self, input_shape):
+        return nn.SReLU(tuple(int(s) for s in input_shape))
+
+
+class ThresholdedReLU(KerasLayer):
+    def __init__(self, theta: float = 1.0, input_shape=None, name=None):
+        super().__init__(input_shape, name)
+        self.theta = theta
+
+    def _build_labor(self, input_shape):
+        return nn.Threshold(self.theta, 0.0)
+
+
+class SoftMax(KerasLayer):
+    def _build_labor(self, input_shape):
+        return nn.SoftMax()
+
+
+class TimeDistributed(KerasLayer):
+    """Apply an inner keras layer to every timestep (dim 1)."""
+
+    def __init__(self, layer: KerasLayer, input_shape=None, name=None):
+        super().__init__(input_shape, name)
+        self.inner = layer
+
+    def _build_labor(self, input_shape):
+        self.inner.build(tuple(input_shape[1:]))
+        return nn.TimeDistributed(self.inner.labor)
+
+    def compute_output_shape(self, input_shape):
+        return (input_shape[0],) + tuple(self.inner.built_output_shape)

@@ -1,0 +1,116 @@
+"""Keras recurrent layers (DL/nn/keras/{SimpleRNN,LSTM,GRU,ConvLSTM2D,
+Bidirectional}.scala). Labors run lax.scan (nn.Recurrent)."""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax.numpy as jnp
+
+import bigdl_tpu.nn as nn
+from bigdl_tpu.keras.topology import KerasLayer
+
+
+class _KerasRecurrent(KerasLayer):
+    def __init__(self, output_dim: int, activation="tanh",
+                 return_sequences: bool = False, go_backwards: bool = False,
+                 input_shape=None, name=None):
+        super().__init__(input_shape, name)
+        self.output_dim = output_dim
+        self.activation = activation
+        self.return_sequences = return_sequences
+        self.go_backwards = go_backwards
+
+    def _make_cell(self, input_dim: int) -> nn.Cell:
+        raise NotImplementedError
+
+    def _build_labor(self, input_shape):
+        steps, dim = input_shape
+        cell = self._make_cell(int(dim))
+        return nn.Recurrent(cell, return_sequences=self.return_sequences,
+                            reverse=self.go_backwards)
+
+    def compute_output_shape(self, input_shape):
+        steps, dim = input_shape
+        if self.return_sequences:
+            return (steps, self.output_dim)
+        return (self.output_dim,)
+
+
+class SimpleRNN(_KerasRecurrent):
+    def _make_cell(self, input_dim):
+        import jax
+        act = jnp.tanh if self.activation == "tanh" else jax.nn.relu
+        return nn.RnnCell(input_dim, self.output_dim, activation=act)
+
+
+class LSTM(_KerasRecurrent):
+    def _make_cell(self, input_dim):
+        return nn.LSTMCell(input_dim, self.output_dim)
+
+
+class GRU(_KerasRecurrent):
+    def _make_cell(self, input_dim):
+        return nn.GRUCell(input_dim, self.output_dim)
+
+
+class ConvLSTM2D(KerasLayer):
+    """(DL/nn/keras/ConvLSTM2D.scala) input (T, H, W, C)."""
+
+    def __init__(self, nb_filter: int, nb_kernel: int = 3,
+                 return_sequences: bool = False, go_backwards: bool = False,
+                 input_shape=None, name=None):
+        super().__init__(input_shape, name)
+        self.nb_filter = nb_filter
+        self.nb_kernel = nb_kernel
+        self.return_sequences = return_sequences
+        self.go_backwards = go_backwards
+
+    def _build_labor(self, input_shape):
+        t, h, w, c = input_shape
+        cell = nn.ConvLSTMPeephole(int(c), self.nb_filter,
+                                   kernel_i=self.nb_kernel,
+                                   kernel_c=self.nb_kernel)
+        return nn.Recurrent(cell, return_sequences=self.return_sequences,
+                            reverse=self.go_backwards)
+
+    def compute_output_shape(self, input_shape):
+        t, h, w, c = input_shape
+        out = (int(h), int(w), self.nb_filter)
+        return (t,) + out if self.return_sequences else out
+
+
+class Bidirectional(KerasLayer):
+    """Wrap a keras recurrent layer fwd+bwd (DL/nn/keras/Bidirectional)."""
+
+    MERGES = ("concat", "sum", "mul", "ave")
+
+    def __init__(self, layer: _KerasRecurrent, merge_mode: str = "concat",
+                 input_shape=None, name=None):
+        super().__init__(input_shape, name)
+        if merge_mode not in self.MERGES:
+            raise ValueError(f"merge_mode must be one of {self.MERGES}, "
+                             f"got '{merge_mode}'")
+        self.inner = layer
+        self.merge_mode = merge_mode
+
+    def _build_labor(self, input_shape):
+        steps, dim = input_shape
+        fwd = self.inner._make_cell(int(dim))
+        bwd = self.inner._make_cell(int(dim))
+        if not self.inner.return_sequences:
+            # run both directions then merge last outputs
+            f = nn.Recurrent(fwd, return_sequences=False)
+            b = nn.Recurrent(bwd, return_sequences=False, reverse=True)
+            ct = nn.ConcatTable().add(f).add(b)
+            merge = {"concat": lambda: nn.JoinTable(axis=-1),
+                     "sum": nn.CAddTable, "mul": nn.CMulTable,
+                     "ave": nn.CAveTable}[self.merge_mode]()
+            return nn.Sequential().add(ct).add(merge)
+        return nn.BiRecurrent(fwd, bwd, merge=self.merge_mode)
+
+    def compute_output_shape(self, input_shape):
+        base = self.inner.compute_output_shape(input_shape)
+        if self.merge_mode == "concat":
+            return tuple(base[:-1]) + (2 * base[-1],)
+        return base

@@ -1,0 +1,360 @@
+"""Keras-style topology: Sequential/Model with compile/fit/evaluate/predict.
+
+Parity: reference Keras-1.2.2-compatible API (DL/nn/keras/Topology.scala:55
+`compile`, `:89,:116` `fit`, `:127` `evaluate`, `:149` `predict`;
+DL/nn/keras/KerasLayer.scala wraps a Torch layer as "labor"; shape inference
+via DL/nn/abstractnn/InferShape.scala). TPU-first translation: a KerasLayer
+builds its labor module eagerly at `add()` time from the propagated input
+shape, so the whole model is an ordinary `Module` pytree and `fit` is one
+jit-compiled train step — no per-layer shape negotiation at run time.
+
+Shapes exclude the batch dimension throughout (Keras convention); image
+layouts are channel-last (NHWC — `dim_ordering='tf'`), the natural layout
+for TPU convolutions.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, List, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import bigdl_tpu.nn as nn
+import bigdl_tpu.optim as optim
+from bigdl_tpu.nn.module import ApplyContext, Module
+
+Shape = Tuple[Optional[int], ...]
+
+
+class KerasLayer(Module):
+    """Base wrapper: owns a `labor` nn.Module built from the input shape."""
+
+    def __init__(self, input_shape: Optional[Shape] = None, name=None):
+        super().__init__(name)
+        self.input_shape_arg = tuple(input_shape) if input_shape else None
+        self.labor: Optional[Module] = None
+        self.built_input_shape: Optional[Shape] = None
+        self.built_output_shape: Optional[Shape] = None
+
+    # -- subclass contract -------------------------------------------------
+    def _build_labor(self, input_shape: Shape) -> Module:
+        raise NotImplementedError
+
+    def compute_output_shape(self, input_shape: Shape) -> Shape:
+        return input_shape
+
+    # -- build machinery ---------------------------------------------------
+    def build(self, input_shape: Shape):
+        if self.labor is None or self.built_input_shape != tuple(input_shape):
+            self.built_input_shape = tuple(input_shape)
+            self.labor = self._build_labor(self.built_input_shape)
+            self.built_output_shape = tuple(
+                self.compute_output_shape(self.built_input_shape))
+        return self
+
+    def _require_built(self):
+        if self.labor is None:
+            if self.input_shape_arg is None:
+                raise ValueError(
+                    f"{self.name}: layer is not built; give it input_shape= "
+                    "or add it to a model after an input layer")
+            self.build(self.input_shape_arg)
+
+    # -- Module contract delegates to labor --------------------------------
+    def init(self, rng):
+        self._require_built()
+        return self.labor.init(rng)
+
+    def apply(self, params, input, ctx: ApplyContext):
+        self._require_built()
+        return self.labor.apply(params, input, ctx)
+
+    def _collect_state(self, out, path):
+        self._require_built()
+        self.labor._collect_state(out, path)
+
+
+class Input(KerasLayer):
+    """Input placeholder carrying only a shape (DL/nn/keras/Input.scala)."""
+
+    def __init__(self, shape: Shape, name=None):
+        super().__init__(input_shape=shape, name=name)
+
+    def _build_labor(self, input_shape):
+        return nn.Identity()
+
+
+# --------------------------------------------------------------------------- #
+# string resolvers (Keras-style sugar)
+# --------------------------------------------------------------------------- #
+
+def activation_module(act: Union[str, Module, None]) -> Optional[Module]:
+    if act is None or isinstance(act, Module):
+        return act
+    table: dict = {
+        "relu": nn.ReLU, "tanh": nn.Tanh, "sigmoid": nn.Sigmoid,
+        "hard_sigmoid": nn.HardSigmoid, "softmax": nn.SoftMax,
+        "softplus": nn.SoftPlus, "softsign": nn.SoftSign,
+        "log_softmax": nn.LogSoftMax, "elu": nn.ELU, "gelu": nn.GELU,
+    }
+    if act == "linear":
+        return None
+    if act not in table:
+        raise ValueError(f"unknown activation '{act}'")
+    return table[act]()
+
+
+def resolve_optim_method(o) -> optim.SGD:
+    if isinstance(o, str):
+        table = {"sgd": lambda: optim.SGD(learning_rate=0.01),
+                 "adam": optim.Adam, "adagrad": optim.Adagrad,
+                 "adadelta": optim.Adadelta, "adamax": optim.Adamax,
+                 "rmsprop": optim.RMSprop}
+        if o.lower() not in table:
+            raise ValueError(f"unknown optimizer '{o}'")
+        return table[o.lower()]()
+    return o
+
+
+def resolve_loss(l):
+    from bigdl_tpu.nn.criterion import Criterion
+    if isinstance(l, Criterion):
+        return l
+    table = {
+        "categorical_crossentropy": CategoricalCrossEntropy,
+        "sparse_categorical_crossentropy":
+            lambda: nn.CrossEntropyCriterion(zero_based=True),
+        "binary_crossentropy": nn.BCECriterion,
+        "mse": nn.MSECriterion, "mean_squared_error": nn.MSECriterion,
+        "mae": nn.AbsCriterion, "mean_absolute_error": nn.AbsCriterion,
+        "mape": nn.MeanAbsolutePercentageCriterion,
+        "msle": nn.MeanSquaredLogarithmicCriterion,
+        "hinge": nn.MarginCriterion,
+        "squared_hinge": lambda: nn.MarginCriterion(squared=True),
+        "kld": nn.KullbackLeiblerDivergenceCriterion,
+        "kullback_leibler_divergence": nn.KullbackLeiblerDivergenceCriterion,
+        "poisson": nn.PoissonCriterion,
+        "cosine_proximity": nn.CosineProximityCriterion,
+    }
+    if l not in table:
+        raise ValueError(f"unknown loss '{l}'")
+    return table[l]()
+
+
+class CategoricalCrossEntropy(nn.criterion.Criterion):
+    """Cross-entropy over probabilities with one-hot targets — Keras's
+    `categorical_crossentropy` (reference DL/nn/CategoricalCrossEntropy.scala:
+    zeroBasedLabel ClassNLL over log of softmax output)."""
+
+    def loss(self, output, target):
+        eps = 1e-8
+        logp = jnp.log(jnp.clip(output, eps, 1.0))
+        per = -jnp.sum(target * logp, axis=-1)
+        return self._reduce(per)
+
+
+def resolve_metric(m):
+    if isinstance(m, optim.ValidationMethod):
+        return m
+    table = {"accuracy": optim.Top1Accuracy, "acc": optim.Top1Accuracy,
+             "top1": optim.Top1Accuracy, "top5": optim.Top5Accuracy,
+             "loss": optim.Loss, "mae": optim.MAE}
+    if m not in table:
+        raise ValueError(f"unknown metric '{m}'")
+    return table[m]()
+
+
+# --------------------------------------------------------------------------- #
+# models
+# --------------------------------------------------------------------------- #
+
+class KerasModel(KerasLayer):
+    """compile/fit/evaluate/predict surface (Topology.scala:55-158)."""
+
+    def __init__(self, name=None):
+        super().__init__(name=name)
+        self.optim_method = None
+        self.criterion = None
+        self.metrics: List = []
+
+    def compile(self, optimizer, loss, metrics: Optional[Sequence] = None):
+        self.optim_method = resolve_optim_method(optimizer)
+        self.criterion = resolve_loss(loss)
+        self.metrics = [resolve_metric(m) for m in (metrics or [])]
+        return self
+
+    def _check_compiled(self):
+        if self.optim_method is None:
+            raise RuntimeError("call compile(optimizer, loss) before fit/evaluate")
+
+    def fit(self, x, y=None, batch_size: int = 32, nb_epoch: int = 10,
+            validation_data=None, distributed: bool = False):
+        """Train; x can be (ndarray, with y=ndarray) or a DataSet/Sample list."""
+        self._check_compiled()
+        data = (x, y) if y is not None else x
+        o = optim.Optimizer(self, data, self.criterion, batch_size=batch_size,
+                            local=not distributed)
+        o.set_optim_method(self.optim_method)
+        o.set_end_when(optim.max_epoch(nb_epoch))
+        if validation_data is not None and self.metrics:
+            vd = validation_data
+            vdata = (vd[0], vd[1]) if isinstance(vd, (tuple, list)) else vd
+            o.set_validation(optim.every_epoch(), vdata, self.metrics,
+                             batch_size=batch_size)
+        o.optimize()  # leaves trained params on self via set_params
+        return self
+
+    def evaluate(self, x, y=None, batch_size: int = 32):
+        self._check_compiled()
+        methods = self.metrics or [optim.Loss(self.criterion)]
+        data = _to_samples(x, y)
+        return self.evaluate_on(data, methods, batch_size=batch_size)
+
+    def predict(self, x, batch_size: int = 32):
+        return super().predict(x, batch_size=batch_size)
+
+    def predict_classes(self, x, batch_size: int = 32, zero_based: bool = True):
+        cls = self.predict_class(x, batch_size=batch_size)
+        return cls if not zero_based else np.asarray(cls) - 1
+
+    def summary(self) -> str:
+        from bigdl_tpu.nn.module import param_count
+        lines = [f"Model: {self.name}",
+                 "-" * 64,
+                 f"{'Layer (type)':<34}{'Output Shape':<20}Param #"]
+        total = 0
+        for l in self._layer_list():
+            n = param_count(l.init(jax.random.PRNGKey(0)))
+            total += n
+            out = str(("None",) + tuple(l.built_output_shape or ()))
+            lines.append(f"{l.name:<34}{out:<20}{n}")
+        lines.append("-" * 64)
+        lines.append(f"Total params: {total}")
+        return "\n".join(lines)
+
+    def _layer_list(self) -> List[KerasLayer]:
+        return []
+
+
+def _to_samples(x, y):
+    if y is not None:
+        from bigdl_tpu.dataset.sample import Sample
+        xs, ys = np.asarray(x), np.asarray(y)
+        return [Sample(xs[i], ys[i]) for i in range(len(xs))]
+    return x
+
+
+class Sequential(KerasModel):
+    """Keras Sequential (DL/nn/keras/Topology.scala Sequential)."""
+
+    def __init__(self, name=None):
+        super().__init__(name=name)
+        self.layers: List[KerasLayer] = []
+        self._seq = nn.Sequential(name=(name or "keras_seq"))
+
+    def add(self, layer: KerasLayer) -> "Sequential":
+        if not isinstance(layer, KerasLayer):
+            raise TypeError("Keras Sequential takes keras layers; got "
+                            f"{type(layer).__name__}")
+        if not self.layers:
+            shape = layer.input_shape_arg
+            if shape is None:
+                raise ValueError("first layer needs input_shape=")
+        else:
+            shape = self.layers[-1].built_output_shape
+            if layer.input_shape_arg and tuple(layer.input_shape_arg) != tuple(shape):
+                raise ValueError(
+                    f"{layer.name}: declared input_shape {layer.input_shape_arg}"
+                    f" != inferred {shape}")
+        layer.build(shape)
+        self.layers.append(layer)
+        self._seq.add(layer)
+        self.built_input_shape = self.layers[0].built_input_shape
+        self.built_output_shape = layer.built_output_shape
+        self.labor = self._seq
+        self._params = None  # invalidate cached stateful params
+        return self
+
+    def get_output_shape(self) -> Shape:
+        return ("None",) + tuple(self.built_output_shape or ())
+
+    def _layer_list(self):
+        return self.layers
+
+
+class Model(KerasModel):
+    """Keras functional Model over the graph DSL (Topology.scala Model).
+
+    Usage:
+        i = Input(shape=(8,))
+        h = Dense(16, activation='relu')(i)
+        m = Model(input=i, output=h)
+    KerasLayer.__call__ on a node builds the layer from the node's output
+    shape and returns a new node.
+    """
+
+    def __init__(self, input, output, name=None):
+        super().__init__(name=name)
+        self.inputs = list(input) if isinstance(input, (list, tuple)) else [input]
+        self.outputs = (list(output) if isinstance(output, (list, tuple))
+                        else [output])
+        in_nodes = [n.node for n in self.inputs]
+        out_nodes = [n.node for n in self.outputs]
+        self.labor = nn.Graph(in_nodes, out_nodes)
+        self.built_input_shape = tuple(self.inputs[0].shape)
+        self.built_output_shape = tuple(self.outputs[0].shape)
+
+    def _layer_list(self):
+        seen, order = set(), []
+
+        def visit(t):
+            if id(t) in seen:
+                return
+            seen.add(id(t))
+            for p in t.prev:
+                visit(p)
+            if isinstance(t.layer, KerasLayer):
+                order.append(t.layer)
+        for o in self.outputs:
+            visit(o)
+        return order
+
+
+class KTensor:
+    """Symbolic tensor in the functional API: (graph node, shape, layer)."""
+
+    def __init__(self, node, shape: Shape, layer: Optional[KerasLayer],
+                 prev: Sequence["KTensor"] = ()):
+        self.node = node
+        self.shape = tuple(shape)
+        self.layer = layer
+        self.prev = list(prev)
+
+
+def input_tensor(shape: Shape, name=None) -> KTensor:
+    """Functional-API entry: a symbolic input tensor (Keras `Input(...)`)."""
+    from bigdl_tpu.nn.containers import InputNode
+    layer = Input(shape, name=name)
+    layer.build(shape)
+    return KTensor(InputNode(name=layer.name), shape, layer)
+
+
+def _call_on_tensor(layer: KerasLayer, tensors) -> KTensor:
+    ts = tensors if isinstance(tensors, (list, tuple)) else [tensors]
+    shapes = [t.shape for t in ts]
+    layer.build(shapes[0] if len(shapes) == 1 else shapes)
+    node = layer.inputs(*[t.node for t in ts])
+    return KTensor(node, layer.built_output_shape, layer, prev=ts)
+
+
+def _keras_call(self, x, *args, **kw):
+    """Symbolic call on KTensor(s); otherwise ordinary Module.forward."""
+    if isinstance(x, KTensor) or (isinstance(x, (list, tuple)) and x
+                                  and isinstance(x[0], KTensor)):
+        return _call_on_tensor(self, x)
+    return self.forward(x, *args, **kw)
+
+
+KerasLayer.__call__ = _keras_call  # type: ignore[assignment]

@@ -226,28 +226,40 @@ class SpatialSeparableConvolution(Module):
 
 
 class TemporalConvolution(Module):
-    """1-D conv over [B, T, C] (DL/nn/TemporalConvolution.scala)."""
+    """1-D conv over [B, T, C] (DL/nn/TemporalConvolution.scala).
+
+    `pad`/`dilation`/`with_bias` extend the reference for the Keras-API
+    wrappers (Convolution1D/AtrousConvolution1D)."""
 
     def __init__(self, input_frame_size: int, output_frame_size: int,
-                 kernel_w: int, stride_w: int = 1, name=None):
+                 kernel_w: int, stride_w: int = 1, pad: PadT = 0,
+                 dilation: int = 1, with_bias: bool = True, name=None):
         super().__init__(name)
         self.c_in, self.c_out = input_frame_size, output_frame_size
         self.kw, self.sw = kernel_w, stride_w
+        self.pad, self.dilation = pad, dilation
+        self.with_bias = with_bias
 
     def init(self, rng):
         k1, k2 = jax.random.split(rng)
         stdv = 1.0 / math.sqrt(self.kw * self.c_in)
-        return {
-            "weight": jax.random.uniform(
-                k1, (self.kw, self.c_in, self.c_out), minval=-stdv, maxval=stdv),
-            "bias": jax.random.uniform(k2, (self.c_out,), minval=-stdv, maxval=stdv),
-        }
+        p = {"weight": jax.random.uniform(
+            k1, (self.kw, self.c_in, self.c_out), minval=-stdv, maxval=stdv)}
+        if self.with_bias:
+            p["bias"] = jax.random.uniform(
+                k2, (self.c_out,), minval=-stdv, maxval=stdv)
+        return p
 
     def apply(self, params, input, ctx):
+        pad = ("SAME" if self.pad in ("SAME", -1)
+               else [(int(self.pad), int(self.pad))])
         y = lax.conv_general_dilated(
-            input, params["weight"], window_strides=(self.sw,), padding="VALID",
+            input, params["weight"], window_strides=(self.sw,),
+            padding=pad, rhs_dilation=(self.dilation,),
             dimension_numbers=("NWC", "WIO", "NWC"))
-        return y + params["bias"]
+        if self.with_bias:
+            y = y + params["bias"]
+        return y
 
 
 class VolumetricConvolution(Module):
@@ -273,7 +285,8 @@ class VolumetricConvolution(Module):
         return p
 
     def apply(self, params, input, ctx):
-        pads = [(pp, pp) for pp in self.p]
+        same = any(pp in ("SAME", -1) for pp in self.p)
+        pads = "SAME" if same else [(pp, pp) for pp in self.p]
         y = lax.conv_general_dilated(
             input, params["weight"], window_strides=self.s, padding=pads,
             dimension_numbers=("NDHWC", "DHWIO", "NDHWC"))

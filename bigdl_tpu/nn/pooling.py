@@ -113,17 +113,21 @@ class SpatialAveragePooling(Module):
 
 
 class TemporalMaxPooling(Module):
-    """1-D max pooling over [B, T, C] (DL/nn/TemporalMaxPooling.scala)."""
+    """1-D max pooling over [B, T, C] (DL/nn/TemporalMaxPooling.scala).
+    `padding` in {"VALID", "SAME"} (SAME extends the reference for the
+    Keras-API wrapper)."""
 
-    def __init__(self, kw: int, dw: Optional[int] = None, name=None):
+    def __init__(self, kw: int, dw: Optional[int] = None,
+                 padding: str = "VALID", name=None):
         super().__init__(name)
         self.kw, self.dw = kw, dw or kw
+        self.padding = padding
 
     def apply(self, params, input, ctx):
         return lax.reduce_window(
             input, -jnp.inf, lax.max,
             window_dimensions=(1, self.kw, 1),
-            window_strides=(1, self.dw, 1), padding="VALID")
+            window_strides=(1, self.dw, 1), padding=self.padding)
 
 
 class VolumetricMaxPooling(Module):

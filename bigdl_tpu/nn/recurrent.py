@@ -305,7 +305,8 @@ class Recurrent(Module):
 
 
 class BiRecurrent(Module):
-    """Bidirectional wrapper (DL/nn/BiRecurrent.scala); merge = concat|sum."""
+    """Bidirectional wrapper (DL/nn/BiRecurrent.scala);
+    merge = concat|sum|mul|ave."""
 
     def __init__(self, cell_fwd: Cell, cell_bwd: Optional[Cell] = None,
                  merge: str = "concat", name=None):
@@ -324,7 +325,13 @@ class BiRecurrent(Module):
         b = self.bwd.apply(params["bwd"], input, ctx)
         if self.merge == "concat":
             return jnp.concatenate([a, b], axis=-1)
-        return a + b
+        if self.merge == "sum":
+            return a + b
+        if self.merge == "mul":
+            return a * b
+        if self.merge == "ave":
+            return (a + b) * 0.5
+        raise ValueError(f"unknown merge '{self.merge}'")
 
 
 class RecurrentDecoder(Module):

@@ -1,0 +1,265 @@
+"""Keras-style API tests (mirror of reference TEST/keras specs: shape
+inference at add() time, forward shapes, and an end-to-end fit)."""
+
+import numpy as np
+import pytest
+
+import bigdl_tpu.keras as K
+
+
+def _run(model, input_shape, batch=2):
+    x = np.random.RandomState(0).rand(batch, *input_shape).astype(np.float32)
+    out = model.forward(x, training=False)
+    return np.asarray(out)
+
+
+class TestShapeInference:
+    def test_dense_stack(self):
+        m = (K.Sequential()
+             .add(K.Dense(16, activation="relu", input_shape=(8,)))
+             .add(K.Dense(4)))
+        assert m.built_output_shape == (4,)
+        assert _run(m, (8,)).shape == (2, 4)
+
+    def test_dense_3d_input(self):
+        m = K.Sequential().add(K.Dense(7, input_shape=(5, 3)))
+        assert m.built_output_shape == (5, 7)
+        assert _run(m, (5, 3)).shape == (2, 5, 7)
+
+    def test_conv2d_valid_same(self):
+        m = (K.Sequential()
+             .add(K.Convolution2D(6, 3, 3, input_shape=(12, 12, 3)))
+             .add(K.MaxPooling2D()))
+        assert m.built_output_shape == (5, 5, 6)
+        m2 = K.Sequential().add(
+            K.Convolution2D(6, 3, 3, border_mode="same",
+                            subsample=(2, 2), input_shape=(12, 12, 3)))
+        assert m2.built_output_shape == (6, 6, 6)
+        assert _run(m2, (12, 12, 3)).shape == (2, 6, 6, 6)
+
+    def test_conv1d(self):
+        m = K.Sequential().add(
+            K.Convolution1D(8, 3, input_shape=(10, 4)))
+        assert m.built_output_shape == (8, 8)
+        assert _run(m, (10, 4)).shape == (2, 8, 8)
+
+    def test_conv3d(self):
+        m = K.Sequential().add(
+            K.Convolution3D(4, 2, 2, 2, input_shape=(5, 6, 7, 2)))
+        assert m.built_output_shape == (4, 5, 6, 4)
+        assert _run(m, (5, 6, 7, 2)).shape == (2, 4, 5, 6, 4)
+
+    def test_flatten_reshape_permute(self):
+        m = (K.Sequential()
+             .add(K.Permute((2, 1), input_shape=(4, 6)))
+             .add(K.Flatten())
+             .add(K.Reshape((8, 3))))
+        assert m.built_output_shape == (8, 3)
+        assert _run(m, (4, 6)).shape == (2, 8, 3)
+
+    def test_embedding(self):
+        m = K.Sequential().add(K.Embedding(20, 5, input_length=7))
+        x = np.random.RandomState(0).randint(0, 20, size=(3, 7))
+        out = m.forward(x, training=False)
+        assert out.shape == (3, 7, 5)
+
+    def test_global_pooling(self):
+        m = K.Sequential().add(
+            K.GlobalAveragePooling2D(input_shape=(6, 6, 5)))
+        assert m.built_output_shape == (5,)
+        assert _run(m, (6, 6, 5)).shape == (2, 5)
+
+    def test_zeropad_crop_upsample(self):
+        m = (K.Sequential()
+             .add(K.ZeroPadding2D((1, 2), input_shape=(4, 4, 3)))
+             .add(K.Cropping2D(((1, 1), (2, 2))))
+             .add(K.UpSampling2D((2, 2))))
+        assert m.built_output_shape == (8, 8, 3)
+        assert _run(m, (4, 4, 3)).shape == (2, 8, 8, 3)
+
+    def test_separable_deconv_atrous(self):
+        m = K.Sequential().add(
+            K.SeparableConvolution2D(8, 3, 3, input_shape=(9, 9, 4)))
+        assert m.built_output_shape == (7, 7, 8)
+        d = K.Sequential().add(
+            K.Deconvolution2D(5, 3, 3, subsample=(2, 2),
+                              input_shape=(4, 4, 2)))
+        assert d.built_output_shape == (9, 9, 5)
+        assert _run(d, (4, 4, 2)).shape == (2, 9, 9, 5)
+        a = K.Sequential().add(
+            K.AtrousConvolution2D(6, 3, 3, atrous_rate=(2, 2),
+                                  input_shape=(10, 10, 3)))
+        assert a.built_output_shape == (6, 6, 6)
+        assert _run(a, (10, 10, 3)).shape == (2, 6, 6, 6)
+
+    def test_same_even_kernel_shapes_match(self):
+        # regression: even kernels under 'same' need asymmetric (TF) padding
+        m = (K.Sequential()
+             .add(K.Convolution1D(5, 2, border_mode="same",
+                                  input_shape=(10, 3)))
+             .add(K.Flatten())
+             .add(K.Dense(2)))
+        assert _run(m, (10, 3)).shape == (2, 2)
+        c3 = K.Sequential().add(
+            K.Convolution3D(4, 2, 2, 2, border_mode="same",
+                            input_shape=(5, 6, 7, 2)))
+        assert _run(c3, (5, 6, 7, 2)).shape == (2,) + c3.built_output_shape
+        sep = K.Sequential().add(
+            K.SeparableConvolution2D(8, 2, 2, border_mode="same",
+                                     input_shape=(9, 9, 4)))
+        assert _run(sep, (9, 9, 4)).shape == (2, 9, 9, 8)
+
+    def test_pool1d_same(self):
+        m = K.Sequential().add(
+            K.MaxPooling1D(2, border_mode="same", input_shape=(7, 4)))
+        assert m.built_output_shape == (4, 4)
+        assert _run(m, (7, 4)).shape == (2, 4, 4)
+        a = K.Sequential().add(
+            K.AveragePooling1D(2, border_mode="same", input_shape=(7, 4)))
+        assert _run(a, (7, 4)).shape == (2, 4, 4)
+        with pytest.raises(ValueError):
+            K.MaxPooling1D(2, border_mode="garbage")
+
+    def test_pool2d_same(self):
+        m = K.Sequential().add(
+            K.MaxPooling2D((2, 2), border_mode="same", input_shape=(7, 7, 3)))
+        assert m.built_output_shape == (4, 4, 3)
+        assert _run(m, (7, 7, 3)).shape == (2, 4, 4, 3)
+
+    def test_merge_concat_axis_batch_inclusive(self):
+        # concat_axis=1 on (batch, steps, feat) joins along steps (reference
+        # Merge.scala semantics), not features
+        inp = K.input_tensor(shape=(4, 6))
+        a = K.TimeDistributed(K.Dense(6))(inp)
+        c = K.merge([a, inp], mode="concat", concat_axis=1)
+        m = K.Model(input=inp, output=c)
+        assert c.shape == (8, 6)
+        x = np.ones((2, 4, 6), np.float32)
+        assert np.asarray(m.forward(x)).shape == (2, 8, 6)
+
+    def test_batchnorm_advanced_activations(self):
+        m = (K.Sequential()
+             .add(K.Dense(6, input_shape=(4,)))
+             .add(K.BatchNormalization())
+             .add(K.LeakyReLU(0.2))
+             .add(K.ELU()))
+        assert _run(m, (4,)).shape == (2, 6)
+
+    def test_declared_shape_mismatch_raises(self):
+        s = K.Sequential().add(K.Dense(4, input_shape=(8,)))
+        with pytest.raises(ValueError):
+            s.add(K.Dense(2, input_shape=(5,)))
+
+    def test_first_layer_needs_shape(self):
+        with pytest.raises(ValueError):
+            K.Sequential().add(K.Dense(4))
+
+
+class TestRecurrent:
+    def test_lstm_last_and_sequences(self):
+        m = K.Sequential().add(K.LSTM(6, input_shape=(5, 3)))
+        assert m.built_output_shape == (6,)
+        assert _run(m, (5, 3)).shape == (2, 6)
+        m2 = K.Sequential().add(
+            K.GRU(6, return_sequences=True, input_shape=(5, 3)))
+        assert _run(m2, (5, 3)).shape == (2, 5, 6)
+
+    def test_simple_rnn_backwards(self):
+        m = K.Sequential().add(
+            K.SimpleRNN(4, go_backwards=True, input_shape=(6, 2)))
+        assert _run(m, (6, 2)).shape == (2, 4)
+
+    def test_bidirectional(self):
+        m = K.Sequential().add(
+            K.Bidirectional(K.LSTM(4, return_sequences=True),
+                            input_shape=(5, 3)))
+        assert m.built_output_shape == (5, 8)
+        assert _run(m, (5, 3)).shape == (2, 5, 8)
+        m2 = K.Sequential().add(
+            K.Bidirectional(K.LSTM(4), merge_mode="sum",
+                            input_shape=(5, 3)))
+        assert _run(m2, (5, 3)).shape == (2, 4)
+
+    def test_bidirectional_mul_ave(self):
+        x = np.random.RandomState(0).rand(2, 5, 3).astype(np.float32)
+        outs = {}
+        for mode in ("sum", "mul", "ave"):
+            m = K.Sequential().add(
+                K.Bidirectional(K.LSTM(4, return_sequences=True),
+                                merge_mode=mode, input_shape=(5, 3)))
+            outs[mode] = np.asarray(m.forward(x, training=False))
+        assert not np.allclose(outs["sum"], outs["mul"])
+        assert np.allclose(outs["ave"] * 2, outs["sum"], atol=1e-5)
+        with pytest.raises(ValueError):
+            K.Bidirectional(K.LSTM(4), merge_mode="bogus")
+
+    def test_convlstm2d(self):
+        m = K.Sequential().add(
+            K.ConvLSTM2D(4, 3, input_shape=(3, 6, 6, 2)))
+        assert _run(m, (3, 6, 6, 2)).shape == (2, 6, 6, 4)
+
+    def test_timedistributed(self):
+        m = K.Sequential().add(
+            K.TimeDistributed(K.Dense(4), input_shape=(5, 3)))
+        assert m.built_output_shape == (5, 4)
+        assert _run(m, (5, 3)).shape == (2, 5, 4)
+
+
+class TestFunctionalModel:
+    def test_two_branch_model(self):
+        inp = K.input_tensor(shape=(8,))
+        a = K.Dense(6, activation="relu")(inp)
+        b = K.Dense(6)(inp)
+        out = K.Dense(3)(K.merge([a, b], mode="sum"))
+        m = K.Model(input=inp, output=out)
+        x = np.random.RandomState(1).rand(4, 8).astype(np.float32)
+        y = np.asarray(m.forward(x, training=False))
+        assert y.shape == (4, 3)
+
+    def test_concat_merge(self):
+        inp = K.input_tensor(shape=(4,))
+        a = K.Dense(3)(inp)
+        b = K.Dense(5)(inp)
+        c = K.merge([a, b], mode="concat")
+        m = K.Model(input=inp, output=c)
+        x = np.ones((2, 4), np.float32)
+        assert np.asarray(m.forward(x)).shape == (2, 8)
+
+
+class TestCompileFit:
+    def test_fit_improves_loss(self):
+        rs = np.random.RandomState(0)
+        x = rs.rand(64, 8).astype(np.float32)
+        w = rs.rand(8, 3).astype(np.float32)
+        logits = x @ w
+        y = (np.argmax(logits, 1) + 1).astype(np.int32)  # 1-based labels
+
+        m = (K.Sequential()
+             .add(K.Dense(16, activation="relu", input_shape=(8,)))
+             .add(K.Dense(3, activation="log_softmax")))
+        m.compile(optimizer="adam",
+                  loss=__import__("bigdl_tpu.nn", fromlist=["nn"])
+                  .ClassNLLCriterion(),
+                  metrics=["accuracy"])
+        m.fit(x, y, batch_size=16, nb_epoch=8)
+        res = m.evaluate(x, y, batch_size=16)
+        acc = res[0].result()[0]
+        assert acc > 0.6, f"accuracy {acc}"
+
+    def test_categorical_crossentropy_onehot(self):
+        rs = np.random.RandomState(0)
+        x = rs.rand(32, 6).astype(np.float32)
+        cls = rs.randint(0, 3, size=32)
+        y = np.eye(3, dtype=np.float32)[cls]
+        m = (K.Sequential()
+             .add(K.Dense(3, activation="softmax", input_shape=(6,))))
+        m.compile(optimizer="sgd", loss="categorical_crossentropy")
+        m.fit(x, y, batch_size=8, nb_epoch=2)
+
+    def test_summary(self):
+        m = (K.Sequential()
+             .add(K.Dense(4, input_shape=(8,)))
+             .add(K.Dense(2)))
+        s = m.summary()
+        assert "Total params: " in s
+        assert str(8 * 4 + 4 + 4 * 2 + 2) in s
