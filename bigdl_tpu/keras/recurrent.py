@@ -39,9 +39,9 @@ class _KerasRecurrent(KerasLayer):
 
 class SimpleRNN(_KerasRecurrent):
     def _make_cell(self, input_dim):
-        import jax
-        act = jnp.tanh if self.activation == "tanh" else jax.nn.relu
-        return nn.RnnCell(input_dim, self.output_dim, activation=act)
+        from bigdl_tpu.keras.layers import _activation_fn
+        return nn.RnnCell(input_dim, self.output_dim,
+                          activation=_activation_fn(self.activation))
 
 
 class LSTM(_KerasRecurrent):

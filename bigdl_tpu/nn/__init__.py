@@ -80,6 +80,9 @@ from bigdl_tpu.nn.criterion import (AbsCriterion, BCECriterion,
                                     TimeDistributedCriterion,
                                     TimeDistributedMaskCriterion,
                                     TransformerCriterion)
+from bigdl_tpu.nn.attention import (MultiHeadAttention,
+                                    ScaledDotProductAttention,
+                                    TransformerBlock, rope)
 from bigdl_tpu.nn import initialization
 from bigdl_tpu.nn.initialization import (BilinearFiller, ConstInitMethod,
                                          MsraFiller, Ones, RandomNormal,

@@ -1,0 +1,150 @@
+"""Attention layers and transformer blocks.
+
+Net-new vs the reference (SURVEY.md §5.7: no attention exists in BigDL);
+designed TPU-first: head-major [B,H,T,D] attention on the flash/blockwise
+kernels in ops/attention_kernel.py, bf16-friendly, fully jittable.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from bigdl_tpu.nn.initialization import Xavier
+from bigdl_tpu.nn.module import ApplyContext, Module
+from bigdl_tpu.nn.normalization import LayerNormalization
+from bigdl_tpu.ops.attention_kernel import (blockwise_attention,
+                                            flash_attention, naive_attention)
+
+
+def rope(x, positions=None, base: float = 10000.0):
+    """Rotary position embedding over [B, H, T, D] (D even). Angles are
+    computed in f32; the result keeps x's dtype (bf16 stays bf16)."""
+    b, h, t, d = x.shape
+    if positions is None:
+        positions = jnp.arange(t)
+    inv = base ** (-jnp.arange(0, d, 2, dtype=jnp.float32) / d)  # [D/2]
+    ang = positions.astype(jnp.float32)[:, None] * inv[None, :]  # [T, D/2]
+    sin, cos = jnp.sin(ang), jnp.cos(ang)
+    x1, x2 = x[..., 0::2], x[..., 1::2]
+    out = jnp.stack([x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
+    return out.reshape(b, h, t, d).astype(x.dtype)
+
+
+class ScaledDotProductAttention(Module):
+    """attention(T(q, k, v)) with optional causal mask; q,k,v [B,H,T,D]."""
+
+    def __init__(self, causal: bool = False, use_flash: bool = True,
+                 sm_scale: Optional[float] = None, name=None):
+        super().__init__(name)
+        self.causal, self.use_flash, self.sm_scale = causal, use_flash, sm_scale
+
+    def apply(self, params, input, ctx):
+        q, k, v = list(input)  # Table is 1-based; iterate instead of index
+        if self.use_flash:
+            return flash_attention(q, k, v, self.causal, self.sm_scale)
+        return naive_attention(q, k, v, self.causal, self.sm_scale)
+
+
+class MultiHeadAttention(Module):
+    """Multi-head attention (separate q/k/v projections — the layout that
+    shards cleanly over a tensor-parallel mesh axis).
+
+    Input: [B, T, E] (self-attention) or Table(query [B,Tq,E],
+    key_value [B,Tk,E]) for cross attention. bias optional; RoPE optional.
+    """
+
+    def __init__(self, embed_dim: int, n_head: int, causal: bool = False,
+                 with_bias: bool = True, use_rope: bool = False,
+                 use_flash: bool = True, kv_embed_dim: Optional[int] = None,
+                 name=None):
+        super().__init__(name)
+        if embed_dim % n_head:
+            raise ValueError(f"embed_dim {embed_dim} % n_head {n_head} != 0")
+        self.e, self.h = embed_dim, n_head
+        self.hd = embed_dim // n_head
+        self.causal, self.with_bias = causal, with_bias
+        self.use_rope, self.use_flash = use_rope, use_flash
+        self.kv_e = kv_embed_dim or embed_dim
+
+    def init(self, rng):
+        k1, k2, k3, k4 = jax.random.split(rng, 4)
+        xav = Xavier()
+        p = {"wq": xav(k1, (self.e, self.e)),
+             "wk": xav(k2, (self.kv_e, self.e)),
+             "wv": xav(k3, (self.kv_e, self.e)),
+             "wo": xav(k4, (self.e, self.e))}
+        if self.with_bias:
+            for n in ("bq", "bk", "bv", "bo"):
+                p[n] = jnp.zeros((self.e,))
+        return p
+
+    def _split(self, x):  # [B,T,E] -> [B,H,T,hd]
+        b, t, _ = x.shape
+        return jnp.transpose(x.reshape(b, t, self.h, self.hd), (0, 2, 1, 3))
+
+    def _merge(self, x):  # [B,H,T,hd] -> [B,T,E]
+        b, h, t, hd = x.shape
+        return jnp.transpose(x, (0, 2, 1, 3)).reshape(b, t, h * hd)
+
+    def apply(self, params, input, ctx):
+        from bigdl_tpu.utils.table import Table
+        if isinstance(input, (Table, list, tuple)):
+            xq, xkv = list(input)  # Table is 1-based; iterate
+        else:
+            xq = xkv = input
+        q = xq @ params["wq"]
+        k = xkv @ params["wk"]
+        v = xkv @ params["wv"]
+        if self.with_bias:
+            q, k, v = q + params["bq"], k + params["bk"], v + params["bv"]
+        q, k, v = self._split(q), self._split(k), self._split(v)
+        if self.use_rope:
+            q, k = rope(q), rope(k)
+        if self.use_flash:
+            o = flash_attention(q, k, v, self.causal)
+        else:
+            o = naive_attention(q, k, v, self.causal)
+        o = self._merge(o) @ params["wo"]
+        if self.with_bias:
+            o = o + params["bo"]
+        return o
+
+
+class TransformerBlock(Module):
+    """Pre-norm transformer block: x + MHA(LN(x)); x + MLP(LN(x))."""
+
+    def __init__(self, embed_dim: int, n_head: int, mlp_ratio: int = 4,
+                 causal: bool = False, use_rope: bool = False,
+                 use_flash: bool = True, dropout: float = 0.0, name=None):
+        super().__init__(name)
+        self.attn = MultiHeadAttention(embed_dim, n_head, causal=causal,
+                                       use_rope=use_rope, use_flash=use_flash)
+        self.ln1 = LayerNormalization(embed_dim)
+        self.ln2 = LayerNormalization(embed_dim)
+        self.e, self.hidden = embed_dim, embed_dim * mlp_ratio
+        self.dropout = dropout
+
+    def init(self, rng):
+        k1, k2, k3, k4, k5 = jax.random.split(rng, 5)
+        xav = Xavier()
+        return {"attn": self.attn.init(k1),
+                "ln1": self.ln1.init(k2), "ln2": self.ln2.init(k3),
+                "w1": xav(k4, (self.e, self.hidden)),
+                "b1": jnp.zeros((self.hidden,)),
+                "w2": xav(k5, (self.hidden, self.e)),
+                "b2": jnp.zeros((self.e,))}
+
+    def apply(self, params, input, ctx):
+        x = input
+        h = self.ln1.apply(params["ln1"], x, ctx)
+        x = x + self.attn.apply(params["attn"], h, ctx)
+        h = self.ln2.apply(params["ln2"], x, ctx)
+        h = jax.nn.gelu(h @ params["w1"] + params["b1"])
+        if self.dropout and ctx.training:
+            keep = 1.0 - self.dropout
+            h = h * jax.random.bernoulli(ctx.make_rng(), keep, h.shape) / keep
+        return x + (h @ params["w2"] + params["b2"])

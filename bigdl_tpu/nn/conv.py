@@ -275,6 +275,11 @@ class VolumetricConvolution(Module):
         self.k = (kt, kh, kw)
         self.s = (dt, dh, dw)
         self.p = (pad_t, pad_h, pad_w)
+        same = [pp in ("SAME", -1) for pp in self.p]
+        if any(same) and not all(same):
+            raise ValueError(
+                "SAME padding must be set on all of pad_t/pad_h/pad_w, "
+                f"got {self.p}")
         self.with_bias = with_bias
 
     def init(self, rng):

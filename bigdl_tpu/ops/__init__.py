@@ -1,0 +1,9 @@
+"""bigdl_tpu.ops — compute kernels (XLA blockwise + Pallas TPU) and
+TF-style stateless operations."""
+
+from bigdl_tpu.ops.attention_kernel import (attention_state_finish,
+                                            attention_state_init,
+                                            blockwise_attention,
+                                            flash_attention,
+                                            flash_attention_forward,
+                                            naive_attention)

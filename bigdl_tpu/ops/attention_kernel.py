@@ -1,0 +1,289 @@
+"""Attention kernels: online-softmax blockwise attention + Pallas flash
+forward.
+
+No counterpart exists in the reference (SURVEY.md §5.7: BigDL has no
+attention layer at all); this is the TPU-native long-context foundation the
+new framework adds. Design:
+
+- `blockwise_attention` — pure-XLA flash-style attention: lax.scan over KV
+  blocks carrying (acc, row_max, row_sum). O(T) memory in the KV direction,
+  differentiable by autodiff (scan rematerialises), and reusable as the
+  inner step of ring attention (accumulators can be carried across devices).
+- `flash_attention` — Pallas TPU forward kernel (one (batch*head, q-block)
+  program per grid cell, KV streamed through VMEM) wrapped in
+  `jax.custom_vjp`; backward recomputes via the blockwise XLA path.
+
+Layouts: q, k, v are [B, H, T, D] (head-major, the layout that keeps the
+per-head [T, D] @ [D, T] matmuls MXU-shaped).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+NEG_INF = -1e30
+
+
+def _ceil_to(x: int, m: int) -> int:
+    return (x + m - 1) // m * m
+
+
+def naive_attention(q, k, v, causal: bool = False,
+                    sm_scale: Optional[float] = None,
+                    mask: Optional[jax.Array] = None):
+    """Reference O(T^2)-memory attention (for tests and tiny shapes)."""
+    sm_scale = sm_scale or q.shape[-1] ** -0.5
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k) * sm_scale
+    if causal:
+        tq, tk = s.shape[-2], s.shape[-1]
+        idx_q = lax.broadcasted_iota(jnp.int32, (tq, tk), 0)
+        idx_k = lax.broadcasted_iota(jnp.int32, (tq, tk), 1)
+        s = jnp.where(idx_q >= idx_k, s, NEG_INF)
+    if mask is not None:
+        s = jnp.where(mask, s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", p, v)
+
+
+def _block_step(q, k_blk, v_blk, acc, m, l, sm_scale,
+                q_offset, k_offset, causal):
+    """One online-softmax update of (acc, m, l) with a KV block.
+
+    q: [B,H,Tq,D]; k_blk/v_blk: [B,H,Bk,D]; acc: [B,H,Tq,D];
+    m, l: [B,H,Tq] running max / normaliser. Offsets are the global
+    positions of q[...,0,:] and k_blk[...,0,:] (for causal masking across
+    ring/sequence shards)."""
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k_blk) * sm_scale  # [B,H,Tq,Bk]
+    if causal:
+        tq, bk = s.shape[-2], s.shape[-1]
+        gq = lax.broadcasted_iota(jnp.int32, (tq, bk), 0) + q_offset
+        gk = lax.broadcasted_iota(jnp.int32, (tq, bk), 1) + k_offset
+        s = jnp.where(gq >= gk, s, NEG_INF)
+    m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+    # guard fully-masked rows (m_new == NEG_INF): exp(s - NEG_INF) would
+    # overflow; shift by 0 there instead.
+    shift = jnp.where(m_new <= NEG_INF / 2, 0.0, m_new)
+    p = jnp.exp(s - shift[..., None])
+    scale_old = jnp.exp(jnp.where(m <= NEG_INF / 2, NEG_INF, m) - shift)
+    scale_old = jnp.where(m <= NEG_INF / 2, 0.0, scale_old)
+    l_new = l * scale_old + jnp.sum(p, axis=-1)
+    acc_new = acc * scale_old[..., None] + jnp.einsum("bhqk,bhkd->bhqd",
+                                                      p, v_blk)
+    return acc_new, m_new, l_new
+
+
+def attention_state_init(q):
+    """Fresh (acc, m, l) accumulators for online-softmax attention.
+
+    Derived arithmetically from q (not fresh constants) so that under
+    shard_map the accumulators inherit q's varying-manual-axes type — a
+    constant init would fail lax.scan's carry typing inside ring attention."""
+    zero = q.astype(jnp.float32) * 0.0
+    row = zero[..., 0]
+    return (zero, row + NEG_INF, row)
+
+
+def attention_state_finish(acc, m, l):
+    den = jnp.where(l == 0.0, 1.0, l)
+    return acc / den[..., None]
+
+
+def blockwise_attention(q, k, v, causal: bool = False,
+                        sm_scale: Optional[float] = None,
+                        block_k: int = 512,
+                        q_offset: int = 0, k_offset: int = 0,
+                        carry: Optional[Tuple] = None,
+                        finish: bool = True):
+    """Flash-style attention via lax.scan over KV blocks.
+
+    With `carry`/`finish=False` the accumulators are exposed so callers
+    (ring attention) can continue the same softmax across KV shards living
+    on other devices."""
+    orig_dtype = q.dtype
+    qf, kf, vf = (x.astype(jnp.float32) for x in (q, k, v))
+    sm_scale = sm_scale or q.shape[-1] ** -0.5
+    b, h, tk, d = kf.shape
+    block_k = min(block_k, tk)
+    n_blocks = -(-tk // block_k)
+    pad = n_blocks * block_k - tk
+    if pad:
+        kf = jnp.pad(kf, ((0, 0), (0, 0), (0, pad), (0, 0)))
+        vf = jnp.pad(vf, ((0, 0), (0, 0), (0, pad), (0, 0)))
+    # reshape to [n_blocks, B, H, block_k, D] for scan
+    ks = jnp.moveaxis(kf.reshape(b, h, n_blocks, block_k, d), 2, 0)
+    vs = jnp.moveaxis(vf.reshape(b, h, n_blocks, block_k, d), 2, 0)
+
+    state = carry if carry is not None else attention_state_init(qf)
+
+    def step(state, inp):
+        i, k_blk, v_blk = inp
+        acc, m, l = state
+        acc, m, l = _block_step(qf, k_blk, v_blk, acc, m, l, sm_scale,
+                                q_offset, k_offset + i * block_k, causal)
+        return (acc, m, l), None
+
+    if pad:
+        # ragged tail: scan the full blocks, then one explicit step on the
+        # unpadded tail (padded keys must never receive softmax weight)
+        full = tk // block_k
+        if full:
+            idxs = jnp.arange(full)
+            state, _ = lax.scan(step, state,
+                                (idxs, ks[:full], vs[:full]))
+        tail_k = kf[:, :, full * block_k: tk]
+        tail_v = vf[:, :, full * block_k: tk]
+        acc, m, l = state
+        state = _block_step(qf, tail_k, tail_v, acc, m, l, sm_scale,
+                            q_offset, k_offset + full * block_k, causal)
+    else:
+        idxs = jnp.arange(n_blocks)
+        state, _ = lax.scan(step, state, (idxs, ks, vs))
+
+    if not finish:
+        return state
+    out = attention_state_finish(*state)
+    return out.astype(orig_dtype)
+
+
+# --------------------------------------------------------------------------- #
+# Pallas flash forward (TPU fast path)
+# --------------------------------------------------------------------------- #
+
+def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int,
+                      sm_scale: float, causal: bool, seq_k: int):
+    """One program = one (batch*head, q-block). K/V blocks stream via the
+    grid's last dimension? No — streamed with fori_loop over VMEM-resident
+    refs sliced dynamically."""
+    from jax.experimental import pallas as pl
+
+    q = q_ref[0].astype(jnp.float32)          # [block_q, d]
+    block_q, d = q.shape
+    i_q = pl.program_id(1)
+    q_off = i_q * block_q
+
+    n_kb = seq_k // block_k
+
+    def body(ib, carry):
+        acc, m, l = carry
+        k_blk = k_ref[0, pl.ds(ib * block_k, block_k), :].astype(jnp.float32)
+        v_blk = v_ref[0, pl.ds(ib * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k_blk, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        s = s * sm_scale                        # [block_q, block_k]
+        if causal:
+            gq = jax.lax.broadcasted_iota(jnp.int32, s.shape, 0) + q_off
+            gk = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1) \
+                + ib * block_k
+            s = jnp.where(gq >= gk, s, NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+        shift = jnp.where(m_new <= NEG_INF / 2, 0.0, m_new)
+        p = jnp.exp(s - shift[:, None])
+        scale_old = jnp.where(m <= NEG_INF / 2, 0.0, jnp.exp(m - shift))
+        l_new = l * scale_old + jnp.sum(p, axis=-1)
+        acc_new = acc * scale_old[:, None] + jax.lax.dot_general(
+            p, v_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return acc_new, m_new, l_new
+
+    acc = jnp.zeros((block_q, d), jnp.float32)
+    m = jnp.full((block_q,), NEG_INF, jnp.float32)
+    l = jnp.zeros((block_q,), jnp.float32)
+    if causal:
+        # only blocks with k_start <= q_end participate
+        n_needed = jnp.minimum(n_kb, (q_off + block_q + block_k - 1)
+                               // block_k)
+        acc, m, l = jax.lax.fori_loop(0, n_needed, body, (acc, m, l))
+    else:
+        acc, m, l = jax.lax.fori_loop(0, n_kb, body, (acc, m, l))
+    den = jnp.where(l == 0.0, 1.0, l)
+    o_ref[0] = (acc / den[:, None]).astype(o_ref.dtype)
+
+
+def flash_attention_forward(q, k, v, causal: bool = False,
+                            sm_scale: Optional[float] = None,
+                            block_q: int = 256, block_k: int = 512,
+                            interpret: bool = False):
+    """Pallas flash-attention forward. q,k,v: [B,H,T,D]; T must be padded to
+    the block sizes by the caller (`flash_attention` handles it)."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, h, tq, d = q.shape
+    tk = k.shape[2]
+    sm_scale = sm_scale or d ** -0.5
+    block_q = min(block_q, tq)
+    block_k = min(block_k, tk)
+    assert tq % block_q == 0 and tk % block_k == 0
+    bh = b * h
+    qr = q.reshape(bh, tq, d)
+    kr = k.reshape(bh, tk, d)
+    vr = v.reshape(bh, tk, d)
+
+    kernel = functools.partial(_flash_fwd_kernel, block_k=block_k,
+                               sm_scale=sm_scale, causal=causal, seq_k=tk)
+    out = pl.pallas_call(
+        kernel,
+        grid=(bh, tq // block_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((1, tk, d), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((1, tk, d), lambda i, j: (i, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, tq, d), q.dtype),
+        interpret=interpret,
+    )(qr, kr, vr)
+    return out.reshape(b, h, tq, d)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def flash_attention(q, k, v, causal: bool = False,
+                    sm_scale: Optional[float] = None,
+                    use_pallas: Optional[bool] = None):
+    """Flash attention: Pallas forward on TPU, blockwise-XLA backward.
+
+    `use_pallas=None` auto-detects (TPU backend -> pallas kernel)."""
+    return _flash_impl(q, k, v, causal, sm_scale, use_pallas)
+
+
+def _flash_impl(q, k, v, causal, sm_scale, use_pallas):
+    if use_pallas is None:
+        use_pallas = jax.default_backend() == "tpu"
+    if not use_pallas:
+        return blockwise_attention(q, k, v, causal=causal, sm_scale=sm_scale)
+    b, h, t, d = q.shape
+    tk = k.shape[2]
+    bq, bk = min(256, _ceil_to(t, 8)), min(512, _ceil_to(tk, 8))
+    pq, pk = _ceil_to(t, bq) - t, _ceil_to(tk, bk) - tk
+    qp = jnp.pad(q, ((0, 0), (0, 0), (0, pq), (0, 0))) if pq else q
+    kp = jnp.pad(k, ((0, 0), (0, 0), (0, pk), (0, 0))) if pk else k
+    vp = jnp.pad(v, ((0, 0), (0, 0), (0, pk), (0, 0))) if pk else v
+    if pk and (not causal or t > tk):
+        # padded keys must never receive weight; the causal mask only hides
+        # them when every query position is < tk (self-attention). Otherwise
+        # fall back to the XLA path, which masks the ragged tail exactly.
+        return blockwise_attention(q, k, v, causal=causal, sm_scale=sm_scale)
+    out = flash_attention_forward(qp, kp, vp, causal=causal,
+                                  sm_scale=sm_scale, block_q=bq, block_k=bk)
+    return out[:, :, :t]
+
+
+def _flash_fwd_rule(q, k, v, causal, sm_scale, use_pallas):
+    out = _flash_impl(q, k, v, causal, sm_scale, use_pallas)
+    return out, (q, k, v)
+
+
+def _flash_bwd_rule(causal, sm_scale, use_pallas, res, g):
+    q, k, v = res
+    _, vjp = jax.vjp(
+        lambda q_, k_, v_: blockwise_attention(q_, k_, v_, causal=causal,
+                                               sm_scale=sm_scale), q, k, v)
+    return vjp(g)
+
+
+flash_attention.defvjp(_flash_fwd_rule, _flash_bwd_rule)

@@ -1,3 +1,6 @@
 from bigdl_tpu.parallel.mesh import (build_mesh, data_sharding,
                                      replicate_sharding)
 from bigdl_tpu.parallel.sharding import (ShardingRules, infer_param_specs)
+from bigdl_tpu.parallel.sequence import (SequenceParallelAttention,
+                                         make_sequence_parallel_attention,
+                                         ring_attention, ulysses_attention)

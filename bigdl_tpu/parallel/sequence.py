@@ -1,0 +1,134 @@
+"""Sequence/context parallelism: ring attention and Ulysses all-to-all.
+
+Net-new vs the reference (SURVEY.md §5.7 marks long-context absent in
+BigDL); first-class here because it shapes the core design. Two schemes,
+both SPMD over a mesh 'sequence' axis:
+
+- **Ring attention**: Q stays put, KV shards rotate around the ring via
+  `lax.ppermute` (XLA lowers to ICI neighbor sends); each hop continues the
+  SAME online softmax by carrying (acc, m, l) accumulators from
+  ops/attention_kernel.blockwise_attention. Memory O(T/n) per device,
+  exact — not an approximation.
+- **Ulysses**: all-to-all swaps sequence sharding for head sharding, runs
+  dense local attention, swaps back. Cheaper collectives when
+  n_heads >= n_devices; ring wins for very long T.
+
+Use inside `shard_map` over a Mesh axis (helpers below build the mapped fn).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from bigdl_tpu.ops.attention_kernel import (attention_state_finish,
+                                            blockwise_attention)
+
+
+def ring_attention(q, k, v, axis_name: str, causal: bool = False,
+                   sm_scale: Optional[float] = None, block_k: int = 512,
+                   axis_size: Optional[int] = None):
+    """Exact attention with sequence-sharded q/k/v ([B,H,T/n,D] per device).
+
+    Must run inside shard_map/pmap with `axis_name` a mesh axis laid out on
+    the ring. Each device computes its Q block against every KV shard as the
+    shards rotate; causal masking uses global offsets so semantics match the
+    unsharded computation exactly.
+    """
+    n = axis_size if axis_size is not None else int(lax.psum(1, axis_name))
+    idx = lax.axis_index(axis_name)
+    t_local = q.shape[2]
+    sm_scale = sm_scale or q.shape[-1] ** -0.5
+
+    q_offset = idx * t_local
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    from bigdl_tpu.ops.attention_kernel import attention_state_init
+    state = attention_state_init(q.astype(jnp.float32))
+    k_cur, v_cur = k, v
+    # unrolled python loop: n is static (the mesh size), which keeps each
+    # ppermute visible to XLA's collective scheduler for compute/comm overlap
+    for i in range(n):
+        src = (idx - i) % n  # device where the held KV shard originated
+        state = blockwise_attention(
+            q, k_cur, v_cur, causal=causal, sm_scale=sm_scale,
+            block_k=block_k, q_offset=q_offset, k_offset=src * t_local,
+            carry=state, finish=False)
+        if i + 1 < n:  # last hop needs no rotation
+            k_cur = lax.ppermute(k_cur, axis_name, perm)
+            v_cur = lax.ppermute(v_cur, axis_name, perm)
+    out = attention_state_finish(*state)
+    return out.astype(q.dtype)
+
+
+def ulysses_attention(q, k, v, axis_name: str, causal: bool = False,
+                      sm_scale: Optional[float] = None):
+    """All-to-all sequence parallelism (DeepSpeed-Ulysses style).
+
+    In: [B, H, T/n, D] sequence-sharded. all_to_all regroups to
+    [B, H/n, T, D] (full sequence, subset of heads), dense flash attention
+    locally, then the inverse all_to_all restores sequence sharding.
+    Requires n_head % n_devices == 0."""
+    n = lax.psum(1, axis_name)
+    b, h, t_loc, d = q.shape
+    if h % n:
+        raise ValueError(f"n_head {h} must divide by axis size {n}")
+
+    def scatter_heads(x):
+        # [B,H,Tl,D] -> [B,H/n,Tl*n,D]: split heads across devices, gather seq
+        return lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2,
+                              tiled=True)
+
+    def gather_heads(x):
+        return lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
+                              tiled=True)
+
+    qh, kh, vh = scatter_heads(q), scatter_heads(k), scatter_heads(v)
+    o = blockwise_attention(qh, kh, vh, causal=causal, sm_scale=sm_scale)
+    return gather_heads(o).astype(q.dtype)
+
+
+def make_sequence_parallel_attention(mesh: Mesh, scheme: str = "ring",
+                                     axis_name: str = "data",
+                                     causal: bool = False):
+    """Build a jit-ready fn(q, k, v) -> out with q,k,v sequence-sharded over
+    `axis_name`. q,k,v/out are [B,H,T,D] global arrays."""
+    try:
+        from jax import shard_map
+    except ImportError:  # older jax
+        from jax.experimental.shard_map import shard_map
+    if scheme not in ("ring", "ulysses"):
+        raise ValueError(f"scheme must be ring|ulysses, got {scheme}")
+    if scheme == "ring":
+        fn = functools.partial(ring_attention, axis_name=axis_name,
+                               causal=causal,
+                               axis_size=int(mesh.shape[axis_name]))
+    else:
+        fn = functools.partial(ulysses_attention, axis_name=axis_name,
+                               causal=causal)
+    spec = P(None, None, axis_name, None)
+
+    mapped = shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
+                       out_specs=spec)
+    return mapped
+
+
+class SequenceParallelAttention:
+    """Module-flavoured wrapper: holds the mesh + scheme, exposes
+    __call__(q, k, v). (Thin; the sharded projections live in the model's
+    pjit partitioning, matching the scaling-book recipe of annotating
+    shardings and letting XLA insert collectives.)"""
+
+    def __init__(self, mesh: Mesh, scheme: str = "ring",
+                 axis_name: str = "data", causal: bool = False):
+        self.fn = make_sequence_parallel_attention(mesh, scheme, axis_name,
+                                                   causal)
+        self.mesh, self.axis_name = mesh, axis_name
+
+    def __call__(self, q, k, v):
+        return self.fn(q, k, v)

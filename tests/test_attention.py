@@ -1,0 +1,193 @@
+"""Attention + sequence-parallel tests.
+
+Correctness oracle = naive O(T^2) attention; ring/Ulysses run on the
+virtual 8-device CPU mesh (conftest) and must match the unsharded result
+exactly (same online softmax, fp32 accumulation).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import bigdl_tpu.nn as nn
+from bigdl_tpu.ops.attention_kernel import (blockwise_attention,
+                                            flash_attention,
+                                            flash_attention_forward,
+                                            naive_attention)
+from bigdl_tpu.parallel.mesh import build_mesh
+from bigdl_tpu.parallel.sequence import make_sequence_parallel_attention
+
+
+def _qkv(b=2, h=4, t=64, d=16, seed=0):
+    rs = np.random.RandomState(seed)
+    mk = lambda: jnp.asarray(rs.randn(b, h, t, d).astype(np.float32))
+    return mk(), mk(), mk()
+
+
+class TestBlockwise:
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_matches_naive(self, causal):
+        q, k, v = _qkv()
+        ref = naive_attention(q, k, v, causal=causal)
+        out = blockwise_attention(q, k, v, causal=causal, block_k=16)
+        np.testing.assert_allclose(out, ref, atol=1e-5)
+
+    def test_ragged_tail_block(self):
+        q, k, v = _qkv(t=50)  # 50 % 16 != 0 -> tail path
+        ref = naive_attention(q, k, v)
+        out = blockwise_attention(q, k, v, block_k=16)
+        np.testing.assert_allclose(out, ref, atol=1e-5)
+
+    def test_cross_attention_lengths(self):
+        rs = np.random.RandomState(1)
+        q = jnp.asarray(rs.randn(2, 2, 10, 8).astype(np.float32))
+        k = jnp.asarray(rs.randn(2, 2, 33, 8).astype(np.float32))
+        v = jnp.asarray(rs.randn(2, 2, 33, 8).astype(np.float32))
+        ref = naive_attention(q, k, v)
+        out = blockwise_attention(q, k, v, block_k=8)
+        np.testing.assert_allclose(out, ref, atol=1e-5)
+
+    def test_grad_flows(self):
+        q, k, v = _qkv(t=32)
+
+        def f(q, k, v):
+            return blockwise_attention(q, k, v, causal=True,
+                                       block_k=8).sum()
+
+        gq, gk, gv = jax.grad(f, argnums=(0, 1, 2))(q, k, v)
+
+        def fr(q, k, v):
+            return naive_attention(q, k, v, causal=True).sum()
+
+        rq, rk, rv = jax.grad(fr, argnums=(0, 1, 2))(q, k, v)
+        np.testing.assert_allclose(gq, rq, atol=1e-4)
+        np.testing.assert_allclose(gk, rk, atol=1e-4)
+        np.testing.assert_allclose(gv, rv, atol=1e-4)
+
+
+class TestPallasFlash:
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_kernel_interpret_matches_naive(self, causal):
+        q, k, v = _qkv(t=64, d=16)
+        ref = naive_attention(q, k, v, causal=causal)
+        out = flash_attention_forward(q, k, v, causal=causal,
+                                      block_q=16, block_k=16, interpret=True)
+        np.testing.assert_allclose(out, ref, atol=1e-5)
+
+    def test_flash_wrapper_cpu_path(self):
+        q, k, v = _qkv(t=40)
+        ref = naive_attention(q, k, v, causal=True)
+        out = flash_attention(q, k, v, True, None, False)
+        np.testing.assert_allclose(out, ref, atol=1e-5)
+
+    def test_flash_backward(self):
+        q, k, v = _qkv(t=32)
+        g = jax.grad(lambda q: flash_attention(q, k, v, True, None,
+                                               False).sum())(q)
+        gr = jax.grad(lambda q: naive_attention(q, k, v,
+                                                causal=True).sum())(q)
+        np.testing.assert_allclose(g, gr, atol=1e-4)
+
+
+class TestLayers:
+    def test_mha_self_attention_shapes_and_grad(self):
+        m = nn.MultiHeadAttention(32, 4, causal=True)
+        x = jnp.asarray(np.random.RandomState(0)
+                        .randn(2, 10, 32).astype(np.float32))
+        params = m.init(jax.random.PRNGKey(0))
+        out = m.apply(params, x, __import__(
+            "bigdl_tpu.nn.module", fromlist=["m"]).ApplyContext())
+        assert out.shape == (2, 10, 32)
+        g = jax.grad(lambda p: (m.apply(p, x, __import__(
+            "bigdl_tpu.nn.module", fromlist=["m"]).ApplyContext()) ** 2)
+            .sum())(params)
+        assert all(np.all(np.isfinite(l))
+                   for l in jax.tree_util.tree_leaves(g))
+
+    def test_mha_causality(self):
+        # causal: output at t must not depend on inputs after t
+        m = nn.MultiHeadAttention(16, 2, causal=True, use_flash=False)
+        params = m.init(jax.random.PRNGKey(1))
+        from bigdl_tpu.nn.module import ApplyContext
+        x = jnp.asarray(np.random.RandomState(2)
+                        .randn(1, 8, 16).astype(np.float32))
+        o1 = m.apply(params, x, ApplyContext())
+        x2 = x.at[:, -1].set(99.0)
+        o2 = m.apply(params, x2, ApplyContext())
+        np.testing.assert_allclose(o1[:, :-1], o2[:, :-1], atol=1e-5)
+
+    def test_mha_cross_attention(self):
+        from bigdl_tpu.utils.table import T
+        m = nn.MultiHeadAttention(16, 2)
+        params = m.init(jax.random.PRNGKey(0))
+        from bigdl_tpu.nn.module import ApplyContext
+        rs = np.random.RandomState(0)
+        q = jnp.asarray(rs.randn(2, 5, 16).astype(np.float32))
+        kv = jnp.asarray(rs.randn(2, 9, 16).astype(np.float32))
+        out = m.apply(params, T(q, kv), ApplyContext())
+        assert out.shape == (2, 5, 16)
+
+    def test_rope_rotation_property(self):
+        # RoPE: dot(q_i, k_j) depends only on i - j
+        d = 8
+        rs = np.random.RandomState(0)
+        q = jnp.asarray(rs.randn(1, 1, 16, d).astype(np.float32))
+        k = jnp.asarray(rs.randn(1, 1, 16, d).astype(np.float32))
+        qr, kr = nn.rope(q), nn.rope(k)
+        s = jnp.einsum("bhqd,bhkd->bhqk", qr, kr)[0, 0]
+        # same relative offset, same base vectors -> same score: compare
+        # (i=5,j=3) built from constant vectors
+        qc = jnp.tile(q[:, :, :1], (1, 1, 16, 1))
+        kc = jnp.tile(k[:, :, :1], (1, 1, 16, 1))
+        sc = jnp.einsum("bhqd,bhkd->bhqk", nn.rope(qc), nn.rope(kc))[0, 0]
+        np.testing.assert_allclose(sc[5, 3], sc[9, 7], atol=1e-4)
+        np.testing.assert_allclose(sc[5, 3], sc[14, 12], atol=1e-4)
+
+    def test_transformer_block_trains(self):
+        blk = nn.TransformerBlock(16, 2, causal=True)
+        params = blk.init(jax.random.PRNGKey(0))
+        from bigdl_tpu.nn.module import ApplyContext
+        x = jnp.asarray(np.random.RandomState(0)
+                        .randn(2, 6, 16).astype(np.float32))
+
+        @jax.jit
+        def loss(p):
+            return (blk.apply(p, x, ApplyContext()) ** 2).sum()
+
+        g = jax.grad(loss)(params)
+        assert all(np.all(np.isfinite(l))
+                   for l in jax.tree_util.tree_leaves(g))
+
+
+class TestSequenceParallel:
+    @pytest.mark.parametrize("scheme", ["ring", "ulysses"])
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_matches_unsharded(self, scheme, causal):
+        mesh = build_mesh(data=8, model=1)
+        q, k, v = _qkv(b=2, h=8, t=64, d=16)
+        ref = naive_attention(q, k, v, causal=causal)
+        fn = make_sequence_parallel_attention(mesh, scheme=scheme,
+                                              axis_name="data",
+                                              causal=causal)
+        out = jax.jit(fn)(q, k, v)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=1e-4)
+
+    def test_ring_grad_matches(self):
+        mesh = build_mesh(data=4, model=2)
+        q, k, v = _qkv(b=1, h=4, t=32, d=8)
+        fn = make_sequence_parallel_attention(mesh, scheme="ring",
+                                              axis_name="data", causal=True)
+        g = jax.grad(lambda q: jax.jit(fn)(q, k, v).sum())(q)
+        gr = jax.grad(lambda q: naive_attention(q, k, v,
+                                                causal=True).sum())(q)
+        np.testing.assert_allclose(np.asarray(g), np.asarray(gr), atol=1e-4)
+
+    def test_ulysses_head_divisibility_error(self):
+        mesh = build_mesh(data=8, model=1)
+        q, k, v = _qkv(b=1, h=4, t=64, d=8)  # 4 heads, 8 devices
+        fn = make_sequence_parallel_attention(mesh, scheme="ulysses",
+                                              axis_name="data")
+        with pytest.raises(ValueError):
+            jax.jit(fn)(q, k, v)
