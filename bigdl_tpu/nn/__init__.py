@@ -6,18 +6,24 @@ from bigdl_tpu.nn.module import (Activity, ApplyContext, Module, Node,
 from bigdl_tpu.nn.containers import (Bottle, CAddTable, CAveTable, CDivTable,
                                      CMaxTable, CMinTable, CMulTable, CSubTable,
                                      Concat, ConcatTable, Container, Echo,
-                                     FlattenTable, Graph, Identity, Input,
+                                     BifurcateSplitTable, FlattenTable, Graph, Identity, Input,
                                      InputNode, JoinTable, MapTable,
                                      MixtureTable, NarrowTable, ParallelTable,
                                      SelectTable, Sequential, SplitTable)
 from bigdl_tpu.nn.linear import (Add, AddConstant, Bilinear, CAdd, CMul,
                                  Cosine, Euclidean, Highway, Linear, Maxout,
                                  Mul, MulConstant, Scale)
-from bigdl_tpu.nn.conv import (LocallyConnected2D, SpatialConvolution,
+from bigdl_tpu.nn.conv import (LocallyConnected1D, LocallyConnected2D,
+                               SpatialConvolution, SpatialConvolutionMap,
                                SpatialDilatedConvolution, SpatialFullConvolution,
                                SpatialSeparableConvolution,
                                SpatialShareConvolution, TemporalConvolution,
-                               VolumetricConvolution)
+                               VolumetricConvolution, VolumetricFullConvolution)
+from bigdl_tpu.nn.detection import (Anchor, DetectionOutputFrcnn,
+                                    DetectionOutputSSD, Nms, PriorBox, Proposal,
+                                    RoiPooling, bbox_iou, bbox_transform_inv,
+                                    clip_boxes, nms_mask)
+from bigdl_tpu.nn.tree import BinaryTreeLSTM, TreeLSTM
 from bigdl_tpu.nn.pooling import (Pooler, ResizeBilinear, SpatialAveragePooling,
                                   SpatialCrossMapLRN, SpatialMaxPooling,
                                   TemporalMaxPooling, UpSampling1D, UpSampling2D,
@@ -25,7 +31,11 @@ from bigdl_tpu.nn.pooling import (Pooler, ResizeBilinear, SpatialAveragePooling,
                                   VolumetricMaxPooling)
 from bigdl_tpu.nn.normalization import (BatchNormalization, LayerNormalization,
                                         Normalize, NormalizeScale,
-                                        SpatialBatchNormalization)
+                                        SpatialBatchNormalization,
+                                        SpatialContrastiveNormalization,
+                                        SpatialDivisiveNormalization,
+                                        SpatialSubtractiveNormalization,
+                                        SpatialWithinChannelLRN)
 from bigdl_tpu.nn.activation import (ELU, GELU, Abs, BinaryThreshold, Clamp,
                                      Exp, GradientReversal, HardShrink,
                                      HardSigmoid, HardTanh, LeakyReLU, Log,
@@ -48,12 +58,14 @@ from bigdl_tpu.nn.shape_ops import (MM, MV, ActivityRegularization, Contiguous,
                                     View)
 from bigdl_tpu.nn.embedding import (LookupTable, LookupTableSparse,
                                     SparseJoinTable, SparseLinear)
-from bigdl_tpu.nn.recurrent import (BiRecurrent, Cell, ConvLSTMPeephole, GRU,
+from bigdl_tpu.nn.recurrent import (BiRecurrent, Cell, ConvLSTMPeephole,
+                                    ConvLSTMPeephole3D, LSTM2, GRU,
                                     GRUCell, LSTM, LSTMCell, LSTMPeephole,
                                     LSTMPeepholeCell, MultiRNNCell, Recurrent,
                                     RecurrentDecoder, RnnCell, TimeDistributed)
 from bigdl_tpu.nn import criterion
 from bigdl_tpu.nn.criterion import (AbsCriterion, BCECriterion,
+                                    CategoricalCrossEntropy,
                                     BCECriterionWithLogits, ClassNLLCriterion,
                                     CosineDistanceCriterion,
                                     CosineEmbeddingCriterion,

@@ -326,3 +326,18 @@ class Echo(Module):
         shape = getattr(input, "shape", None)
         print(f"[Echo {self.name}] shape={shape}")
         return input
+
+
+class BifurcateSplitTable(Module):
+    """Split a tensor into two halves along `axis`
+    (DL/nn/BifurcateSplitTable.scala; 0-based axis here)."""
+
+    def __init__(self, axis: int = 1, name=None):
+        super().__init__(name)
+        self.axis = axis
+
+    def apply(self, params, input, ctx):
+        n = input.shape[self.axis]
+        left = n // 2
+        a, b = jnp.split(input, [left], axis=self.axis)
+        return T(a, b)

@@ -340,3 +340,129 @@ class LocallyConnected2D(Module):
         if self.with_bias:
             y = y + params["bias"]
         return y
+
+
+class VolumetricFullConvolution(Module):
+    """3-D transposed convolution over [B, D, H, W, C]
+    (DL/nn/VolumetricFullConvolution.scala, NCDHW in the reference).
+    Output size per axis: (in-1)*stride - 2*pad + kernel + adj."""
+
+    def __init__(self, n_input_plane: int, n_output_plane: int,
+                 kt: int, kw: int, kh: int, dt: int = 1, dw: int = 1, dh: int = 1,
+                 pad_t: int = 0, pad_w: int = 0, pad_h: int = 0,
+                 adj_t: int = 0, adj_w: int = 0, adj_h: int = 0,
+                 with_bias: bool = True, name=None):
+        super().__init__(name)
+        self.n_in, self.n_out = n_input_plane, n_output_plane
+        self.k = (kt, kh, kw)
+        self.s = (dt, dh, dw)
+        self.p = (pad_t, pad_h, pad_w)
+        self.adj = (adj_t, adj_h, adj_w)
+        self.with_bias = with_bias
+
+    def init(self, rng):
+        k1, _ = jax.random.split(rng)
+        p = {"weight": Xavier()(k1, self.k + (self.n_out, self.n_in))}
+        if self.with_bias:
+            p["bias"] = jnp.zeros((self.n_out,))
+        return p
+
+    def apply(self, params, input, ctx):
+        pads = tuple((k - 1 - p, k - 1 - p + a)
+                     for k, p, a in zip(self.k, self.p, self.adj))
+        w = jnp.swapaxes(jnp.flip(params["weight"], (0, 1, 2)), 3, 4)
+        y = lax.conv_general_dilated(
+            input, w, window_strides=(1, 1, 1), padding=pads,
+            lhs_dilation=self.s,
+            dimension_numbers=("NDHWC", "DHWIO", "NDHWC"))
+        if self.with_bias:
+            y = y + params["bias"]
+        return y
+
+
+class LocallyConnected1D(Module):
+    """Unshared-weights 1-D conv over [B, T, C]
+    (DL/nn/LocallyConnected1D.scala). Same patch-einsum formulation as the
+    2-D variant."""
+
+    def __init__(self, n_input_frame: int, input_frame_size: int,
+                 output_frame_size: int, kernel_w: int, stride_w: int = 1,
+                 with_bias: bool = True, name=None):
+        super().__init__(name)
+        self.n_frames = n_input_frame
+        self.c_in, self.c_out = input_frame_size, output_frame_size
+        self.kw, self.sw = kernel_w, stride_w
+        self.with_bias = with_bias
+        self.ot = (n_input_frame - kernel_w) // stride_w + 1
+
+    def init(self, rng):
+        k1, _ = jax.random.split(rng)
+        fan_in = self.kw * self.c_in
+        stdv = 1.0 / math.sqrt(fan_in)
+        p = {"weight": jax.random.uniform(
+            k1, (self.ot, self.kw * self.c_in, self.c_out),
+            minval=-stdv, maxval=stdv)}
+        if self.with_bias:
+            p["bias"] = jnp.zeros((self.ot, self.c_out))
+        return p
+
+    def apply(self, params, input, ctx):
+        patches = lax.conv_general_dilated_patches(
+            input[:, :, None, :], (self.kw, 1), (self.sw, 1), padding="VALID",
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))[:, :, 0, :]
+        y = jnp.einsum("btk,tko->bto", patches, params["weight"])
+        if self.with_bias:
+            y = y + params["bias"]
+        return y
+
+
+class SpatialConvolutionMap(Module):
+    """Convolution with an explicit input→output connection table
+    (DL/nn/SpatialConvolutionMap.scala, the classic LeNet C3 sparse
+    connectivity). `conn_table` is an [K, 2] array of (in_plane, out_plane)
+    1-based pairs. TPU formulation: a full conv with the kernel masked to
+    the table — the MXU prefers one dense conv over K tiny gathers.
+    """
+
+    def __init__(self, conn_table, kw: int, kh: int, dw: int = 1, dh: int = 1,
+                 pad_w: int = 0, pad_h: int = 0, name=None):
+        super().__init__(name)
+        import numpy as _np
+        tbl = _np.asarray(conn_table, _np.int64)
+        self.n_in = int(tbl[:, 0].max())
+        self.n_out = int(tbl[:, 1].max())
+        mask = _np.zeros((self.n_in, self.n_out), _np.float32)
+        mask[tbl[:, 0] - 1, tbl[:, 1] - 1] = 1.0
+        self.mask = jnp.asarray(mask)
+        self.kw, self.kh, self.dw, self.dh = kw, kh, dw, dh
+        self.pad_w, self.pad_h = pad_w, pad_h
+
+    @staticmethod
+    def full(n_in: int, n_out: int):
+        """Full connection table (SpatialConvolutionMap.full parity)."""
+        import numpy as _np
+        ii, oo = _np.meshgrid(_np.arange(1, n_in + 1), _np.arange(1, n_out + 1))
+        return _np.stack([ii.ravel(), oo.ravel()], axis=1)
+
+    @staticmethod
+    def one_to_one(n: int):
+        import numpy as _np
+        r = _np.arange(1, n + 1)
+        return _np.stack([r, r], axis=1)
+
+    def init(self, rng):
+        k1, _ = jax.random.split(rng)
+        fan_in = float(jnp.sum(self.mask, axis=0).max()) * self.kw * self.kh
+        stdv = 1.0 / math.sqrt(fan_in)
+        return {"weight": jax.random.uniform(
+            k1, (self.kh, self.kw, self.n_in, self.n_out),
+            minval=-stdv, maxval=stdv),
+            "bias": jnp.zeros((self.n_out,))}
+
+    def apply(self, params, input, ctx):
+        w = params["weight"] * self.mask[None, None, :, :]
+        y = lax.conv_general_dilated(
+            input, w, window_strides=(self.dh, self.dw),
+            padding=[(self.pad_h, self.pad_h), (self.pad_w, self.pad_w)],
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        return y + params["bias"]

@@ -556,3 +556,18 @@ class TransformerCriterion(Criterion):
         if self.target_transformer is not None:
             target = self.target_transformer.forward(target)
         return self.criterion.loss(output, target)
+
+
+class CategoricalCrossEntropy(Criterion):
+    """Cross entropy against one-hot (or probability) targets over
+    softmax-normalized input (DL/nn/CategoricalCrossEntropy.scala — the
+    Keras-parity criterion; target is a distribution, not a class index)."""
+
+    def __init__(self, eps: float = 1e-8):
+        super().__init__()
+        self.eps = eps
+
+    def loss(self, input, target):
+        p = jax.nn.softmax(input, axis=-1)
+        ll = jnp.sum(target * jnp.log(p + self.eps), axis=-1)
+        return -jnp.mean(ll)

@@ -1,0 +1,424 @@
+"""Object-detection layers.
+
+Parity targets (reference): Anchor (DL/nn/Anchor.scala), PriorBox
+(DL/nn/PriorBox.scala), Nms (DL/nn/Nms.scala), Proposal
+(DL/nn/Proposal.scala), RoiPooling (DL/nn/RoiPooling.scala),
+DetectionOutputSSD (DL/nn/DetectionOutputSSD.scala), DetectionOutputFrcnn
+(DL/nn/DetectionOutputFrcnn.scala), plus bbox helpers
+(DL/transform/vision/image/util/BboxUtil.scala).
+
+TPU-first design notes: the reference implements NMS and proposal filtering
+with data-dependent Scala loops producing variable-length outputs. Under XLA
+everything must be static-shape, so this module returns FIXED-size results
+(`max_out` boxes) plus a validity mask / count, and NMS is an O(N^2)
+mask-matrix suppression (score-sorted greedy via `lax.fori_loop` over a
+boolean keep-vector) — the standard TPU formulation: all pairwise IoUs are
+one [N, N] matmul-shaped op on the MXU-friendly path rather than a host
+loop. Boxes use corner format (x1, y1, x2, y2) throughout.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from bigdl_tpu.nn.module import ApplyContext, Module
+from bigdl_tpu.utils.table import Table, T
+
+
+# --------------------------------------------------------------------------- #
+# bbox utilities (BboxUtil parity)
+# --------------------------------------------------------------------------- #
+
+def bbox_area(boxes: jnp.ndarray) -> jnp.ndarray:
+    """Area of corner-format boxes [..., 4] (Pascal convention: +1)."""
+    w = jnp.maximum(boxes[..., 2] - boxes[..., 0] + 1.0, 0.0)
+    h = jnp.maximum(boxes[..., 3] - boxes[..., 1] + 1.0, 0.0)
+    return w * h
+
+
+def bbox_iou(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """Pairwise IoU matrix [Na, Nb] of corner boxes (BboxUtil.jaccard)."""
+    lt = jnp.maximum(a[:, None, :2], b[None, :, :2])
+    rb = jnp.minimum(a[:, None, 2:], b[None, :, 2:])
+    wh = jnp.maximum(rb - lt + 1.0, 0.0)
+    inter = wh[..., 0] * wh[..., 1]
+    union = bbox_area(a)[:, None] + bbox_area(b)[None, :] - inter
+    return jnp.where(union > 0, inter / union, 0.0)
+
+
+def bbox_transform_inv(boxes: jnp.ndarray, deltas: jnp.ndarray) -> jnp.ndarray:
+    """Apply (dx, dy, dw, dh) regression deltas to corner boxes
+    (BboxUtil.bboxTransformInv / Faster-RCNN decoding)."""
+    widths = boxes[:, 2] - boxes[:, 0] + 1.0
+    heights = boxes[:, 3] - boxes[:, 1] + 1.0
+    cx = boxes[:, 0] + 0.5 * (widths - 1.0)
+    cy = boxes[:, 1] + 0.5 * (heights - 1.0)
+    dx, dy, dw, dh = deltas[:, 0], deltas[:, 1], deltas[:, 2], deltas[:, 3]
+    pred_cx = dx * widths + cx
+    pred_cy = dy * heights + cy
+    pred_w = jnp.exp(dw) * widths
+    pred_h = jnp.exp(dh) * heights
+    return jnp.stack([pred_cx - 0.5 * (pred_w - 1.0),
+                      pred_cy - 0.5 * (pred_h - 1.0),
+                      pred_cx + 0.5 * (pred_w - 1.0),
+                      pred_cy + 0.5 * (pred_h - 1.0)], axis=1)
+
+
+def clip_boxes(boxes: jnp.ndarray, height: float, width: float) -> jnp.ndarray:
+    """Clip corner boxes to the image (BboxUtil.clipBoxes)."""
+    x1 = jnp.clip(boxes[..., 0], 0.0, width - 1.0)
+    y1 = jnp.clip(boxes[..., 1], 0.0, height - 1.0)
+    x2 = jnp.clip(boxes[..., 2], 0.0, width - 1.0)
+    y2 = jnp.clip(boxes[..., 3], 0.0, height - 1.0)
+    return jnp.stack([x1, y1, x2, y2], axis=-1)
+
+
+def nms_mask(boxes: jnp.ndarray, scores: jnp.ndarray, iou_threshold: float,
+             valid: Optional[jnp.ndarray] = None) -> jnp.ndarray:
+    """Greedy score-ordered NMS over a FIXED box count.
+
+    Returns a boolean keep mask aligned with the input order. The pairwise
+    IoU matrix is computed once; the sequential greedy dependency runs in a
+    `lax.fori_loop` over the score ranking (static trip count), which XLA
+    unrolls on-device — no host sync, no dynamic shapes.
+    """
+    n = boxes.shape[0]
+    order = jnp.argsort(-scores)
+    iou = bbox_iou(boxes, boxes)
+    valid_v = jnp.ones((n,), bool) if valid is None else valid
+
+    def body(i, keep):
+        idx = order[i]
+        # suppressed if any higher-ranked kept box overlaps too much
+        higher = jnp.arange(n) < i
+        overlap = iou[idx, order] > iou_threshold
+        suppressed = jnp.any(higher & keep[order] & overlap)
+        ok = valid_v[idx] & ~suppressed
+        return keep.at[idx].set(ok)
+
+    return lax.fori_loop(0, n, body, jnp.zeros((n,), bool))
+
+
+class Nms(Module):
+    """Standalone NMS layer (DL/nn/Nms.scala). Input: Table(boxes [N,4],
+    scores [N]); output: keep mask [N] (fixed shape, see module docstring)."""
+
+    def __init__(self, iou_threshold: float = 0.7, name=None):
+        super().__init__(name)
+        self.iou_threshold = iou_threshold
+
+    def apply(self, params, input, ctx: ApplyContext):
+        boxes, scores = input[1], input[2]
+        return nms_mask(boxes, scores, self.iou_threshold)
+
+
+# --------------------------------------------------------------------------- #
+# anchor / prior generation
+# --------------------------------------------------------------------------- #
+
+class Anchor:
+    """RPN anchor generator (DL/nn/Anchor.scala): base anchors from
+    ratios x scales, shifted over the feature-map grid."""
+
+    def __init__(self, ratios: Sequence[float], scales: Sequence[float],
+                 base_size: int = 16):
+        self.ratios = tuple(ratios)
+        self.scales = tuple(scales)
+        self.base_size = base_size
+        self.num = len(self.ratios) * len(self.scales)
+        self._base = self._base_anchors()
+
+    def _base_anchors(self) -> jnp.ndarray:
+        base = self.base_size
+        w, h = float(base), float(base)
+        cx, cy = (base - 1) / 2.0, (base - 1) / 2.0
+        anchors = []
+        size = w * h
+        for r in self.ratios:
+            ws = round(math.sqrt(size / r))
+            hs = round(ws * r)
+            for s in self.scales:
+                wss, hss = ws * s, hs * s
+                anchors.append([cx - (wss - 1) / 2.0, cy - (hss - 1) / 2.0,
+                                cx + (wss - 1) / 2.0, cy + (hss - 1) / 2.0])
+        return jnp.asarray(anchors, jnp.float32)
+
+    def generate(self, height: int, width: int, stride: int = 16) -> jnp.ndarray:
+        """All anchors for an HxW feature map: [H*W*A, 4]."""
+        sx = jnp.arange(width, dtype=jnp.float32) * stride
+        sy = jnp.arange(height, dtype=jnp.float32) * stride
+        shift_x, shift_y = jnp.meshgrid(sx, sy)
+        shifts = jnp.stack([shift_x.ravel(), shift_y.ravel(),
+                            shift_x.ravel(), shift_y.ravel()], axis=1)
+        return (self._base[None, :, :] + shifts[:, None, :]).reshape(-1, 4)
+
+
+class PriorBox(Module):
+    """SSD prior-box layer (DL/nn/PriorBox.scala).
+
+    Input: feature map [B, H, W, C] (NHWC); output: [1, 2, H*W*P*4] —
+    priors row + variances row, matching the reference's output contract.
+    `img_size` must be given statically (TPU: no dynamic image metadata).
+    """
+
+    def __init__(self, min_sizes: Sequence[float],
+                 max_sizes: Optional[Sequence[float]] = None,
+                 aspect_ratios: Optional[Sequence[float]] = None,
+                 flip: bool = True, clip: bool = False,
+                 variances: Sequence[float] = (0.1, 0.1, 0.2, 0.2),
+                 offset: float = 0.5, img_h: int = 300, img_w: int = 300,
+                 step_h: float = 0.0, step_w: float = 0.0, name=None):
+        super().__init__(name)
+        self.min_sizes = tuple(min_sizes)
+        self.max_sizes = tuple(max_sizes or ())
+        ars = [1.0]
+        for ar in (aspect_ratios or ()):
+            if all(abs(ar - e) > 1e-6 for e in ars):
+                ars.append(ar)
+                if flip:
+                    ars.append(1.0 / ar)
+        self.aspect_ratios = ars
+        self.clip = clip
+        self.variances = tuple(variances)
+        self.offset = offset
+        self.img_h, self.img_w = img_h, img_w
+        self.step_h, self.step_w = step_h, step_w
+
+    @property
+    def num_priors(self) -> int:
+        return len(self.aspect_ratios) * len(self.min_sizes) + len(self.max_sizes)
+
+    def apply(self, params, input, ctx: ApplyContext):
+        h, w = input.shape[1], input.shape[2]
+        step_h = self.step_h or self.img_h / h
+        step_w = self.step_w or self.img_w / w
+        cx = (jnp.arange(w, dtype=jnp.float32) + self.offset) * step_w
+        cy = (jnp.arange(h, dtype=jnp.float32) + self.offset) * step_h
+        cxg, cyg = jnp.meshgrid(cx, cy)  # [h, w]
+
+        whs = []  # per-prior (box_w, box_h)
+        for i, ms in enumerate(self.min_sizes):
+            whs.append((ms, ms))
+            if self.max_sizes:
+                mx = self.max_sizes[i]
+                s = math.sqrt(ms * mx)
+                whs.append((s, s))
+            for ar in self.aspect_ratios:
+                if abs(ar - 1.0) < 1e-6:
+                    continue
+                whs.append((ms * math.sqrt(ar), ms / math.sqrt(ar)))
+        bw = jnp.asarray([p[0] for p in whs], jnp.float32)
+        bh = jnp.asarray([p[1] for p in whs], jnp.float32)
+        # normalized corner boxes [h, w, P, 4]
+        x1 = (cxg[..., None] - bw / 2.0) / self.img_w
+        y1 = (cyg[..., None] - bh / 2.0) / self.img_h
+        x2 = (cxg[..., None] + bw / 2.0) / self.img_w
+        y2 = (cyg[..., None] + bh / 2.0) / self.img_h
+        priors = jnp.stack([x1, y1, x2, y2], axis=-1)
+        if self.clip:
+            priors = jnp.clip(priors, 0.0, 1.0)
+        flat = priors.reshape(-1)
+        var = jnp.tile(jnp.asarray(self.variances, jnp.float32),
+                       flat.shape[0] // 4)
+        return jnp.stack([flat, var])[None, :, :]
+
+
+# --------------------------------------------------------------------------- #
+# proposal / ROI layers
+# --------------------------------------------------------------------------- #
+
+class Proposal(Module):
+    """RPN proposal layer (DL/nn/Proposal.scala): decode anchor deltas,
+    clip, NMS, emit a FIXED `post_nms_topn` proposal set [post, 5]
+    (batch-index column + corners) plus padding by the top-scoring boxes.
+
+    Input: Table(cls_scores [1, H, W, 2A], bbox_deltas [1, H, W, 4A],
+    im_info (h, w) static python tuple passed at construction).
+    """
+
+    def __init__(self, pre_nms_topn: int = 6000, post_nms_topn: int = 300,
+                 ratios: Sequence[float] = (0.5, 1.0, 2.0),
+                 scales: Sequence[float] = (8, 16, 32),
+                 rpn_nms_thresh: float = 0.7, min_size: int = 16,
+                 im_h: int = 600, im_w: int = 800, name=None):
+        super().__init__(name)
+        self.pre_nms_topn = pre_nms_topn
+        self.post_nms_topn = post_nms_topn
+        self.anchor = Anchor(ratios, scales)
+        self.nms_thresh = rpn_nms_thresh
+        self.min_size = min_size
+        self.im_h, self.im_w = im_h, im_w
+
+    def apply(self, params, input, ctx: ApplyContext):
+        scores, deltas = input[1], input[2]
+        a = self.anchor.num
+        h, w = scores.shape[1], scores.shape[2]
+        # foreground scores are the second half of the 2A channels
+        fg = scores[0, :, :, a:].reshape(-1)
+        d = deltas[0].reshape(h * w, a, 4).reshape(-1, 4)
+        anchors = self.anchor.generate(h, w)
+        boxes = clip_boxes(bbox_transform_inv(anchors, d), self.im_h, self.im_w)
+        ws = boxes[:, 2] - boxes[:, 0] + 1.0
+        hs = boxes[:, 3] - boxes[:, 1] + 1.0
+        valid = (ws >= self.min_size) & (hs >= self.min_size)
+        fg = jnp.where(valid, fg, -jnp.inf)
+        k = min(self.pre_nms_topn, boxes.shape[0])
+        top_scores, top_idx = lax.top_k(fg, k)
+        top_boxes = boxes[top_idx]
+        keep = nms_mask(top_boxes, top_scores, self.nms_thresh,
+                        valid=top_scores > -jnp.inf)
+        # rank kept boxes first (stable by score since input is sorted)
+        sel = jnp.argsort(~keep, stable=True)[: self.post_nms_topn]
+        out_boxes = top_boxes[sel]
+        batch_col = jnp.zeros((out_boxes.shape[0], 1), out_boxes.dtype)
+        return T(jnp.concatenate([batch_col, out_boxes], axis=1),
+                 keep[sel])
+
+
+class RoiPooling(Module):
+    """ROI max pooling (DL/nn/RoiPooling.scala).
+
+    Input: Table(features [1, H, W, C] NHWC, rois [R, 5] with batch index
+    + corner coords in image scale). Output [R, pooled_h, pooled_w, C].
+    TPU formulation: each bin is a masked max over the feature map — a
+    reduction with a computed mask instead of dynamic slicing, keeping
+    shapes static under jit.
+    """
+
+    def __init__(self, pooled_w: int, pooled_h: int, spatial_scale: float,
+                 name=None):
+        super().__init__(name)
+        self.pooled_w, self.pooled_h = pooled_w, pooled_h
+        self.spatial_scale = spatial_scale
+
+    def apply(self, params, input, ctx: ApplyContext):
+        feat, rois = input[1], input[2]
+        fmap = feat[0]  # [H, W, C]
+        H, W = fmap.shape[0], fmap.shape[1]
+        ys = jnp.arange(H, dtype=jnp.float32)
+        xs = jnp.arange(W, dtype=jnp.float32)
+
+        def pool_one(roi):
+            x1 = jnp.round(roi[1] * self.spatial_scale)
+            y1 = jnp.round(roi[2] * self.spatial_scale)
+            x2 = jnp.round(roi[3] * self.spatial_scale)
+            y2 = jnp.round(roi[4] * self.spatial_scale)
+            rw = jnp.maximum(x2 - x1 + 1.0, 1.0)
+            rh = jnp.maximum(y2 - y1 + 1.0, 1.0)
+            bw, bh = rw / self.pooled_w, rh / self.pooled_h
+
+            py = jnp.arange(self.pooled_h, dtype=jnp.float32)
+            px = jnp.arange(self.pooled_w, dtype=jnp.float32)
+            ys0 = jnp.clip(jnp.floor(py * bh) + y1, 0, H)      # [ph]
+            ys1 = jnp.clip(jnp.ceil((py + 1) * bh) + y1, 0, H)
+            xs0 = jnp.clip(jnp.floor(px * bw) + x1, 0, W)
+            xs1 = jnp.clip(jnp.ceil((px + 1) * bw) + x1, 0, W)
+            # mask [ph, H] / [pw, W]
+            my = (ys[None, :] >= ys0[:, None]) & (ys[None, :] < ys1[:, None])
+            mx = (xs[None, :] >= xs0[:, None]) & (xs[None, :] < xs1[:, None])
+            m = my[:, None, :, None, None] & mx[None, :, None, :, None]
+            vals = jnp.where(m, fmap[None, None, :, :, :], -jnp.inf)
+            out = jnp.max(vals, axis=(2, 3))  # [ph, pw, C]
+            empty = ~jnp.any(m, axis=(2, 3))  # [ph, pw]
+            return jnp.where(empty[..., None], 0.0, out)
+
+        return jax.vmap(pool_one)(rois)
+
+
+def _decode_ssd(loc: jnp.ndarray, priors: jnp.ndarray,
+                variances: jnp.ndarray) -> jnp.ndarray:
+    """Decode SSD loc predictions against priors (both normalized corners)."""
+    pw = priors[:, 2] - priors[:, 0]
+    ph = priors[:, 3] - priors[:, 1]
+    pcx = (priors[:, 0] + priors[:, 2]) / 2.0
+    pcy = (priors[:, 1] + priors[:, 3]) / 2.0
+    cx = variances[:, 0] * loc[:, 0] * pw + pcx
+    cy = variances[:, 1] * loc[:, 1] * ph + pcy
+    w = jnp.exp(variances[:, 2] * loc[:, 2]) * pw
+    h = jnp.exp(variances[:, 3] * loc[:, 3]) * ph
+    return jnp.stack([cx - w / 2, cy - h / 2, cx + w / 2, cy + h / 2], axis=1)
+
+
+class DetectionOutputSSD(Module):
+    """SSD detection head (DL/nn/DetectionOutputSSD.scala).
+
+    Input: Table(loc [B, P*4], conf [B, P*n_classes], priors [1, 2, P*4]).
+    Output: Table(boxes [B, n_classes, keep_topk, 4], scores
+    [B, n_classes, keep_topk], mask same shape) — fixed shapes; class 0 is
+    background and its mask row is all-false.
+    """
+
+    def __init__(self, n_classes: int = 21, nms_thresh: float = 0.45,
+                 nms_topk: int = 400, keep_topk: int = 200,
+                 conf_thresh: float = 0.01, name=None):
+        super().__init__(name)
+        self.n_classes = n_classes
+        self.nms_thresh = nms_thresh
+        self.nms_topk = nms_topk
+        self.keep_topk = keep_topk
+        self.conf_thresh = conf_thresh
+
+    def apply(self, params, input, ctx: ApplyContext):
+        loc, conf, priors = input[1], input[2], input[3]
+        B = loc.shape[0]
+        P = loc.shape[1] // 4
+        pri = priors[0, 0].reshape(P, 4)
+        var = priors[0, 1].reshape(P, 4)
+        conf = jax.nn.softmax(conf.reshape(B, P, self.n_classes), axis=-1)
+
+        def per_image(loc_i, conf_i):
+            boxes = _decode_ssd(loc_i.reshape(P, 4), pri, var)
+            k = min(self.nms_topk, P)
+
+            def per_class(scores_c):
+                s = jnp.where(scores_c > self.conf_thresh, scores_c, -jnp.inf)
+                top_s, top_i = lax.top_k(s, k)
+                b = boxes[top_i]
+                keep = nms_mask(b, top_s, self.nms_thresh, valid=top_s > -jnp.inf)
+                sel = jnp.argsort(~keep, stable=True)[: self.keep_topk]
+                return b[sel], jnp.where(keep[sel], top_s[sel], 0.0), keep[sel]
+
+            return jax.vmap(per_class, in_axes=1)(conf_i)
+
+        b, s, m = jax.vmap(per_image)(loc, conf)
+        m = m.at[:, 0].set(False)  # background class emits nothing
+        return T(b, s, m)
+
+
+class DetectionOutputFrcnn(Module):
+    """Faster-RCNN output head (DL/nn/DetectionOutputFrcnn.scala): per-class
+    bbox decoding + NMS over ROI scores. Input: Table(cls_prob [R, n_cls],
+    bbox_pred [R, n_cls*4], rois [R, 5]); output like DetectionOutputSSD."""
+
+    def __init__(self, n_classes: int = 21, nms_thresh: float = 0.3,
+                 max_per_image: int = 100, thresh: float = 0.05,
+                 im_h: int = 600, im_w: int = 800, name=None):
+        super().__init__(name)
+        self.n_classes = n_classes
+        self.nms_thresh = nms_thresh
+        self.max_per_image = max_per_image
+        self.thresh = thresh
+        self.im_h, self.im_w = im_h, im_w
+
+    def apply(self, params, input, ctx: ApplyContext):
+        cls_prob, bbox_pred, rois = input[1], input[2], input[3]
+        R = rois.shape[0]
+        boxes = rois[:, 1:5]
+
+        def per_class(c_scores, c_deltas):
+            decoded = clip_boxes(bbox_transform_inv(boxes, c_deltas),
+                                 self.im_h, self.im_w)
+            s = jnp.where(c_scores > self.thresh, c_scores, -jnp.inf)
+            keep = nms_mask(decoded, s, self.nms_thresh, valid=s > -jnp.inf)
+            sel = jnp.argsort(jnp.where(keep, -s, jnp.inf))[: self.max_per_image]
+            return decoded[sel], jnp.where(keep[sel], c_scores[sel], 0.0), keep[sel]
+
+        deltas = bbox_pred.reshape(R, self.n_classes, 4)
+        b, s, m = jax.vmap(per_class, in_axes=(1, 1))(cls_prob, deltas)
+        m = m.at[0].set(False)
+        return T(b[None], s[None], m[None])

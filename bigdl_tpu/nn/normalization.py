@@ -140,3 +140,103 @@ class LayerNormalization(Module):
         var = jnp.var(input, axis=-1, keepdims=True)
         y = (input - mean) * jax.lax.rsqrt(var + self.eps)
         return y * params["weight"] + params["bias"]
+
+
+def _gaussian_kernel(size: int, sigma: float = None):
+    """Default smoothing kernel used by the Torch-style normalization layers
+    when none is given (reference passes an explicit kernel tensor)."""
+    sigma = sigma or (size / 4.0)
+    r = jnp.arange(size, dtype=jnp.float32) - (size - 1) / 2.0
+    g = jnp.exp(-(r ** 2) / (2 * sigma ** 2))
+    k = g[:, None] * g[None, :]
+    return k / jnp.sum(k)
+
+
+def _smooth2d(x2d, kernel):
+    """SAME-padded 2-D correlation of [B, H, W] with [kh, kw], plus the
+    border-coefficient map (reference adjusts means near edges by dividing
+    by the local kernel mass, Torch SpatialSubtractiveNormalization)."""
+    kh, kw = kernel.shape
+    k4 = kernel[:, :, None, None]
+    y = jax.lax.conv_general_dilated(
+        x2d[..., None], k4, window_strides=(1, 1), padding="SAME",
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))[..., 0]
+    ones = jnp.ones_like(x2d[:1])
+    coef = jax.lax.conv_general_dilated(
+        ones[..., None], k4, window_strides=(1, 1), padding="SAME",
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))[..., 0]
+    return y / coef
+
+
+class SpatialSubtractiveNormalization(Module):
+    """Subtract the weighted local neighbourhood mean, NHWC
+    (DL/nn/SpatialSubtractiveNormalization.scala). The kernel is normalized
+    to unit mass and averaged across channels, matching Torch semantics."""
+
+    def __init__(self, n_input_plane: int = 1, kernel=None, name=None):
+        super().__init__(name)
+        self.n_input_plane = n_input_plane
+        k = _gaussian_kernel(9) if kernel is None else jnp.asarray(kernel, jnp.float32)
+        if k.ndim == 1:
+            k = k[:, None] * k[None, :]
+        self.kernel = k / jnp.sum(k)
+
+    def _local_mean(self, x):
+        return _smooth2d(jnp.mean(x, axis=-1), self.kernel)
+
+    def apply(self, params, input, ctx):
+        return input - self._local_mean(input)[..., None]
+
+
+class SpatialDivisiveNormalization(Module):
+    """Divide by the weighted local neighbourhood stdev, thresholded by its
+    per-image mean (DL/nn/SpatialDivisiveNormalization.scala)."""
+
+    def __init__(self, n_input_plane: int = 1, kernel=None,
+                 threshold: float = 1e-4, thresval: float = None, name=None):
+        super().__init__(name)
+        self.sub = SpatialSubtractiveNormalization(n_input_plane, kernel)
+        self.threshold = threshold
+        self.thresval = threshold if thresval is None else thresval
+
+    def apply(self, params, input, ctx):
+        local_var = _smooth2d(jnp.mean(input * input, axis=-1), self.sub.kernel)
+        local_std = jnp.sqrt(jnp.maximum(local_var, 0.0))
+        # Torch Threshold(threshold, thresval) semantics: stds at or below
+        # `threshold` are replaced by `thresval` before dividing
+        denom = jnp.where(local_std > self.threshold, local_std, self.thresval)
+        return input / denom[..., None]
+
+
+class SpatialContrastiveNormalization(Module):
+    """Subtractive then divisive normalization
+    (DL/nn/SpatialContrastiveNormalization.scala)."""
+
+    def __init__(self, n_input_plane: int = 1, kernel=None,
+                 threshold: float = 1e-4, thresval: float = None, name=None):
+        super().__init__(name)
+        self.sub = SpatialSubtractiveNormalization(n_input_plane, kernel)
+        self.div = SpatialDivisiveNormalization(n_input_plane, kernel,
+                                                threshold, thresval)
+
+    def apply(self, params, input, ctx):
+        return self.div.apply({}, self.sub.apply({}, input, ctx), ctx)
+
+
+class SpatialWithinChannelLRN(Module):
+    """Within-channel local response normalization over a spatial window,
+    NHWC (DL/nn/SpatialWithinChannelLRN.scala; Caffe WITHIN_CHANNEL LRN):
+    y = x / (1 + alpha/size^2 * avg_window(x^2))^beta."""
+
+    def __init__(self, size: int = 5, alpha: float = 1.0, beta: float = 0.75,
+                 name=None):
+        super().__init__(name)
+        self.size, self.alpha, self.beta = size, alpha, beta
+
+    def apply(self, params, input, ctx):
+        sq = input * input
+        win = jax.lax.reduce_window(
+            sq, 0.0, jax.lax.add, (1, self.size, self.size, 1), (1, 1, 1, 1),
+            "SAME")
+        avg = win / (self.size * self.size)
+        return input / jnp.power(1.0 + self.alpha * avg, self.beta)

@@ -214,17 +214,21 @@ class ConvLSTMPeephole(Cell):
                  kernel_c: int = 3, stride: int = 1, with_peephole: bool = True,
                  name=None):
         super().__init__(name)
+        if stride != 1:
+            raise NotImplementedError(
+                "ConvLSTMPeephole stride != 1 would shrink the state map "
+                "each step; the reference only supports stride 1 in practice")
         self.c_in, self.c_out = input_size, output_size
-        self.k = kernel_i
+        self.ki, self.kc = kernel_i, kernel_c
         self.with_peephole = with_peephole
         self.hidden_size = output_size
 
     def init(self, rng):
         ks = jax.random.split(rng, 5)
-        fan = self.k * self.k * (self.c_in + self.c_out)
+        fan = self.ki * self.ki * (self.c_in + self.c_out)
         stdv = math.sqrt(2.0 / fan)
-        p = {"wi": stdv * jax.random.normal(ks[0], (self.k, self.k, self.c_in, 4 * self.c_out)),
-             "wh": stdv * jax.random.normal(ks[1], (self.k, self.k, self.c_out, 4 * self.c_out)),
+        p = {"wi": stdv * jax.random.normal(ks[0], (self.ki, self.ki, self.c_in, 4 * self.c_out)),
+             "wh": stdv * jax.random.normal(ks[1], (self.kc, self.kc, self.c_out, 4 * self.c_out)),
              "bias": jnp.zeros((4 * self.c_out,))}
         if self.with_peephole:
             p["peep_i"] = jnp.zeros((self.c_out,))
@@ -386,3 +390,63 @@ class TimeDistributed(Module):
         finally:
             ctx.pop()
         return y.reshape((b, t) + y.shape[1:])
+
+
+# Reference LSTM2 (DL/nn/LSTM2.scala) is a re-fused rewrite of LSTM with
+# identical math (one 4-gate GEMM); our LSTMCell is already that formulation.
+LSTM2 = LSTMCell
+
+
+class ConvLSTMPeephole3D(Cell):
+    """3-D convolutional LSTM over NDHWC volumes
+    (DL/nn/ConvLSTMPeephole3D.scala)."""
+
+    def __init__(self, input_size: int, output_size: int, kernel_i: int = 3,
+                 kernel_c: int = 3, stride: int = 1, with_peephole: bool = True,
+                 name=None):
+        super().__init__(name)
+        if stride != 1:
+            raise NotImplementedError(
+                "ConvLSTMPeephole3D stride != 1 would shrink the state map "
+                "each step; the reference only supports stride 1 in practice")
+        self.c_in, self.c_out = input_size, output_size
+        self.ki, self.kc = kernel_i, kernel_c
+        self.with_peephole = with_peephole
+        self.hidden_size = output_size
+
+    def init(self, rng):
+        ks = jax.random.split(rng, 2)
+        fan = self.ki ** 3 * (self.c_in + self.c_out)
+        stdv = math.sqrt(2.0 / fan)
+        p = {"wi": stdv * jax.random.normal(
+                ks[0], (self.ki, self.ki, self.ki, self.c_in, 4 * self.c_out)),
+             "wh": stdv * jax.random.normal(
+                ks[1], (self.kc, self.kc, self.kc, self.c_out, 4 * self.c_out)),
+             "bias": jnp.zeros((4 * self.c_out,))}
+        if self.with_peephole:
+            p["peep_i"] = jnp.zeros((self.c_out,))
+            p["peep_f"] = jnp.zeros((self.c_out,))
+            p["peep_o"] = jnp.zeros((self.c_out,))
+        return p
+
+    def zero_state_dhw(self, batch, d, h, w, dtype=jnp.float32):
+        z = jnp.zeros((batch, d, h, w, self.c_out), dtype)
+        return (z, z)
+
+    def step(self, params, x, state, ctx):
+        h_prev, c_prev = state
+        conv = lambda inp, w: lax.conv_general_dilated(
+            inp, w, (1, 1, 1), "SAME",
+            dimension_numbers=("NDHWC", "DHWIO", "NDHWC"))
+        z = conv(x, params["wi"]) + conv(h_prev, params["wh"]) + params["bias"]
+        i, f, g, o = jnp.split(z, 4, axis=-1)
+        if self.with_peephole:
+            i = i + params["peep_i"] * c_prev
+            f = f + params["peep_f"] * c_prev
+        i, f, g = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jnp.tanh(g)
+        c = f * c_prev + i * g
+        if self.with_peephole:
+            o = o + params["peep_o"] * c
+        o = jax.nn.sigmoid(o)
+        h = o * jnp.tanh(c)
+        return h, (h, c)

@@ -1,0 +1,111 @@
+"""Tree-structured LSTMs.
+
+Parity: TreeLSTM (DL/nn/TreeLSTM.scala, abstract base) and BinaryTreeLSTM
+(DL/nn/BinaryTreeLSTM.scala) — constituency-tree LSTM (Tai et al. 2015)
+used by the reference's treeLSTMSentiment example.
+
+TPU-first design: the reference walks the tree with recursive Scala calls
+(variable structure per sample). Under XLA the tree is instead *linearised*:
+nodes arrive in children-before-parent order as a static-size tensor, and a
+`lax.fori_loop` fills a node-state buffer with `dynamic_update` writes —
+one fused on-device loop, no host recursion, batched with `vmap`.
+
+Input contract: Table(embeddings [B, L, D], tree [B, N, 3]) where
+tree[b, n] = (left, right, leaf) with 1-based indices (Torch parity);
+leaf > 0 marks a leaf taking embeddings[b, leaf-1]; left/right > 0 point at
+earlier node slots. Zero rows are padding. Output: node hiddens [B, N, H].
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from bigdl_tpu.nn.module import ApplyContext, Module
+
+
+class TreeLSTM(Module):
+    """Abstract base (DL/nn/TreeLSTM.scala): holds sizes; concrete tree
+    topologies implement `apply`."""
+
+    def __init__(self, input_size: int, hidden_size: int, name=None):
+        super().__init__(name)
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+
+
+class BinaryTreeLSTM(TreeLSTM):
+    """Binary constituency Tree-LSTM (DL/nn/BinaryTreeLSTM.scala)."""
+
+    def __init__(self, input_size: int, hidden_size: int,
+                 gate_output: bool = True, name=None):
+        super().__init__(input_size, hidden_size, name)
+        self.gate_output = gate_output
+
+    def init(self, rng):
+        D, H = self.input_size, self.hidden_size
+        ks = jax.random.split(rng, 4)
+        stdv = 1.0 / jnp.sqrt(H)
+
+        def u(k, shape):
+            return jax.random.uniform(k, shape, minval=-stdv, maxval=stdv)
+
+        return {
+            # leaf: input -> (i, o, u) gates
+            "leaf_w": u(ks[0], (D, 3 * H)),
+            "leaf_b": jnp.zeros((3 * H,)),
+            # composer: (h_l, h_r) -> (i, f_l, f_r, o, u) gates
+            "comp_wl": u(ks[1], (H, 5 * H)),
+            "comp_wr": u(ks[2], (H, 5 * H)),
+            "comp_b": jnp.zeros((5 * H,)),
+        }
+
+    def apply(self, params, input, ctx: ApplyContext):
+        emb, tree = input[1], input[2]
+        tree = tree.astype(jnp.int32)
+        B, N = tree.shape[0], tree.shape[1]
+        H = self.hidden_size
+
+        def one(emb_b, tree_b):
+            def body(n, hc):
+                h_buf, c_buf = hc
+                left, right, leaf = tree_b[n, 0], tree_b[n, 1], tree_b[n, 2]
+                # -- leaf path --
+                x = emb_b[jnp.maximum(leaf - 1, 0)]
+                g = x @ params["leaf_w"] + params["leaf_b"]
+                i_l = jax.nn.sigmoid(g[:H])
+                o_l = jax.nn.sigmoid(g[H:2 * H]) if self.gate_output else 1.0
+                u_l = jnp.tanh(g[2 * H:])
+                c_leaf = i_l * u_l
+                h_leaf = o_l * jnp.tanh(c_leaf)
+                # -- compose path --
+                hl = h_buf[jnp.maximum(left - 1, 0)]
+                hr = h_buf[jnp.maximum(right - 1, 0)]
+                cl = c_buf[jnp.maximum(left - 1, 0)]
+                cr = c_buf[jnp.maximum(right - 1, 0)]
+                gc = hl @ params["comp_wl"] + hr @ params["comp_wr"] + params["comp_b"]
+                i = jax.nn.sigmoid(gc[:H])
+                fl = jax.nn.sigmoid(gc[H:2 * H])
+                fr = jax.nn.sigmoid(gc[2 * H:3 * H])
+                o = jax.nn.sigmoid(gc[3 * H:4 * H]) if self.gate_output else 1.0
+                u_c = jnp.tanh(gc[4 * H:])
+                c_comp = i * u_c + fl * cl + fr * cr
+                h_comp = o * jnp.tanh(c_comp)
+
+                is_leaf = leaf > 0
+                is_pad = (leaf == 0) & (left == 0) & (right == 0)
+                h_n = jnp.where(is_pad, 0.0,
+                                jnp.where(is_leaf, h_leaf, h_comp))
+                c_n = jnp.where(is_pad, 0.0,
+                                jnp.where(is_leaf, c_leaf, c_comp))
+                return (h_buf.at[n].set(h_n), c_buf.at[n].set(c_n))
+
+            h0 = jnp.zeros((N, H), emb_b.dtype)
+            c0 = jnp.zeros((N, H), emb_b.dtype)
+            h_buf, _ = lax.fori_loop(0, N, body, (h0, c0))
+            return h_buf
+
+        return jax.vmap(one)(emb, tree)

@@ -1,0 +1,280 @@
+"""Tests for detection ops, tree LSTMs, and the norm/conv additions
+(reference TEST/nn/{Nms,PriorBox,Proposal,RoiPooling,BinaryTreeLSTM,...}Spec
+pattern)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import bigdl_tpu.nn as nn
+from bigdl_tpu.utils.table import T
+
+KEY = jax.random.PRNGKey(0)
+
+
+class TestBbox:
+    def test_iou_identity_and_disjoint(self):
+        boxes = jnp.asarray([[0, 0, 9, 9], [20, 20, 29, 29]], jnp.float32)
+        iou = nn.bbox_iou(boxes, boxes)
+        np.testing.assert_allclose(np.diag(iou), [1.0, 1.0], atol=1e-6)
+        assert float(iou[0, 1]) == 0.0
+
+    def test_transform_inv_zero_deltas_is_identity(self):
+        boxes = jnp.asarray([[2, 3, 11, 13]], jnp.float32)
+        out = nn.bbox_transform_inv(boxes, jnp.zeros((1, 4)))
+        np.testing.assert_allclose(np.asarray(out), np.asarray(boxes), atol=1e-5)
+
+    def test_clip(self):
+        boxes = jnp.asarray([[-5, -5, 200, 300]], jnp.float32)
+        out = nn.clip_boxes(boxes, 100, 150)
+        np.testing.assert_allclose(np.asarray(out)[0], [0, 0, 149, 99])
+
+
+class TestNms:
+    def test_suppresses_overlaps_keeps_best(self):
+        boxes = jnp.asarray([[0, 0, 10, 10], [1, 1, 11, 11], [50, 50, 60, 60]],
+                            jnp.float32)
+        scores = jnp.asarray([0.9, 0.8, 0.7])
+        keep = nn.nms_mask(boxes, scores, 0.5)
+        assert keep.tolist() == [True, False, True]
+
+    def test_respects_score_order_not_input_order(self):
+        boxes = jnp.asarray([[1, 1, 11, 11], [0, 0, 10, 10]], jnp.float32)
+        scores = jnp.asarray([0.2, 0.9])
+        keep = nn.nms_mask(boxes, scores, 0.5)
+        assert keep.tolist() == [False, True]
+
+    def test_jittable(self):
+        f = jax.jit(lambda b, s: nn.nms_mask(b, s, 0.5))
+        boxes = jnp.asarray([[0, 0, 10, 10], [1, 1, 11, 11]], jnp.float32)
+        keep = f(boxes, jnp.asarray([0.5, 0.6]))
+        assert keep.tolist() == [False, True]
+
+
+class TestPriorBox:
+    def test_shapes_and_range(self):
+        m = nn.PriorBox(min_sizes=[30.0], max_sizes=[60.0],
+                        aspect_ratios=[2.0], img_h=300, img_w=300, clip=True)
+        fmap = jnp.zeros((1, 4, 4, 8))
+        out = m.forward(fmap)
+        num = 4 * 4 * m.num_priors * 4
+        assert out.shape == (1, 2, num)
+        pri = np.asarray(out[0, 0])
+        assert pri.min() >= 0.0 and pri.max() <= 1.0
+        # variances row repeats the 4 variance values
+        var = np.asarray(out[0, 1]).reshape(-1, 4)
+        np.testing.assert_allclose(var, np.tile([0.1, 0.1, 0.2, 0.2],
+                                                (var.shape[0], 1)))
+
+
+class TestAnchorProposal:
+    def test_anchor_count(self):
+        a = nn.Anchor(ratios=[0.5, 1.0, 2.0], scales=[8, 16, 32])
+        anchors = a.generate(3, 4, stride=16)
+        assert anchors.shape == (3 * 4 * 9, 4)
+
+    def test_proposal_fixed_output(self):
+        m = nn.Proposal(pre_nms_topn=50, post_nms_topn=10,
+                        ratios=[1.0], scales=[8], im_h=64, im_w=64)
+        h, w, a = 4, 4, 1
+        rs = np.random.RandomState(0)
+        scores = jnp.asarray(rs.rand(1, h, w, 2 * a).astype(np.float32))
+        deltas = jnp.asarray(0.1 * rs.randn(1, h, w, 4 * a).astype(np.float32))
+        out = m.forward(T(scores, deltas))
+        rois, keep = out[1], out[2]
+        assert rois.shape == (10, 5)
+        assert bool(keep[0])  # top proposal always valid
+        # all boxes inside the image
+        b = np.asarray(rois[:, 1:])
+        assert b.min() >= 0 and b[:, 2].max() <= 63 and b[:, 3].max() <= 63
+
+
+class TestRoiPooling:
+    def test_matches_manual_max(self):
+        fmap = jnp.arange(36, dtype=jnp.float32).reshape(1, 6, 6, 1)
+        rois = jnp.asarray([[0, 0, 0, 5, 5]], jnp.float32)
+        m = nn.RoiPooling(pooled_w=2, pooled_h=2, spatial_scale=1.0)
+        out = m.forward(T(fmap, rois))
+        assert out.shape == (1, 2, 2, 1)
+        # max over each 3x3 quadrant of the 6x6 map
+        np.testing.assert_allclose(
+            np.asarray(out)[0, :, :, 0], [[14, 17], [32, 35]])
+
+    def test_vs_torchvision_style_scale(self):
+        torch = pytest.importorskip("torch")
+        torchvision = pytest.importorskip("torchvision")
+        rs = np.random.RandomState(1)
+        fm = rs.rand(1, 8, 8, 4).astype(np.float32)
+        rois = np.asarray([[0, 0, 0, 7, 7], [0, 2, 2, 6, 6]], np.float32)
+        m = nn.RoiPooling(pooled_w=2, pooled_h=2, spatial_scale=1.0)
+        ours = np.asarray(m.forward(T(jnp.asarray(fm), jnp.asarray(rois))))
+        ref = torchvision.ops.roi_pool(
+            torch.tensor(fm.transpose(0, 3, 1, 2)), torch.tensor(rois),
+            output_size=2, spatial_scale=1.0).numpy().transpose(0, 2, 3, 1)
+        np.testing.assert_allclose(ours, ref, atol=1e-5)
+
+
+class TestDetectionOutput:
+    def test_ssd_head_shapes(self):
+        P, C = 8, 3
+        m = nn.DetectionOutputSSD(n_classes=C, nms_topk=8, keep_topk=4)
+        rs = np.random.RandomState(0)
+        loc = jnp.asarray(0.1 * rs.randn(2, P * 4).astype(np.float32))
+        conf = jnp.asarray(rs.randn(2, P * C).astype(np.float32))
+        pri = np.zeros((1, 2, P * 4), np.float32)
+        grid = np.linspace(0.05, 0.85, P)
+        for i, g in enumerate(grid):
+            pri[0, 0, i * 4: i * 4 + 4] = [g, g, g + 0.1, g + 0.1]
+            pri[0, 1, i * 4: i * 4 + 4] = [0.1, 0.1, 0.2, 0.2]
+        out = m.forward(T(loc, conf, jnp.asarray(pri)))
+        boxes, scores, mask = out[1], out[2], out[3]
+        assert boxes.shape == (2, C, 4, 4)
+        assert scores.shape == (2, C, 4)
+        assert not bool(np.asarray(mask)[:, 0].any())  # background dropped
+
+    def test_frcnn_head_shapes(self):
+        R, C = 6, 4
+        m = nn.DetectionOutputFrcnn(n_classes=C, max_per_image=5,
+                                    im_h=64, im_w=64)
+        rs = np.random.RandomState(0)
+        cls_prob = jax.nn.softmax(jnp.asarray(rs.randn(R, C), jnp.float32))
+        bbox = jnp.asarray(0.05 * rs.randn(R, C * 4).astype(np.float32))
+        rois = np.zeros((R, 5), np.float32)
+        rois[:, 1:] = [5, 5, 30, 30]
+        out = m.forward(T(cls_prob, bbox, jnp.asarray(rois)))
+        assert out[1].shape == (1, C, 5, 4)
+        assert out[2].shape == (1, C, 5)
+
+
+class TestBinaryTreeLSTM:
+    def test_tree_combines_children(self):
+        # sentence of 2 words; tree: leaf(1), leaf(2), root(children 1,2)
+        D, H = 4, 3
+        m = nn.BinaryTreeLSTM(D, H)
+        emb = jnp.asarray(np.random.RandomState(0).randn(1, 2, D), jnp.float32)
+        tree = jnp.asarray([[[0, 0, 1], [0, 0, 2], [1, 2, 0]]], jnp.int32)
+        out = m.forward(T(emb, tree))
+        assert out.shape == (1, 3, H)
+        # root state differs from both leaves and padding rows are zero
+        o = np.asarray(out[0])
+        assert not np.allclose(o[2], o[0]) and not np.allclose(o[2], o[1])
+
+    def test_padding_rows_zero(self):
+        D, H = 4, 3
+        m = nn.BinaryTreeLSTM(D, H)
+        emb = jnp.ones((1, 2, D))
+        tree = jnp.asarray([[[0, 0, 1], [0, 0, 0]]], jnp.int32)
+        out = np.asarray(m.forward(T(emb, tree)))
+        assert np.allclose(out[0, 1], 0.0)
+
+    def test_jit_grad(self):
+        D, H = 4, 3
+        m = nn.BinaryTreeLSTM(D, H)
+        params = m.init(KEY)
+        emb = jnp.ones((2, 2, D))
+        tree = jnp.asarray([[[0, 0, 1], [0, 0, 2], [1, 2, 0]]] * 2, jnp.int32)
+
+        @jax.jit
+        def loss(p):
+            out = m.apply(p, T(emb, tree), nn.ApplyContext())
+            return jnp.sum(out ** 2)
+
+        g = jax.grad(loss)(params)
+        assert all(np.isfinite(np.asarray(l)).all()
+                   for l in jax.tree_util.tree_leaves(g))
+
+
+class TestNormVariants:
+    def test_subtractive_removes_constant(self):
+        m = nn.SpatialSubtractiveNormalization(3)
+        x = jnp.full((1, 8, 8, 3), 5.0)
+        out = np.asarray(m.forward(x))
+        np.testing.assert_allclose(out, 0.0, atol=1e-5)
+
+    def test_divisive_scales_down_high_variance(self):
+        m = nn.SpatialDivisiveNormalization(1)
+        rs = np.random.RandomState(0)
+        x = jnp.asarray(10.0 * rs.randn(1, 8, 8, 1).astype(np.float32))
+        out = np.asarray(m.forward(x))
+        assert np.abs(out).std() < np.abs(np.asarray(x)).std()
+
+    def test_contrastive_composes(self):
+        m = nn.SpatialContrastiveNormalization(2)
+        x = jnp.asarray(np.random.RandomState(0).randn(1, 6, 6, 2), jnp.float32)
+        assert m.forward(x).shape == (1, 6, 6, 2)
+
+    def test_within_channel_lrn_identity_for_zero_alpha(self):
+        m = nn.SpatialWithinChannelLRN(size=3, alpha=0.0)
+        x = jnp.asarray(np.random.RandomState(0).randn(1, 5, 5, 2), jnp.float32)
+        np.testing.assert_allclose(np.asarray(m.forward(x)), np.asarray(x),
+                                   atol=1e-6)
+
+
+class TestConvAdditions:
+    def test_volumetric_full_conv_output_size(self):
+        m = nn.VolumetricFullConvolution(2, 3, 2, 2, 2, dt=2, dw=2, dh=2)
+        y = m.forward(jnp.ones((1, 4, 4, 4, 2)))
+        # (4-1)*2 - 0 + 2 = 8
+        assert y.shape == (1, 8, 8, 8, 3)
+
+    def test_volumetric_full_conv_vs_torch(self):
+        torch = pytest.importorskip("torch")
+        m = nn.VolumetricFullConvolution(2, 3, 3, 3, 3, dt=2, dw=2, dh=2,
+                                         pad_t=1, pad_w=1, pad_h=1)
+        params = m.parameters()
+        tm = torch.nn.ConvTranspose3d(2, 3, 3, stride=2, padding=1)
+        with torch.no_grad():
+            # ours (t,h,w,out,in) -> torch (in,out,t,h,w)
+            w = np.asarray(params["weight"]).transpose(4, 3, 0, 1, 2)
+            tm.weight.copy_(torch.tensor(w))
+            tm.bias.copy_(torch.tensor(np.asarray(params["bias"])))
+        x = np.random.RandomState(0).rand(1, 4, 4, 4, 2).astype(np.float32)
+        ours = np.asarray(m.forward(jnp.asarray(x)))
+        ref = tm(torch.tensor(x.transpose(0, 4, 1, 2, 3))).detach().numpy()
+        np.testing.assert_allclose(ours, ref.transpose(0, 2, 3, 4, 1),
+                                   rtol=1e-4, atol=1e-4)
+
+    def test_locally_connected_1d(self):
+        m = nn.LocallyConnected1D(n_input_frame=6, input_frame_size=3,
+                                  output_frame_size=5, kernel_w=3)
+        y = m.forward(jnp.ones((2, 6, 3)))
+        assert y.shape == (2, 4, 5)
+
+    def test_spatial_convolution_map_respects_table(self):
+        # one-to-one table: each output channel sees only its own input
+        tbl = nn.SpatialConvolutionMap.one_to_one(2)
+        m = nn.SpatialConvolutionMap(tbl, 3, 3, pad_w=1, pad_h=1)
+        params = m.parameters()
+        x = np.zeros((1, 5, 5, 2), np.float32)
+        x[..., 0] = 1.0  # only input channel 0 lit
+        y = np.asarray(m.forward(jnp.asarray(x)))
+        bias = np.asarray(params["bias"])
+        # output channel 1 gets bias only (no connection to input 0)
+        np.testing.assert_allclose(y[..., 1], bias[1], atol=1e-6)
+
+
+class TestSmallAdditions:
+    def test_bifurcate_split(self):
+        m = nn.BifurcateSplitTable(axis=1)
+        out = m.forward(jnp.arange(10.0).reshape(2, 5))
+        assert out[1].shape == (2, 2) and out[2].shape == (2, 3)
+
+    def test_categorical_cross_entropy_matches_nll(self):
+        logits = jnp.asarray(np.random.RandomState(0).randn(4, 5), jnp.float32)
+        onehot = jax.nn.one_hot(jnp.asarray([0, 1, 2, 3]), 5)
+        cce = nn.CategoricalCrossEntropy()(logits, onehot)
+        ref = nn.CrossEntropyCriterion(zero_based=True)(
+            logits, jnp.asarray([0, 1, 2, 3]))
+        np.testing.assert_allclose(float(cce), float(ref), rtol=1e-5)
+
+    def test_lstm2_alias(self):
+        assert nn.LSTM2 is nn.LSTMCell
+
+    def test_conv_lstm_3d_step(self):
+        cell = nn.ConvLSTMPeephole3D(2, 4)
+        params = cell.init(KEY)
+        x = jnp.ones((1, 3, 3, 3, 2))
+        state = cell.zero_state_dhw(1, 3, 3, 3)
+        h, (h2, c2) = cell.step(params, x, state, nn.ApplyContext())
+        assert h.shape == (1, 3, 3, 3, 4)
