@@ -324,8 +324,10 @@ class RoiPooling(Module):
             m = my[:, None, :, None, None] & mx[None, :, None, :, None]
             vals = jnp.where(m, fmap[None, None, :, :, :], -jnp.inf)
             out = jnp.max(vals, axis=(2, 3))  # [ph, pw, C]
-            empty = ~jnp.any(m, axis=(2, 3))  # [ph, pw]
-            return jnp.where(empty[..., None], 0.0, out)
+            # m's trailing channel axis (size 1) survives the reduction, so
+            # `empty` is [ph, pw, 1] and broadcasts against out directly
+            empty = ~jnp.any(m, axis=(2, 3))
+            return jnp.where(empty, 0.0, out)
 
         return jax.vmap(pool_one)(rois)
 
