@@ -7,3 +7,28 @@ from bigdl_tpu.ops.attention_kernel import (attention_state_finish,
                                             flash_attention,
                                             flash_attention_forward,
                                             naive_attention)
+from bigdl_tpu.ops import operation
+from bigdl_tpu.ops import feature_col
+from bigdl_tpu.ops.operation import (Abs, Add, All, Any, ApproximateEqual,
+                                     ArgMax, Assert, BatchMatMul, BiasAdd,
+                                     Cast, Ceil, Compare, ControlDependency,
+                                     CrossEntropy, DepthwiseConv2D, Digamma,
+                                     Dilation2D, Equal, Erf, Erfc, Exp, Expm1,
+                                     Floor, FloorDiv, FloorMod, Gather,
+                                     Greater, GreaterEqual, InTopK, Inv,
+                                     IsFinite, IsInf, IsNan, L2Loss, Less,
+                                     LessEqual, Lgamma, Log1p, LogicalAnd,
+                                     LogicalNot, LogicalOr, Max, Maximum,
+                                     Minimum, Mod, ModuleToOperation, Mul, NoOp,
+                                     NotEqual, OneHot, Operation, Pad, Pow,
+                                     Prod, RandomUniform, RangeOps, Rank,
+                                     RealDiv, ResizeBilinearOps, Rint, Round,
+                                     Rsqrt, SegmentSum, Select, Shape, Sign,
+                                     Slice, SplitAndSelect, Sqrt, Square,
+                                     SquaredDifference, StridedSlice, Sub,
+                                     Sum, TensorModuleWrapper, TensorOp, Tile,
+                                     TopK, TruncateDiv, TruncatedNormal)
+from bigdl_tpu.ops.feature_col import (BucketizedCol, CategoricalColHashBucket,
+                                       CategoricalColVocaList, CrossCol,
+                                       IndicatorCol, Kv2Tensor, MkString,
+                                       Substr)

@@ -1,0 +1,161 @@
+"""Feature-column ops for tabular/recommender pipelines.
+
+Parity: DL/nn/ops/{BucketizedCol,CategoricalColHashBucket,
+CategoricalColVocaList,CrossCol,IndicatorCol,Kv2Tensor,MkString,Substr}.scala
+— the building blocks the reference's Wide&Deep pyspark path composes.
+
+These ops transform raw host-side features (strings, ids) into dense/sparse
+numeric tensors. String handling runs on numpy object arrays on the host
+(the reference likewise runs them on the JVM heap, outside MKL); the numeric
+outputs are ordinary arrays that feed straight into jitted models. Hashing
+uses crc32 — stable across processes, unlike Python's builtin hash.
+"""
+
+from __future__ import annotations
+
+import zlib
+from typing import Optional, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+from bigdl_tpu.ops.operation import Operation
+from bigdl_tpu.utils.table import Table, T
+
+
+def _stable_hash(s: str, buckets: int) -> int:
+    return zlib.crc32(str(s).encode("utf-8")) % buckets
+
+
+class BucketizedCol(Operation):
+    """Bucketize numeric features by boundaries
+    (DL/nn/ops/BucketizedCol.scala): output = #boundaries crossed."""
+
+    def __init__(self, boundaries: Sequence[float], name=None):
+        super().__init__(name)
+        self.boundaries = jnp.asarray(sorted(boundaries), jnp.float32)
+
+    def apply(self, params, input, ctx):
+        return jnp.sum(input[..., None] >= self.boundaries, axis=-1).astype(jnp.int32)
+
+
+class CategoricalColHashBucket(Operation):
+    """String/id column -> hash bucket index
+    (DL/nn/ops/CategoricalColHashBucket.scala)."""
+
+    def __init__(self, hash_bucket_size: int, name=None):
+        super().__init__(name)
+        self.size = hash_bucket_size
+
+    def apply(self, params, input, ctx):
+        arr = np.asarray(input)
+        out = np.vectorize(lambda s: _stable_hash(s, self.size))(arr)
+        return jnp.asarray(out.astype(np.int32))
+
+
+class CategoricalColVocaList(Operation):
+    """String column -> vocabulary index
+    (DL/nn/ops/CategoricalColVocaList.scala). Unknowns map to
+    `default_value` or hash into `num_oov_buckets` past the vocab."""
+
+    def __init__(self, vocab: Sequence[str], default_value: int = -1,
+                 num_oov_buckets: int = 0, name=None):
+        super().__init__(name)
+        self.lookup = {v: i for i, v in enumerate(vocab)}
+        self.vocab_size = len(self.lookup)
+        self.default = default_value
+        self.oov = num_oov_buckets
+
+    def _map(self, s):
+        if s in self.lookup:
+            return self.lookup[s]
+        if self.oov > 0:
+            return self.vocab_size + _stable_hash(s, self.oov)
+        return self.default
+
+    def apply(self, params, input, ctx):
+        arr = np.asarray(input)
+        return jnp.asarray(np.vectorize(self._map)(arr).astype(np.int32))
+
+
+class CrossCol(Operation):
+    """Cross N categorical columns into one hashed feature
+    (DL/nn/ops/CrossCol.scala). Input: Table of N equal-length columns."""
+
+    def __init__(self, hash_bucket_size: int, name=None):
+        super().__init__(name)
+        self.size = hash_bucket_size
+
+    def apply(self, params, input, ctx):
+        cols = [np.asarray(input[i + 1]) for i in range(len(input))]
+        flat = [c.reshape(-1) for c in cols]
+        res = np.asarray([_stable_hash("_X_".join(str(v[i]) for v in flat),
+                                       self.size)
+                          for i in range(flat[0].shape[0])], np.int32)
+        return jnp.asarray(res.reshape(cols[0].shape))
+
+
+class IndicatorCol(Operation):
+    """Categorical index -> multi-hot dense vector
+    (DL/nn/ops/IndicatorCol.scala)."""
+
+    def __init__(self, feat_len: int, is_count: bool = True, name=None):
+        super().__init__(name)
+        self.feat_len = feat_len
+        self.is_count = is_count
+
+    def apply(self, params, input, ctx):
+        import jax
+        idx = jnp.asarray(input).astype(jnp.int32)
+        counts = jnp.sum(jax.nn.one_hot(idx, self.feat_len), axis=-2)
+        return counts if self.is_count else jnp.clip(counts, 0.0, 1.0)
+
+
+class Kv2Tensor(Operation):
+    """Parse 'k:v,k:v' strings into dense vectors (DL/nn/ops/Kv2Tensor.scala).
+    Host-side string parsing, like the reference."""
+
+    def __init__(self, kv_delimiter: str = ",", item_delimiter: str = ":",
+                 feat_len: int = 0, name=None):
+        super().__init__(name)
+        self.kv_delimiter = kv_delimiter
+        self.item_delimiter = item_delimiter
+        self.feat_len = feat_len
+
+    def apply(self, params, input, ctx):
+        arr = np.asarray(input).reshape(-1)
+        out = np.zeros((arr.shape[0], self.feat_len), np.float32)
+        for r, s in enumerate(arr):
+            for item in str(s).split(self.kv_delimiter):
+                if not item:
+                    continue
+                k, v = item.split(self.item_delimiter)
+                out[r, int(k)] = float(v)
+        return jnp.asarray(out)
+
+
+class MkString(Operation):
+    """Join row elements into strings (DL/nn/ops/MkString.scala)."""
+
+    def __init__(self, str_delimiter: str = ",", name=None):
+        super().__init__(name)
+        self.delim = str_delimiter
+
+    def apply(self, params, input, ctx):
+        arr = np.asarray(input)
+        return np.asarray([self.delim.join(str(v) for v in row)
+                           for row in arr.reshape(arr.shape[0], -1)], object)
+
+
+class Substr(Operation):
+    """Substring by (pos, len) (DL/nn/ops/Substr.scala). Host-side."""
+
+    def __init__(self, pos: int = 0, length: int = -1, name=None):
+        super().__init__(name)
+        self.pos, self.length = pos, length
+
+    def apply(self, params, input, ctx):
+        end = None if self.length < 0 else self.pos + self.length
+        arr = np.asarray(input)
+        return np.asarray([str(s)[self.pos:end] for s in arr.reshape(-1)],
+                          object).reshape(arr.shape)

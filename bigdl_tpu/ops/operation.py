@@ -1,0 +1,547 @@
+"""TF-style stateless operation nodes.
+
+Parity: `DL/nn/ops/` (71 files) — `Operation` extends AbstractModule with no
+backward (DL/nn/ops/Operation.scala); these nodes exist to execute imported
+TF graphs and feature-engineering pipelines. Here an Operation is just a
+parameter-free Module whose `apply` wraps the matching jax/lax op, so ops
+compose with layers inside `Graph` and stay jit-compilable.
+
+Numeric ops are pure jnp and TPU-native. String ops (Substr, MkString, the
+feature-column family) run host-side on numpy object arrays — exactly as the
+reference runs them on the JVM heap, outside the MKL compute path — and are
+documented as non-jittable.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from bigdl_tpu.nn.module import ApplyContext, Module
+from bigdl_tpu.utils.table import Table, T
+
+
+class Operation(Module):
+    """Base class: forward-only module (DL/nn/ops/Operation.scala)."""
+
+    def backward(self, *a, **k):
+        raise RuntimeError("Operation does not support backward "
+                           "(reference Operation.scala contract)")
+
+
+class _Unary(Operation):
+    fn: Callable = None
+
+    def apply(self, params, input, ctx):
+        return type(self).fn(input)
+
+
+class _Binary(Operation):
+    """Takes Table(a, b)."""
+    fn: Callable = None
+
+    def apply(self, params, input, ctx):
+        return type(self).fn(input[1], input[2])
+
+
+def _unary(name: str, fn: Callable) -> type:
+    return type(name, (_Unary,), {"fn": staticmethod(fn), "__doc__":
+                                  f"TF-style `{name}` op (DL/nn/ops/{name}.scala)."})
+
+
+def _binary(name: str, fn: Callable) -> type:
+    return type(name, (_Binary,), {"fn": staticmethod(fn), "__doc__":
+                                   f"TF-style `{name}` op (DL/nn/ops/{name}.scala)."})
+
+
+# ---- elementwise / math ---------------------------------------------------- #
+Abs = _unary("Abs", jnp.abs)
+Ceil = _unary("Ceil", jnp.ceil)
+Digamma = _unary("Digamma", lambda x: jax.scipy.special.digamma(x))
+Erf = _unary("Erf", lambda x: jax.scipy.special.erf(x))
+Erfc = _unary("Erfc", lambda x: jax.scipy.special.erfc(x))
+Exp = _unary("Exp", jnp.exp)
+Expm1 = _unary("Expm1", jnp.expm1)
+Floor = _unary("Floor", jnp.floor)
+Inv = _unary("Inv", lambda x: 1.0 / x)
+IsFinite = _unary("IsFinite", jnp.isfinite)
+IsInf = _unary("IsInf", jnp.isinf)
+IsNan = _unary("IsNan", jnp.isnan)
+Lgamma = _unary("Lgamma", lambda x: jax.scipy.special.gammaln(x))
+Log1p = _unary("Log1p", jnp.log1p)
+Rint = _unary("Rint", jnp.rint)
+Round = _unary("Round", jnp.round)
+Sign = _unary("Sign", jnp.sign)
+Sqrt = _unary("Sqrt", jnp.sqrt)
+Rsqrt = _unary("Rsqrt", lambda x: lax.rsqrt(x))
+Square = _unary("Square", jnp.square)
+LogicalNot = _unary("LogicalNot", jnp.logical_not)
+Rank = _unary("Rank", lambda x: jnp.asarray(jnp.ndim(x), jnp.int32))
+Shape = _unary("Shape", lambda x: jnp.asarray(x.shape, jnp.int32))
+L2Loss = _unary("L2Loss", lambda x: jnp.sum(x * x) / 2.0)
+
+Add = _binary("Add", jnp.add)
+Sub = _binary("Sub", jnp.subtract)
+Mul = _binary("Mul", jnp.multiply)
+RealDiv = _binary("RealDiv", jnp.divide)
+FloorDiv = _binary("FloorDiv", jnp.floor_divide)
+FloorMod = _binary("FloorMod", jnp.mod)
+Mod = _binary("Mod", lax.rem)  # TF Mod = C truncated remainder
+Maximum = _binary("Maximum", jnp.maximum)
+Minimum = _binary("Minimum", jnp.minimum)
+Pow = _binary("Pow", jnp.power)
+SquaredDifference = _binary("SquaredDifference", lambda a, b: jnp.square(a - b))
+TruncateDiv = _binary("TruncateDiv",
+                      lambda a, b: jnp.trunc(a / b).astype(a.dtype))
+Equal = _binary("Equal", lambda a, b: a == b)
+NotEqual = _binary("NotEqual", lambda a, b: a != b)
+Greater = _binary("Greater", lambda a, b: a > b)
+GreaterEqual = _binary("GreaterEqual", lambda a, b: a >= b)
+Less = _binary("Less", lambda a, b: a < b)
+LessEqual = _binary("LessEqual", lambda a, b: a <= b)
+LogicalAnd = _binary("LogicalAnd", jnp.logical_and)
+LogicalOr = _binary("LogicalOr", jnp.logical_or)
+BatchMatMul = _binary("BatchMatMul", jnp.matmul)
+
+
+class ApproximateEqual(Operation):
+    """|a - b| < tolerance (DL/nn/ops/ApproximateEqual.scala)."""
+
+    def __init__(self, tolerance: float = 1e-5, name=None):
+        super().__init__(name)
+        self.tolerance = tolerance
+
+    def apply(self, params, input, ctx):
+        return jnp.abs(input[1] - input[2]) < self.tolerance
+
+
+class Compare(Operation):
+    """Generic comparison by operator string."""
+
+    _ops = {"eq": jnp.equal, "ne": jnp.not_equal, "gt": jnp.greater,
+            "ge": jnp.greater_equal, "lt": jnp.less, "le": jnp.less_equal}
+
+    def __init__(self, op: str = "eq", name=None):
+        super().__init__(name)
+        self.op = self._ops[op]
+
+    def apply(self, params, input, ctx):
+        return self.op(input[1], input[2])
+
+
+# ---- reductions / indexing ------------------------------------------------- #
+
+class _Reduce(Operation):
+    rfn: Callable = None
+
+    def __init__(self, axis: Optional[int] = None, keep_dims: bool = False,
+                 name=None):
+        super().__init__(name)
+        self.axis, self.keep_dims = axis, keep_dims
+
+    def apply(self, params, input, ctx):
+        if isinstance(input, Table):
+            x, axis = input[1], int(input[2])
+        else:
+            x, axis = input, self.axis
+        return type(self).rfn(x, axis=axis, keepdims=self.keep_dims)
+
+
+class All(_Reduce):
+    """Logical-all reduction (DL/nn/ops/All.scala)."""
+    rfn = staticmethod(jnp.all)
+
+
+class Any(_Reduce):
+    """Logical-any reduction (DL/nn/ops/Any.scala)."""
+    rfn = staticmethod(jnp.any)
+
+
+class Sum(_Reduce):
+    rfn = staticmethod(jnp.sum)
+
+
+class Prod(_Reduce):
+    rfn = staticmethod(jnp.prod)
+
+
+class Max(_Reduce):
+    rfn = staticmethod(jnp.max)
+
+
+class ArgMax(Operation):
+    """Argmax along an axis, 0-based output (DL/nn/ops/ArgMax.scala)."""
+
+    def __init__(self, axis: int = 0, name=None):
+        super().__init__(name)
+        self.axis = axis
+
+    def apply(self, params, input, ctx):
+        if isinstance(input, Table):
+            x, axis = input[1], int(input[2])
+        else:
+            x, axis = input, self.axis
+        return jnp.argmax(x, axis=axis).astype(jnp.int32)
+
+
+class Cast(Operation):
+    """dtype cast (DL/nn/ops/Cast.scala)."""
+
+    def __init__(self, dtype, name=None):
+        super().__init__(name)
+        self.dtype = dtype
+
+    def apply(self, params, input, ctx):
+        return input.astype(self.dtype)
+
+
+class Gather(Operation):
+    """Gather slices along axis 0 by integer indices
+    (DL/nn/ops/Gather.scala; indices 0-based like TF)."""
+
+    def __init__(self, axis: int = 0, name=None):
+        super().__init__(name)
+        self.axis = axis
+
+    def apply(self, params, input, ctx):
+        x, idx = input[1], input[2]
+        return jnp.take(x, idx.astype(jnp.int32), axis=self.axis)
+
+
+class InTopK(Operation):
+    """Whether targets are within top-k predictions (DL/nn/ops/InTopK.scala)."""
+
+    def __init__(self, k: int, start_from_zero: bool = True, name=None):
+        super().__init__(name)
+        self.k = k
+        self.zero = start_from_zero
+
+    def apply(self, params, input, ctx):
+        pred, targets = input[1], input[2]
+        t = targets.astype(jnp.int32) - (0 if self.zero else 1)
+        target_vals = jnp.take_along_axis(pred, t[:, None], axis=1)[:, 0]
+        rank = jnp.sum(pred > target_vals[:, None], axis=1)
+        return rank < self.k
+
+
+class TopK(Operation):
+    """Top-k values + 0-based indices (DL/nn/ops/TopK.scala)."""
+
+    def __init__(self, k: int, sorted: bool = True, start_index: int = 0,
+                 name=None):
+        super().__init__(name)
+        self.k = k
+        self.start_index = start_index
+
+    def apply(self, params, input, ctx):
+        vals, idx = lax.top_k(input, self.k)
+        return T(vals, idx.astype(jnp.int32) + self.start_index)
+
+
+class OneHot(Operation):
+    """One-hot encode (DL/nn/ops/OneHot.scala)."""
+
+    def __init__(self, depth: int, on_value: float = 1.0,
+                 off_value: float = 0.0, axis: int = -1, name=None):
+        super().__init__(name)
+        self.depth, self.on, self.off, self.axis = depth, on_value, off_value, axis
+
+    def apply(self, params, input, ctx):
+        oh = jax.nn.one_hot(input.astype(jnp.int32), self.depth, axis=self.axis)
+        return oh * (self.on - self.off) + self.off
+
+
+class Pad(Operation):
+    """Zero/constant pad with a [rank, 2] padding spec (DL/nn/ops/Pad.scala)."""
+
+    def __init__(self, value: float = 0.0, name=None):
+        super().__init__(name)
+        self.value = value
+
+    def apply(self, params, input, ctx):
+        x, paddings = input[1], np.asarray(input[2])
+        return jnp.pad(x, [(int(a), int(b)) for a, b in paddings],
+                       constant_values=self.value)
+
+
+class RangeOps(Operation):
+    """range(start, limit, delta) (DL/nn/ops/RangeOps.scala)."""
+
+    def apply(self, params, input, ctx):
+        start, limit, delta = (int(input[1]), int(input[2]), int(input[3]))
+        return jnp.arange(start, limit, delta)
+
+
+class ResizeBilinearOps(Operation):
+    """Bilinear image resize NHWC (DL/nn/ops/ResizeBilinear op wrapper)."""
+
+    def __init__(self, align_corners: bool = False, name=None):
+        super().__init__(name)
+        self.align = align_corners
+
+    def apply(self, params, input, ctx):
+        x, size = input[1], input[2]
+        h, w = int(size[0]), int(size[1])
+        if not self.align:
+            return jax.image.resize(x, (x.shape[0], h, w, x.shape[3]),
+                                    "bilinear")
+        # align_corners: out[i] samples input at i*(in-1)/(out-1) — build the
+        # grid explicitly and gather-lerp (jax.image.resize has no such mode)
+        ih, iw = x.shape[1], x.shape[2]
+        ry = jnp.linspace(0.0, ih - 1.0, h)
+        rx = jnp.linspace(0.0, iw - 1.0, w)
+        y0 = jnp.clip(jnp.floor(ry).astype(jnp.int32), 0, ih - 1)
+        x0 = jnp.clip(jnp.floor(rx).astype(jnp.int32), 0, iw - 1)
+        y1 = jnp.minimum(y0 + 1, ih - 1)
+        x1 = jnp.minimum(x0 + 1, iw - 1)
+        fy = (ry - y0)[None, :, None, None]
+        fx = (rx - x0)[None, None, :, None]
+        g = lambda yy, xx: x[:, yy][:, :, xx]
+        top = g(y0, x0) * (1 - fx) + g(y0, x1) * fx
+        bot = g(y1, x0) * (1 - fx) + g(y1, x1) * fx
+        return top * (1 - fy) + bot * fy
+
+
+class SegmentSum(Operation):
+    """Sum rows by segment id (DL/nn/ops/SegmentSum.scala). `num_segments`
+    must be static for XLA."""
+
+    def __init__(self, num_segments: Optional[int] = None, name=None):
+        super().__init__(name)
+        self.num_segments = num_segments
+
+    def apply(self, params, input, ctx):
+        x, seg = input[1], input[2].astype(jnp.int32)
+        n = self.num_segments or int(np.asarray(seg).max()) + 1
+        return jax.ops.segment_sum(x, seg, num_segments=n)
+
+
+class Select(Operation):
+    """Elementwise select(cond, a, b) (DL/nn/ops/Select.scala)."""
+
+    def apply(self, params, input, ctx):
+        return jnp.where(input[1], input[2], input[3])
+
+
+class Slice(Operation):
+    """Static slice by begin/size (DL/nn/ops/Slice.scala)."""
+
+    def __init__(self, begin: Sequence[int], size: Sequence[int], name=None):
+        super().__init__(name)
+        self.begin, self.size = tuple(begin), tuple(size)
+
+    def apply(self, params, input, ctx):
+        limits = tuple(b + (s if s >= 0 else input.shape[i] - b)
+                       for i, (b, s) in enumerate(zip(self.begin, self.size)))
+        return lax.slice(input, self.begin, limits)
+
+
+class StridedSlice(Operation):
+    """Static strided slice (DL/nn/tf/StridedSlice.scala)."""
+
+    def __init__(self, begin, end, strides=None, name=None):
+        super().__init__(name)
+        self.begin, self.end = tuple(begin), tuple(end)
+        self.strides = tuple(strides) if strides else (1,) * len(self.begin)
+
+    def apply(self, params, input, ctx):
+        return lax.slice(input, self.begin, self.end, self.strides)
+
+
+class Tile(Operation):
+    """Tile by multiples (DL/nn/ops/Tile.scala)."""
+
+    def apply(self, params, input, ctx):
+        x, mult = input[1], np.asarray(input[2])
+        return jnp.tile(x, tuple(int(m) for m in mult))
+
+
+class RandomUniform(Operation):
+    """Stateless uniform sampler (DL/nn/ops/RandomUniform.scala); draws from
+    the ApplyContext RNG so results are reproducible under jit."""
+
+    def __init__(self, minval: float = 0.0, maxval: float = 1.0, name=None):
+        super().__init__(name)
+        self.minval, self.maxval = minval, maxval
+
+    def apply(self, params, input, ctx):
+        shape = tuple(int(s) for s in np.asarray(input))
+        return jax.random.uniform(ctx.make_rng(), shape,
+                                  minval=self.minval, maxval=self.maxval)
+
+
+class TruncatedNormal(Operation):
+    """Truncated-normal sampler (DL/nn/ops/TruncatedNormal.scala)."""
+
+    def __init__(self, mean: float = 0.0, stddev: float = 1.0, name=None):
+        super().__init__(name)
+        self.mean, self.stddev = mean, stddev
+
+    def apply(self, params, input, ctx):
+        shape = tuple(int(s) for s in np.asarray(input))
+        z = jax.random.truncated_normal(ctx.make_rng(), -2.0, 2.0, shape)
+        return z * self.stddev + self.mean
+
+
+class CrossEntropy(Operation):
+    """Softmax cross-entropy with logits, per-row output
+    (DL/nn/ops/CrossEntropy.scala)."""
+
+    def apply(self, params, input, ctx):
+        logits, labels = input[1], input[2]
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        return -jnp.sum(labels * logp, axis=-1)
+
+
+class DepthwiseConv2D(Operation):
+    """Depthwise conv op taking Table(input NHWC, filter HWIO-depthwise)
+    (DL/nn/ops/DepthwiseConv2D.scala)."""
+
+    def __init__(self, stride_h: int = 1, stride_w: int = 1,
+                 padding: str = "SAME", name=None):
+        super().__init__(name)
+        self.s = (stride_h, stride_w)
+        self.padding = padding
+
+    def apply(self, params, input, ctx):
+        x, w = input[1], input[2]
+        cin, mult = w.shape[2], w.shape[3]
+        w = jnp.reshape(w, w.shape[:2] + (1, cin * mult))
+        return lax.conv_general_dilated(
+            x, w, self.s, self.padding, feature_group_count=cin,
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+
+
+class Dilation2D(Operation):
+    """Grayscale morphological dilation (DL/nn/ops/Dilation2D.scala)."""
+
+    def __init__(self, strides=(1, 1), rates=(1, 1), padding: str = "SAME",
+                 name=None):
+        super().__init__(name)
+        self.strides, self.rates, self.padding = tuple(strides), tuple(rates), padding
+
+    def apply(self, params, input, ctx):
+        x, filt = input[1], input[2]  # [B,H,W,C], [kh,kw,C]
+        kh, kw, c = filt.shape
+        patches = lax.conv_general_dilated_patches(
+            x, (kh, kw), self.strides, self.padding,
+            rhs_dilation=self.rates,
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        B, oh, ow, _ = patches.shape
+        # patches layout: [B, oh, ow, C*kh*kw] with channel-major ordering
+        p = patches.reshape(B, oh, ow, c, kh * kw)
+        f = jnp.transpose(filt, (2, 0, 1)).reshape(c, kh * kw)
+        return jnp.max(p + f[None, None, None], axis=-1)
+
+
+class BiasAdd(Operation):
+    """Add a channel bias vector (DL/nn/tf/BiasAdd.scala)."""
+
+    def apply(self, params, input, ctx):
+        return input[1] + input[2]
+
+
+class SplitAndSelect(Operation):
+    """Split along axis into N parts, return part `index`
+    (DL/nn/tf/SplitAndSelect.scala; 0-based here)."""
+
+    def __init__(self, axis: int, index: int, num_split: int, name=None):
+        super().__init__(name)
+        self.axis, self.index, self.num = axis, index, num_split
+
+    def apply(self, params, input, ctx):
+        return jnp.split(input, self.num, axis=self.axis)[self.index]
+
+
+class Assert(Operation):
+    """Host-side assertion (DL/nn/tf/Assert.scala); no-op under jit tracing."""
+
+    def __init__(self, message: str = "", name=None):
+        super().__init__(name)
+        self.message = message
+
+    def apply(self, params, input, ctx):
+        cond, data = input[1], input[2]
+        if isinstance(cond, jax.core.Tracer):
+            return data  # traced under jit: assertion is advisory
+        ok = bool(np.asarray(cond).all())
+        if not ok:
+            raise AssertionError(self.message or str(np.asarray(data)))
+        return data
+
+
+class NoOp(Operation):
+    """Pass-through (DL/nn/tf/NoOp.scala)."""
+
+    def apply(self, params, input, ctx):
+        return input
+
+
+class ControlDependency(NoOp):
+    """Ordering-only edge (DL/nn/tf/ControlDependency); XLA's dataflow
+    semantics make explicit control edges unnecessary — pass-through."""
+
+
+class ModuleToOperation(Operation):
+    """Wrap any Module as a forward-only Operation
+    (DL/nn/ops/ModuleToOperation.scala)."""
+
+    def __init__(self, module: Module, name=None):
+        super().__init__(name or f"op_{module.name}")
+        self.module = module
+
+    def init(self, rng):
+        return self.module.init(rng)
+
+    def apply(self, params, input, ctx):
+        return self.module.apply(params, input, ctx)
+
+
+class TensorModuleWrapper(ModuleToOperation):
+    """Alias for parity with DL/nn/tf/TensorModuleWrapper.scala."""
+
+
+class TensorOp(Operation):
+    """Composable tensor->tensor op built from a chain of functions
+    (DL/nn/ops/TensorOp.scala: `TensorOp.exp.log.sqrt` style fluent DSL)."""
+
+    def __init__(self, fn: Callable = None, name=None):
+        super().__init__(name)
+        self.fn = fn or (lambda x: x)
+
+    def _chain(self, g):
+        return TensorOp(lambda x, f=self.fn: g(f(x)))
+
+    def exp(self):
+        return self._chain(jnp.exp)
+
+    def log(self):
+        return self._chain(jnp.log)
+
+    def sqrt(self):
+        return self._chain(jnp.sqrt)
+
+    def abs(self):
+        return self._chain(jnp.abs)
+
+    def sigmoid(self):
+        return self._chain(jax.nn.sigmoid)
+
+    def tanh(self):
+        return self._chain(jnp.tanh)
+
+    def add(self, c):
+        return self._chain(lambda x: x + c)
+
+    def mul(self, c):
+        return self._chain(lambda x: x * c)
+
+    def pow(self, c):
+        return self._chain(lambda x: jnp.power(x, c))
+
+    def apply(self, params, input, ctx):
+        return self.fn(input)

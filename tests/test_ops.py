@@ -1,0 +1,218 @@
+"""TF-style ops tests (reference TEST/nn/ops/*Spec.scala pattern)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import bigdl_tpu.ops as ops
+import bigdl_tpu.nn as nn
+from bigdl_tpu.utils.table import T
+
+
+def tbl(*xs):
+    return T(*[jnp.asarray(x) for x in xs])
+
+
+class TestElementwise:
+    def test_unary_ops(self):
+        x = jnp.asarray([1.0, 4.0, 9.0])
+        np.testing.assert_allclose(ops.Sqrt().forward(x), [1, 2, 3])
+        np.testing.assert_allclose(ops.Square().forward(x), [1, 16, 81])
+        np.testing.assert_allclose(ops.Sign().forward(jnp.asarray([-2.0, 0.0, 5.0])),
+                                   [-1, 0, 1])
+        assert ops.IsNan().forward(jnp.asarray([jnp.nan, 1.0])).tolist() == [True, False]
+        assert ops.IsInf().forward(jnp.asarray([jnp.inf, 1.0])).tolist() == [True, False]
+
+    def test_special_functions_vs_scipy(self):
+        sp = pytest.importorskip("scipy.special")
+        x = jnp.asarray([0.5, 1.5, 2.5])
+        np.testing.assert_allclose(ops.Digamma().forward(x), sp.digamma(np.asarray(x)), rtol=1e-5)
+        np.testing.assert_allclose(ops.Lgamma().forward(x), sp.gammaln(np.asarray(x)), rtol=1e-5)
+        np.testing.assert_allclose(ops.Erf().forward(x), sp.erf(np.asarray(x)), rtol=1e-5)
+        np.testing.assert_allclose(ops.Erfc().forward(x), sp.erfc(np.asarray(x)), rtol=1e-4)
+
+    def test_binary_ops(self):
+        a, b = jnp.asarray([7.0, -7.0]), jnp.asarray([3.0, 3.0])
+        np.testing.assert_allclose(ops.FloorDiv().forward(tbl(a, b)), [2, -3])
+        np.testing.assert_allclose(ops.TruncateDiv().forward(tbl(a, b)), [2, -2])
+        np.testing.assert_allclose(ops.SquaredDifference().forward(tbl(a, b)), [16, 100])
+        assert ops.Less().forward(tbl(a, b)).tolist() == [False, True]
+
+    def test_approximate_equal(self):
+        out = ops.ApproximateEqual(0.1).forward(
+            tbl(jnp.asarray([1.0, 1.0]), jnp.asarray([1.05, 1.5])))
+        assert out.tolist() == [True, False]
+
+    def test_l2loss(self):
+        np.testing.assert_allclose(
+            float(ops.L2Loss().forward(jnp.asarray([1.0, 2.0, 3.0]))), 7.0)
+
+
+class TestReduceIndex:
+    def test_all_any(self):
+        x = jnp.asarray([[True, False], [True, True]])
+        assert ops.All(axis=1).forward(x).tolist() == [False, True]
+        assert ops.Any(axis=0).forward(x).tolist() == [True, True]
+
+    def test_argmax_gather_topk(self):
+        x = jnp.asarray([[1.0, 5.0, 3.0], [9.0, 0.0, 2.0]])
+        assert ops.ArgMax(axis=1).forward(x).tolist() == [1, 0]
+        g = ops.Gather().forward(tbl(x, jnp.asarray([1, 0])))
+        np.testing.assert_allclose(g, np.asarray(x)[[1, 0]])
+        vals, idx = ops.TopK(2).forward(x[0])[1], ops.TopK(2).forward(x[0])[2]
+        assert vals.tolist() == [5.0, 3.0] and idx.tolist() == [1, 2]
+
+    def test_in_top_k(self):
+        pred = jnp.asarray([[0.1, 0.8, 0.1], [0.9, 0.05, 0.05]])
+        out = ops.InTopK(1).forward(tbl(pred, jnp.asarray([1, 2])))
+        assert out.tolist() == [True, False]
+
+    def test_one_hot(self):
+        oh = ops.OneHot(depth=3, on_value=5.0, off_value=-1.0).forward(
+            jnp.asarray([0, 2]))
+        np.testing.assert_allclose(oh, [[5, -1, -1], [-1, -1, 5]])
+
+    def test_segment_sum(self):
+        x = jnp.asarray([[1.0, 2.0], [3.0, 4.0], [5.0, 6.0]])
+        out = ops.SegmentSum(num_segments=2).forward(tbl(x, jnp.asarray([0, 0, 1])))
+        np.testing.assert_allclose(out, [[4, 6], [5, 6]])
+
+    def test_select_slice_strided(self):
+        cond = jnp.asarray([True, False])
+        out = ops.Select().forward(tbl(cond, jnp.asarray([1.0, 1.0]),
+                                       jnp.asarray([2.0, 2.0])))
+        assert out.tolist() == [1.0, 2.0]
+        x = jnp.arange(12.0).reshape(3, 4)
+        np.testing.assert_allclose(ops.Slice((1, 0), (2, 2)).forward(x),
+                                   np.asarray(x)[1:3, 0:2])
+        np.testing.assert_allclose(
+            ops.StridedSlice((0, 0), (3, 4), (2, 2)).forward(x),
+            np.asarray(x)[::2, ::2])
+
+    def test_shape_rank_cast(self):
+        x = jnp.zeros((2, 3))
+        assert ops.Shape().forward(x).tolist() == [2, 3]
+        assert int(ops.Rank().forward(x)) == 2
+        assert ops.Cast(jnp.int32).forward(jnp.asarray([1.7])).dtype == jnp.int32
+
+
+class TestSamplersConv:
+    def test_random_uniform_deterministic_per_key(self):
+        op = ops.RandomUniform(0.0, 1.0)
+        ctx = nn.ApplyContext(rng=jax.random.PRNGKey(0))
+        a = op.apply({}, jnp.asarray([4]), ctx)
+        ctx2 = nn.ApplyContext(rng=jax.random.PRNGKey(0))
+        b = op.apply({}, jnp.asarray([4]), ctx2)
+        np.testing.assert_allclose(a, b)
+        assert float(a.min()) >= 0.0 and float(a.max()) <= 1.0
+
+    def test_truncated_normal_bounds(self):
+        op = ops.TruncatedNormal(stddev=1.0)
+        ctx = nn.ApplyContext(rng=jax.random.PRNGKey(1))
+        z = op.apply({}, jnp.asarray([1000]), ctx)
+        assert float(jnp.abs(z).max()) <= 2.0 + 1e-5
+
+    def test_depthwise_conv_vs_torch(self):
+        torch = pytest.importorskip("torch")
+        rs = np.random.RandomState(0)
+        x = rs.rand(1, 5, 5, 2).astype(np.float32)
+        w = rs.rand(3, 3, 2, 1).astype(np.float32)  # HW, Cin, mult
+        out = ops.DepthwiseConv2D(padding="VALID").forward(
+            tbl(x, w))
+        tw = torch.tensor(w.transpose(2, 3, 0, 1).reshape(2, 1, 3, 3))
+        ref = torch.nn.functional.conv2d(
+            torch.tensor(x.transpose(0, 3, 1, 2)), tw, groups=2).numpy()
+        np.testing.assert_allclose(np.asarray(out),
+                                   ref.transpose(0, 2, 3, 1), rtol=1e-4, atol=1e-5)
+
+    def test_dilation2d(self):
+        x = jnp.zeros((1, 5, 5, 1)).at[0, 2, 2, 0].set(1.0)
+        filt = jnp.zeros((3, 3, 1))
+        out = ops.Dilation2D(padding="SAME").forward(tbl(x, filt))
+        # dilation with zero filter = local max: the single 1 spreads to 3x3
+        assert float(jnp.sum(out > 0.5)) == 9.0
+
+    def test_cross_entropy_rows(self):
+        logits = jnp.asarray([[1.0, 2.0, 3.0]])
+        labels = jnp.asarray([[0.0, 0.0, 1.0]])
+        out = ops.CrossEntropy().forward(tbl(logits, labels))
+        ref = -jax.nn.log_softmax(logits)[0, 2]
+        np.testing.assert_allclose(float(out[0]), float(ref), rtol=1e-6)
+
+
+class TestControlAndWrap:
+    def test_assert_raises_and_passes(self):
+        a = ops.Assert("boom")
+        out = a.forward(tbl(jnp.asarray(True), jnp.asarray([1.0])))
+        assert out.tolist() == [1.0]
+        with pytest.raises(AssertionError):
+            a.forward(tbl(jnp.asarray(False), jnp.asarray([1.0])))
+
+    def test_operation_no_backward(self):
+        with pytest.raises(RuntimeError):
+            ops.NoOp().backward(None, None)
+
+    def test_module_to_operation(self):
+        lin = nn.Linear(3, 2)
+        op = ops.ModuleToOperation(lin)
+        p = op.init(jax.random.PRNGKey(0))
+        y = op.apply(p, jnp.ones((1, 3)), nn.ApplyContext())
+        assert y.shape == (1, 2)
+
+    def test_tensor_op_chain(self):
+        top = ops.TensorOp().exp().log().mul(2.0)
+        x = jnp.asarray([1.0, 2.0])
+        np.testing.assert_allclose(top.forward(x), [2.0, 4.0], rtol=1e-6)
+
+
+class TestFeatureColumns:
+    def test_bucketized(self):
+        out = ops.BucketizedCol([0.0, 10.0, 100.0]).forward(
+            jnp.asarray([-5.0, 5.0, 50.0, 500.0]))
+        assert out.tolist() == [0, 1, 2, 3]
+
+    def test_hash_bucket_stable(self):
+        op = ops.CategoricalColHashBucket(100)
+        a = op.forward(np.asarray(["cat", "dog", "cat"], object))
+        assert a[0] == a[2] and 0 <= int(a.min()) and int(a.max()) < 100
+
+    def test_voca_list(self):
+        op = ops.CategoricalColVocaList(["a", "b"], num_oov_buckets=2)
+        out = op.forward(np.asarray(["a", "b", "zzz"], object))
+        assert out.tolist()[:2] == [0, 1] and int(out[2]) in (2, 3)
+
+    def test_cross_col(self):
+        op = ops.CrossCol(1000)
+        out = op.forward(T(np.asarray(["a", "b"], object),
+                           np.asarray(["x", "y"], object)))
+        out2 = op.forward(T(np.asarray(["a"], object), np.asarray(["x"], object)))
+        assert int(out[0]) == int(out2[0])  # crossing is positionwise-stable
+
+    def test_indicator(self):
+        out = ops.IndicatorCol(4).forward(jnp.asarray([[0, 2, 2]]))
+        np.testing.assert_allclose(out, [[1, 0, 2, 0]])
+        out = ops.IndicatorCol(4, is_count=False).forward(jnp.asarray([[0, 2, 2]]))
+        np.testing.assert_allclose(out, [[1, 0, 1, 0]])
+
+    def test_kv2tensor(self):
+        out = ops.Kv2Tensor(feat_len=4).forward(
+            np.asarray(["0:1.5,2:3.0", "1:2.0"], object))
+        np.testing.assert_allclose(out, [[1.5, 0, 3.0, 0], [0, 2.0, 0, 0]])
+
+    def test_mkstring_substr(self):
+        s = ops.MkString("-").forward(np.asarray([[1, 2], [3, 4]]))
+        assert s.tolist() == ["1-2", "3-4"]
+        sub = ops.Substr(1, 2).forward(np.asarray(["hello", "world"], object))
+        assert sub.tolist() == ["el", "or"]
+
+
+class TestOpsInGraph:
+    def test_ops_compose_with_layers_in_graph(self):
+        inp = nn.InputNode()
+        h = nn.Linear(4, 3).inputs(inp)
+        out = ops.Cast(jnp.float32).inputs(ops.Exp().inputs(h))
+        g = nn.Graph([inp], [out])
+        y = g.forward(jnp.ones((2, 4)))
+        assert y.shape == (2, 3)
+        assert float(np.asarray(y).min()) > 0  # exp output
