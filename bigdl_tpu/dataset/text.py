@@ -1,0 +1,161 @@
+"""Text pipeline.
+
+Parity: DL/dataset/text/{SentenceTokenizer,SentenceSplitter,
+SentenceBiPadding,Dictionary,TextToLabeledSentence,
+LabeledSentenceToSample}.scala. The reference tokenizes with Apache
+OpenNLP; here a regex tokenizer gives equivalent behavior for the PTB/news20
+pipelines without a JVM dependency.
+"""
+
+from __future__ import annotations
+
+import re
+from collections import Counter
+from typing import Dict, Iterable, Iterator, List, Optional, Sequence
+
+import numpy as np
+
+from bigdl_tpu.dataset.sample import Sample
+from bigdl_tpu.dataset.transformer import Transformer
+
+SENTENCE_START = "SENTENCESTART"
+SENTENCE_END = "SENTENCEEND"
+
+
+class LabeledSentence:
+    """(DL/dataset/text/LabeledSentence.scala) token-id sequence + label
+    sequence (for LM: labels are the inputs shifted by one)."""
+
+    def __init__(self, data: Sequence[float], labels: Sequence[float]):
+        self.data = np.asarray(data, np.float32)
+        self.labels = np.asarray(labels, np.float32)
+
+    def data_length(self) -> int:
+        return self.data.shape[0]
+
+    def label_length(self) -> int:
+        return self.labels.shape[0]
+
+
+class SentenceSplitter(Transformer):
+    """(SentenceSplitter.scala) paragraph string -> sentence strings."""
+
+    _pat = re.compile(r"(?<=[.!?])\s+")
+
+    def apply(self, it: Iterator[str]) -> Iterator[str]:
+        for text in it:
+            for s in self._pat.split(text.strip()):
+                if s:
+                    yield s
+
+
+class SentenceTokenizer(Transformer):
+    """(SentenceTokenizer.scala) sentence string -> token list."""
+
+    _pat = re.compile(r"[A-Za-z0-9']+|[^\sA-Za-z0-9]")
+
+    def apply(self, it: Iterator[str]) -> Iterator[List[str]]:
+        for s in it:
+            yield self._pat.findall(s)
+
+
+class SentenceBiPadding(Transformer):
+    """(SentenceBiPadding.scala) wrap token lists with start/end markers."""
+
+    def __init__(self, start: bool = True, end: bool = True):
+        self.start, self.end = start, end
+
+    def apply(self, it: Iterator[List[str]]) -> Iterator[List[str]]:
+        for toks in it:
+            out = list(toks)
+            if self.start:
+                out = [SENTENCE_START] + out
+            if self.end:
+                out = out + [SENTENCE_END]
+            yield out
+
+
+class Dictionary:
+    """(Dictionary.scala) vocab built from token streams; most-frequent
+    `vocab_size` words keep their own index, everything else maps to an
+    unknown index at the end of the vocab."""
+
+    def __init__(self, sentences: Optional[Iterable[Sequence[str]]] = None,
+                 vocab_size: Optional[int] = None):
+        self._word2index: Dict[str, int] = {}
+        self._index2word: Dict[int, str] = {}
+        if sentences is not None:
+            counts = Counter(tok for s in sentences for tok in s)
+            common = counts.most_common(vocab_size)
+            for i, (w, _) in enumerate(common):
+                self._word2index[w] = i
+                self._index2word[i] = w
+
+    def vocab_size(self) -> int:
+        return len(self._word2index)
+
+    def get_index(self, word: str) -> int:
+        """Unknown words map to vocab_size() (one-past-the-end), matching
+        the reference's discard/unknown handling."""
+        return self._word2index.get(word, len(self._word2index))
+
+    def get_word(self, index: int) -> str:
+        return self._index2word.get(int(index), "<unk>")
+
+    def word2index(self) -> Dict[str, int]:
+        return dict(self._word2index)
+
+    def save(self, path: str):
+        import json
+        with open(path, "w") as f:
+            json.dump(self._word2index, f)
+
+    @staticmethod
+    def load(path: str) -> "Dictionary":
+        import json
+        d = Dictionary()
+        with open(path) as f:
+            d._word2index = json.load(f)
+        d._index2word = {i: w for w, i in d._word2index.items()}
+        return d
+
+
+class TextToLabeledSentence(Transformer):
+    """(TextToLabeledSentence.scala) token list -> LabeledSentence with
+    next-token labels (language modelling)."""
+
+    def __init__(self, dictionary: Dictionary):
+        self.dictionary = dictionary
+
+    def apply(self, it: Iterator[List[str]]) -> Iterator[LabeledSentence]:
+        for toks in it:
+            ids = [self.dictionary.get_index(t) for t in toks]
+            if len(ids) < 2:
+                continue
+            yield LabeledSentence(ids[:-1], ids[1:])
+
+
+class LabeledSentenceToSample(Transformer):
+    """(LabeledSentenceToSample.scala) LabeledSentence -> Sample. With
+    `one_hot_vocab_size` set, features become one-hot rows (reference
+    SimpleRNN path); otherwise raw id sequences feed an embedding layer.
+    Labels are 1-based class indices (Torch convention)."""
+
+    def __init__(self, one_hot_vocab_size: Optional[int] = None,
+                 fixed_length: Optional[int] = None):
+        self.vocab = one_hot_vocab_size
+        self.fixed_length = fixed_length
+
+    def apply(self, it: Iterator[LabeledSentence]) -> Iterator[Sample]:
+        for ls in it:
+            data, labels = ls.data, ls.labels
+            if self.fixed_length is not None:
+                n = self.fixed_length
+                data = np.pad(data[:n], (0, max(0, n - len(data))))
+                labels = np.pad(labels[:n], (0, max(0, n - len(labels))))
+            if self.vocab:
+                feat = np.zeros((len(data), self.vocab), np.float32)
+                feat[np.arange(len(data)), data.astype(int)] = 1.0
+            else:
+                feat = data
+            yield Sample(feat, labels + 1.0)

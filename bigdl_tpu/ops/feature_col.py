@@ -49,8 +49,9 @@ class CategoricalColHashBucket(Operation):
 
     def apply(self, params, input, ctx):
         arr = np.asarray(input)
-        out = np.vectorize(lambda s: _stable_hash(s, self.size))(arr)
-        return jnp.asarray(out.astype(np.int32))
+        out = np.vectorize(lambda s: _stable_hash(s, self.size),
+                           otypes=[np.int32])(arr)
+        return jnp.asarray(out)
 
 
 class CategoricalColVocaList(Operation):
@@ -75,7 +76,7 @@ class CategoricalColVocaList(Operation):
 
     def apply(self, params, input, ctx):
         arr = np.asarray(input)
-        return jnp.asarray(np.vectorize(self._map)(arr).astype(np.int32))
+        return jnp.asarray(np.vectorize(self._map, otypes=[np.int32])(arr))
 
 
 class CrossCol(Operation):

@@ -9,7 +9,11 @@ compose with layers inside `Graph` and stay jit-compilable.
 Numeric ops are pure jnp and TPU-native. String ops (Substr, MkString, the
 feature-column family) run host-side on numpy object arrays — exactly as the
 reference runs them on the JVM heap, outside the MKL compute path — and are
-documented as non-jittable.
+not jittable. Ops whose *spec operand* shapes the output (Pad's paddings,
+Tile's multiples, RangeOps' bounds, the Table-axis form of reductions,
+RandomUniform/TruncatedNormal's shape) need that operand to be a concrete
+(non-traced) value: XLA requires static shapes, so under jit the spec must
+be closed over, not passed as a traced argument.
 """
 
 from __future__ import annotations
@@ -231,8 +235,9 @@ class InTopK(Operation):
 class TopK(Operation):
     """Top-k values + 0-based indices (DL/nn/ops/TopK.scala)."""
 
-    def __init__(self, k: int, sorted: bool = True, start_index: int = 0,
-                 name=None):
+    def __init__(self, k: int, start_index: int = 0, name=None):
+        # note: output is always score-sorted (lax.top_k semantics; the
+        # reference's sorted=false mode is not supported)
         super().__init__(name)
         self.k = k
         self.start_index = start_index
@@ -427,8 +432,18 @@ class Dilation2D(Operation):
     def apply(self, params, input, ctx):
         x, filt = input[1], input[2]  # [B,H,W,C], [kh,kw,C]
         kh, kw, c = filt.shape
+        if self.padding == "SAME":
+            # out-of-bounds elements must lose the max (TF dilation2d
+            # -inf semantics); pre-pad with the dtype minimum — true -inf
+            # would NaN inside the conv-based patch extraction (0 * -inf)
+            ekh = (kh - 1) * self.rates[0] + 1
+            ekw = (kw - 1) * self.rates[1] + 1
+            ph, pw = ekh - 1, ekw - 1
+            x = jnp.pad(x, ((0, 0), (ph // 2, ph - ph // 2),
+                            (pw // 2, pw - pw // 2), (0, 0)),
+                        constant_values=float(jnp.finfo(x.dtype).min) / 4)
         patches = lax.conv_general_dilated_patches(
-            x, (kh, kw), self.strides, self.padding,
+            x, (kh, kw), self.strides, "VALID",
             rhs_dilation=self.rates,
             dimension_numbers=("NHWC", "HWIO", "NHWC"))
         B, oh, ow, _ = patches.shape

@@ -1,0 +1,2 @@
+"""bigdl_tpu.transform — vision/text feature-transform pipelines
+(reference DL/transform parity)."""

@@ -1,0 +1,23 @@
+from bigdl_tpu.transform.vision.image import (FeatureTransformer, ImageFeature,
+                                              ImageFrame, LocalImageFrame)
+from bigdl_tpu.transform.vision import augmentation
+from bigdl_tpu.transform.vision.augmentation import (AspectScale, Brightness,
+                                                     CenterCrop, ChannelNormalize,
+                                                     ChannelOrder,
+                                                     ChannelScaledNormalizer,
+                                                     ColorJitter, Contrast,
+                                                     Expand, Filler, FixedCrop,
+                                                     HFlip, Hue, Lighting,
+                                                     PixelNormalizer,
+                                                     RandomAlterAspect,
+                                                     RandomCrop, RandomCropper,
+                                                     RandomResize,
+                                                     RandomTransformer, Resize,
+                                                     Saturation)
+from bigdl_tpu.transform.vision.label import (BatchSampler, BoundingBox,
+                                              RoiHFlip, RoiLabel, RoiNormalize,
+                                              RoiResize)
+from bigdl_tpu.transform.vision.convertor import (ImageFeatureToSample,
+                                                  ImageFrameToSample,
+                                                  MatToFloats, MatToTensor,
+                                                  MTImageFeatureToBatch)

@@ -1,0 +1,392 @@
+"""Vision augmentation transformers.
+
+Parity: DL/transform/vision/image/augmentation/*.scala (Brightness, Contrast,
+Hue, Saturation, ChannelOrder, ChannelNormalize, ChannelScaledNormalizer,
+ColorJitter, Crop family, Expand, Filler, HFlip, PixelNormalizer,
+RandomAlterAspect, RandomCropper, RandomResize, RandomTransformer, Resize)
+plus DL/dataset/image/Lighting.scala (AlexNet-style PCA noise).
+
+All transforms mutate `feature['floats']`, a HWC float32 array in BGR order
+(the reference's OpenCV convention). Host-side numpy; the resize uses PIL's
+bilinear, matching OpenCV INTER_LINEAR closely enough for training.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+
+from bigdl_tpu.transform.vision.image import FeatureTransformer, ImageFeature
+
+
+def _resize_arr(arr: np.ndarray, h: int, w: int) -> np.ndarray:
+    from PIL import Image
+    im = Image.fromarray(np.clip(arr, 0, 255).astype(np.uint8))
+    return np.asarray(im.resize((w, h), Image.BILINEAR), np.float32)
+
+
+class Resize(FeatureTransformer):
+    """(augmentation/Resize.scala) resize to (resize_h, resize_w)."""
+
+    def __init__(self, resize_h: int, resize_w: int, seed=None):
+        super().__init__(seed)
+        self.h, self.w = resize_h, resize_w
+
+    def transform_mat(self, f: ImageFeature):
+        f.image = _resize_arr(f.image, self.h, self.w)
+
+
+class AspectScale(FeatureTransformer):
+    """(augmentation/AspectScale.scala) scale shorter edge to `scale`,
+    capping the longer edge at max_size."""
+
+    def __init__(self, scale: int, max_size: int = 1000, seed=None):
+        super().__init__(seed)
+        self.scale, self.max_size = scale, max_size
+
+    def transform_mat(self, f: ImageFeature):
+        h, w = f.height(), f.width()
+        short, long = min(h, w), max(h, w)
+        ratio = min(self.scale / short, self.max_size / long)
+        f.image = _resize_arr(f.image, int(round(h * ratio)), int(round(w * ratio)))
+
+
+class RandomResize(FeatureTransformer):
+    """(augmentation/RandomResize.scala) resize to a random size in
+    [min_size, max_size] on the shorter edge, keeping aspect."""
+
+    def __init__(self, min_size: int, max_size: int, seed=None):
+        super().__init__(seed)
+        self.min_size, self.max_size = min_size, max_size
+
+    def transform_mat(self, f: ImageFeature):
+        s = int(self.rng.randint(self.min_size, self.max_size + 1))
+        h, w = f.height(), f.width()
+        ratio = s / min(h, w)
+        f.image = _resize_arr(f.image, int(round(h * ratio)), int(round(w * ratio)))
+
+
+class Brightness(FeatureTransformer):
+    """(augmentation/Brightness.scala) add U(delta_low, delta_high)."""
+
+    def __init__(self, delta_low: float = -32.0, delta_high: float = 32.0,
+                 seed=None):
+        super().__init__(seed)
+        self.lo, self.hi = delta_low, delta_high
+
+    def transform_mat(self, f: ImageFeature):
+        f.image = f.image + self.rng.uniform(self.lo, self.hi)
+
+
+class Contrast(FeatureTransformer):
+    """(augmentation/Contrast.scala) multiply by U(lo, hi)."""
+
+    def __init__(self, delta_low: float = 0.5, delta_high: float = 1.5,
+                 seed=None):
+        super().__init__(seed)
+        self.lo, self.hi = delta_low, delta_high
+
+    def transform_mat(self, f: ImageFeature):
+        f.image = f.image * self.rng.uniform(self.lo, self.hi)
+
+
+def _bgr_to_hsv(img: np.ndarray) -> np.ndarray:
+    import colorsys
+    rgb = np.clip(img[..., ::-1] / 255.0, 0, 1)
+    mx = rgb.max(-1)
+    mn = rgb.min(-1)
+    diff = mx - mn + 1e-12
+    r, g, b = rgb[..., 0], rgb[..., 1], rgb[..., 2]
+    h = np.where(mx == r, (g - b) / diff % 6,
+                 np.where(mx == g, (b - r) / diff + 2, (r - g) / diff + 4))
+    h = h * 60.0
+    s = np.where(mx > 0, diff / (mx + 1e-12), 0.0)
+    return np.stack([h, s, mx], -1)
+
+
+def _hsv_to_bgr(hsv: np.ndarray) -> np.ndarray:
+    h, s, v = hsv[..., 0] / 60.0, hsv[..., 1], hsv[..., 2]
+    c = v * s
+    x = c * (1 - np.abs(h % 2 - 1))
+    m = v - c
+    z = np.zeros_like(c)
+    idx = (np.floor(h).astype(int) % 6)[..., None]  # broadcast over channels
+    rgb = np.select(
+        [idx == 0, idx == 1, idx == 2, idx == 3, idx == 4, idx == 5],
+        [np.stack([c, x, z], -1), np.stack([x, c, z], -1),
+         np.stack([z, c, x], -1), np.stack([z, x, c], -1),
+         np.stack([x, z, c], -1), np.stack([c, z, x], -1)])
+    rgb = (rgb + m[..., None]) * 255.0
+    return rgb[..., ::-1]
+
+
+class Hue(FeatureTransformer):
+    """(augmentation/Hue.scala) rotate hue by U(lo, hi) degrees."""
+
+    def __init__(self, delta_low: float = -18.0, delta_high: float = 18.0,
+                 seed=None):
+        super().__init__(seed)
+        self.lo, self.hi = delta_low, delta_high
+
+    def transform_mat(self, f: ImageFeature):
+        hsv = _bgr_to_hsv(f.image)
+        hsv[..., 0] = (hsv[..., 0] + self.rng.uniform(self.lo, self.hi)) % 360
+        f.image = _hsv_to_bgr(hsv)
+
+
+class Saturation(FeatureTransformer):
+    """(augmentation/Saturation.scala) scale saturation by U(lo, hi)."""
+
+    def __init__(self, delta_low: float = 0.5, delta_high: float = 1.5,
+                 seed=None):
+        super().__init__(seed)
+        self.lo, self.hi = delta_low, delta_high
+
+    def transform_mat(self, f: ImageFeature):
+        hsv = _bgr_to_hsv(f.image)
+        hsv[..., 1] = np.clip(hsv[..., 1] * self.rng.uniform(self.lo, self.hi),
+                              0, 1)
+        f.image = _hsv_to_bgr(hsv)
+
+
+class ChannelOrder(FeatureTransformer):
+    """(augmentation/ChannelOrder.scala) randomly permute channels."""
+
+    def transform_mat(self, f: ImageFeature):
+        perm = self.rng.permutation(f.image.shape[-1])
+        f.image = f.image[..., perm]
+
+
+class ChannelNormalize(FeatureTransformer):
+    """(augmentation/ChannelNormalize.scala) per-channel (x - mean) / std."""
+
+    def __init__(self, mean_b: float, mean_g: float, mean_r: float,
+                 std_b: float = 1.0, std_g: float = 1.0, std_r: float = 1.0,
+                 seed=None):
+        super().__init__(seed)
+        self.mean = np.asarray([mean_b, mean_g, mean_r], np.float32)
+        self.std = np.asarray([std_b, std_g, std_r], np.float32)
+
+    def transform_mat(self, f: ImageFeature):
+        f.image = (f.image - self.mean) / self.std
+
+
+class ChannelScaledNormalizer(FeatureTransformer):
+    """(augmentation/ChannelScaledNormalizer.scala) subtract per-channel
+    means then scale."""
+
+    def __init__(self, mean_b: int, mean_g: int, mean_r: int, scale: float,
+                 seed=None):
+        super().__init__(seed)
+        self.mean = np.asarray([mean_b, mean_g, mean_r], np.float32)
+        self.scale = scale
+
+    def transform_mat(self, f: ImageFeature):
+        f.image = (f.image - self.mean) * self.scale
+
+
+class PixelNormalizer(FeatureTransformer):
+    """(augmentation/PixelNormalizer.scala) subtract a full mean image."""
+
+    def __init__(self, means: np.ndarray, seed=None):
+        super().__init__(seed)
+        self.means = np.asarray(means, np.float32)
+
+    def transform_mat(self, f: ImageFeature):
+        f.image = f.image - self.means.reshape(f.image.shape)
+
+
+class HFlip(FeatureTransformer):
+    """(augmentation/HFlip.scala) horizontal mirror with probability p
+    (reference flips unconditionally; RandomTransformer adds the coin —
+    both styles supported via `threshold`)."""
+
+    def __init__(self, threshold: float = 1.0, seed=None):
+        super().__init__(seed)
+        self.threshold = threshold
+
+    def transform_mat(self, f: ImageFeature):
+        if self.threshold >= 1.0 or self.rng.rand() < self.threshold:
+            f.image = f.image[:, ::-1].copy()
+            f["flipped"] = True
+
+
+class CenterCrop(FeatureTransformer):
+    """(augmentation/Crop.scala CenterCrop) crop [h, w] from the center."""
+
+    def __init__(self, crop_width: int, crop_height: int, seed=None):
+        super().__init__(seed)
+        self.cw, self.ch = crop_width, crop_height
+
+    def transform_mat(self, f: ImageFeature):
+        h, w = f.height(), f.width()
+        y0 = max((h - self.ch) // 2, 0)
+        x0 = max((w - self.cw) // 2, 0)
+        f.image = f.image[y0:y0 + self.ch, x0:x0 + self.cw].copy()
+
+
+class RandomCrop(FeatureTransformer):
+    """(augmentation/Crop.scala RandomCrop) crop [h, w] at random offset."""
+
+    def __init__(self, crop_width: int, crop_height: int, seed=None):
+        super().__init__(seed)
+        self.cw, self.ch = crop_width, crop_height
+
+    def transform_mat(self, f: ImageFeature):
+        h, w = f.height(), f.width()
+        y0 = self.rng.randint(0, max(h - self.ch, 0) + 1)
+        x0 = self.rng.randint(0, max(w - self.cw, 0) + 1)
+        f.image = f.image[y0:y0 + self.ch, x0:x0 + self.cw].copy()
+
+
+class FixedCrop(FeatureTransformer):
+    """(augmentation/Crop.scala FixedCrop) crop by absolute or normalized
+    corner coords (x1, y1, x2, y2)."""
+
+    def __init__(self, x1: float, y1: float, x2: float, y2: float,
+                 normalized: bool = True, seed=None):
+        super().__init__(seed)
+        self.box = (x1, y1, x2, y2)
+        self.normalized = normalized
+
+    def transform_mat(self, f: ImageFeature):
+        x1, y1, x2, y2 = self.box
+        if self.normalized:
+            x1, x2 = x1 * f.width(), x2 * f.width()
+            y1, y2 = y1 * f.height(), y2 * f.height()
+        f.image = f.image[int(y1):int(y2), int(x1):int(x2)].copy()
+
+
+class Expand(FeatureTransformer):
+    """(augmentation/Expand.scala) place the image on a larger mean-filled
+    canvas at a random offset (SSD zoom-out)."""
+
+    def __init__(self, means_b: float = 123.0, means_g: float = 117.0,
+                 means_r: float = 104.0, max_expand_ratio: float = 4.0,
+                 seed=None):
+        super().__init__(seed)
+        self.means = np.asarray([means_b, means_g, means_r], np.float32)
+        self.max_ratio = max_expand_ratio
+
+    def transform_mat(self, f: ImageFeature):
+        ratio = self.rng.uniform(1.0, self.max_ratio)
+        h, w, c = f.image.shape
+        nh, nw = int(h * ratio), int(w * ratio)
+        canvas = np.tile(self.means, (nh, nw, 1)).astype(np.float32)
+        y0 = self.rng.randint(0, nh - h + 1)
+        x0 = self.rng.randint(0, nw - w + 1)
+        canvas[y0:y0 + h, x0:x0 + w] = f.image
+        f["expand_offset"] = (x0, y0, ratio)
+        f.image = canvas
+
+
+class Filler(FeatureTransformer):
+    """(augmentation/Filler.scala) fill a normalized sub-rect with a value."""
+
+    def __init__(self, start_x: float, start_y: float, end_x: float,
+                 end_y: float, value: float = 255.0, seed=None):
+        super().__init__(seed)
+        self.rect = (start_x, start_y, end_x, end_y)
+        self.value = value
+
+    def transform_mat(self, f: ImageFeature):
+        x1, y1, x2, y2 = self.rect
+        h, w = f.height(), f.width()
+        f.image[int(y1 * h):int(y2 * h), int(x1 * w):int(x2 * w)] = self.value
+
+
+class RandomAlterAspect(FeatureTransformer):
+    """(augmentation/RandomAlterAspect.scala) random-area/aspect crop then
+    resize to a fixed square (Inception-style)."""
+
+    def __init__(self, min_area_ratio: float = 0.08,
+                 max_area_ratio: float = 1.0, min_aspect_ratio: float = 0.75,
+                 target_size: int = 224, seed=None):
+        super().__init__(seed)
+        self.min_area, self.max_area = min_area_ratio, max_area_ratio
+        self.min_aspect = min_aspect_ratio
+        self.size = target_size
+
+    def transform_mat(self, f: ImageFeature):
+        h, w = f.height(), f.width()
+        area = h * w
+        for _ in range(10):
+            target_area = self.rng.uniform(self.min_area, self.max_area) * area
+            aspect = self.rng.uniform(self.min_aspect, 1.0 / self.min_aspect)
+            cw = int(round(np.sqrt(target_area * aspect)))
+            ch = int(round(np.sqrt(target_area / aspect)))
+            if cw <= w and ch <= h:
+                y0 = self.rng.randint(0, h - ch + 1)
+                x0 = self.rng.randint(0, w - cw + 1)
+                f.image = _resize_arr(f.image[y0:y0 + ch, x0:x0 + cw],
+                                      self.size, self.size)
+                return
+        f.image = _resize_arr(f.image, self.size, self.size)
+
+
+class RandomCropper(FeatureTransformer):
+    """(augmentation/RandomCropper.scala) random crop + optional mirror."""
+
+    def __init__(self, crop_w: int, crop_h: int, mirror: bool = True,
+                 seed=None):
+        super().__init__(seed)
+        self.crop = RandomCrop(crop_w, crop_h)
+        self.crop.rng = self.rng
+        self.mirror = mirror
+
+    def transform_mat(self, f: ImageFeature):
+        self.crop.transform_mat(f)
+        if self.mirror and self.rng.rand() < 0.5:
+            f.image = f.image[:, ::-1].copy()
+
+
+class RandomTransformer(FeatureTransformer):
+    """(augmentation/RandomTransformer.scala) apply inner transformer with
+    probability p."""
+
+    def __init__(self, inner: FeatureTransformer, prob: float, seed=None):
+        super().__init__(seed)
+        self.inner, self.prob = inner, prob
+
+    def transform_mat(self, f: ImageFeature):
+        if self.rng.rand() < self.prob:
+            self.inner.transform(f)
+
+
+class ColorJitter(FeatureTransformer):
+    """(augmentation/ColorJitter.scala) random order of brightness /
+    contrast / saturation (reference randomizes the BGR-op ordering)."""
+
+    def __init__(self, brightness: float = 32.0, contrast: float = 0.5,
+                 saturation: float = 0.5, seed=None):
+        super().__init__(seed)
+        self.ts = [Brightness(-brightness, brightness),
+                   Contrast(1 - contrast, 1 + contrast),
+                   Saturation(1 - saturation, 1 + saturation)]
+        for t in self.ts:
+            t.rng = self.rng
+
+    def transform_mat(self, f: ImageFeature):
+        for i in self.rng.permutation(len(self.ts)):
+            self.ts[i].transform_mat(f)
+
+
+class Lighting(FeatureTransformer):
+    """AlexNet-style PCA lighting noise (DL/dataset/image/ColorJitter
+    companion Lighting.scala); eigen basis from ImageNet statistics."""
+
+    _eigval = np.asarray([0.2175, 0.0188, 0.0045], np.float32)
+    _eigvec = np.asarray([[-0.5675, 0.7192, 0.4009],
+                          [-0.5808, -0.0045, -0.8140],
+                          [-0.5836, -0.6948, 0.4203]], np.float32)
+
+    def __init__(self, alphastd: float = 0.1, seed=None):
+        super().__init__(seed)
+        self.alphastd = alphastd
+
+    def transform_mat(self, f: ImageFeature):
+        alpha = self.rng.normal(0, self.alphastd, 3).astype(np.float32)
+        rgb_shift = (self._eigvec * alpha * self._eigval).sum(axis=1)
+        # image is BGR; shift is in RGB order
+        f.image = f.image + rgb_shift[::-1] * 255.0

@@ -1,0 +1,119 @@
+"""ImageFeature -> tensor/Sample/batch convertors.
+
+Parity: DL/transform/vision/image/Convertor.scala (MatToFloats, MatToTensor,
+ImageFrameToSample) and MTImageFeatureToBatch.scala (multi-threaded batch
+assembly). The MT batcher uses a thread pool exactly where the reference
+used Engine.default threads; decode/augment is pure-numpy (GIL released in
+PIL/numpy hot loops), and the assembled batch is one contiguous array ready
+for jax.device_put.
+"""
+
+from __future__ import annotations
+
+from concurrent.futures import ThreadPoolExecutor
+from typing import Iterable, Iterator, List, Optional
+
+import numpy as np
+
+from bigdl_tpu.dataset.sample import MiniBatch, Sample
+from bigdl_tpu.transform.vision.image import (FeatureTransformer, ImageFeature,
+                                              LocalImageFrame)
+from bigdl_tpu.transform.vision.label import RoiLabel
+
+
+class MatToFloats(FeatureTransformer):
+    """(Convertor.scala MatToFloats) ensure the image slot is float32 HWC."""
+
+    def __init__(self, valid_height: int = 300, valid_width: int = 300,
+                 seed=None):
+        super().__init__(seed)
+        self.h, self.w = valid_height, valid_width
+
+    def transform_mat(self, f: ImageFeature):
+        f.image = np.ascontiguousarray(f.image, np.float32)
+
+
+class MatToTensor(FeatureTransformer):
+    """(Convertor.scala MatToTensor) HWC float image -> tensor slot. The
+    reference emits CHW; TPU-native layout is HWC (NHWC batches), so `to_chw`
+    defaults False and exists for parity testing."""
+
+    def __init__(self, to_chw: bool = False, seed=None):
+        super().__init__(seed)
+        self.to_chw = to_chw
+
+    def transform_mat(self, f: ImageFeature):
+        img = np.ascontiguousarray(f.image, np.float32)
+        f["tensor"] = img.transpose(2, 0, 1) if self.to_chw else img
+
+
+class ImageFeatureToSample(FeatureTransformer):
+    """Build a Sample from feature + label slots
+    (Convertor.scala ImageFrameToSample per-feature step)."""
+
+    def __init__(self, seed=None):
+        super().__init__(seed)
+
+    def transform_mat(self, f: ImageFeature):
+        tensor = f.get("tensor")
+        if tensor is None:
+            tensor = np.ascontiguousarray(f.image, np.float32)
+        label = f.get(ImageFeature.LABEL)
+        if isinstance(label, RoiLabel):
+            f[ImageFeature.SAMPLE] = Sample(tensor,
+                                            [label.classes, label.bboxes])
+        elif label is not None:
+            f[ImageFeature.SAMPLE] = Sample(tensor, np.asarray(label))
+        else:
+            f[ImageFeature.SAMPLE] = Sample(tensor)
+
+
+def ImageFrameToSample(frame: LocalImageFrame) -> List[Sample]:
+    """(Convertor.scala ImageFrameToSample) frame -> list of Samples."""
+    conv = ImageFeatureToSample()
+    return [conv.transform(f)[ImageFeature.SAMPLE] for f in frame]
+
+
+class MTImageFeatureToBatch:
+    """(MTImageFeatureToBatch.scala) multi-threaded transform + batch.
+
+    Pulls ImageFeatures from an iterable, applies `transformer` across
+    `num_threads` workers, and yields MiniBatches of stacked [B, H, W, C]
+    images + labels. Equal-size output requires fixed (height, width).
+    """
+
+    def __init__(self, width: int, height: int, batch_size: int,
+                 transformer: Optional[FeatureTransformer] = None,
+                 num_threads: int = 4, drop_remainder: bool = False):
+        self.w, self.h = width, height
+        self.batch_size = batch_size
+        self.transformer = transformer
+        self.num_threads = num_threads
+        self.drop_remainder = drop_remainder
+
+    def _prep(self, f: ImageFeature) -> ImageFeature:
+        if self.transformer is not None:
+            f = self.transformer.transform(f)
+        if f.image.shape[:2] != (self.h, self.w):
+            from bigdl_tpu.transform.vision.augmentation import _resize_arr
+            f.image = _resize_arr(f.image, self.h, self.w)
+        return f
+
+    def __call__(self, features: Iterable[ImageFeature]) -> Iterator[MiniBatch]:
+        buf: List[ImageFeature] = []
+        with ThreadPoolExecutor(max_workers=self.num_threads) as pool:
+            for f in pool.map(self._prep, features):
+                buf.append(f)
+                if len(buf) == self.batch_size:
+                    yield self._to_batch(buf)
+                    buf = []
+        if buf and not self.drop_remainder:
+            yield self._to_batch(buf)
+
+    def _to_batch(self, feats: List[ImageFeature]) -> MiniBatch:
+        imgs = np.stack([np.ascontiguousarray(f.image, np.float32)
+                         for f in feats])
+        labels = [f.get(ImageFeature.LABEL) for f in feats]
+        if all(l is not None and not isinstance(l, RoiLabel) for l in labels):
+            return MiniBatch(imgs, np.asarray(labels, np.float32))
+        return MiniBatch(imgs, None)

@@ -1,0 +1,165 @@
+"""ImageFeature / ImageFrame / FeatureTransformer core.
+
+Parity: DL/transform/vision/image/{ImageFeature,ImageFrame,
+FeatureTransformer}.scala. The reference's pipeline is OpenCV-Mat based
+(opencv/OpenCVMat.scala); here images are numpy HWC float32 arrays (BGR
+channel order preserved for parity with the reference's OpenCV convention),
+decoded via PIL on the host. The TPU never sees any of this — like the
+reference, augmentation is host-side preprocessing feeding the device queue.
+"""
+
+from __future__ import annotations
+
+import io
+import os
+from typing import Any, Callable, Dict, Iterable, Iterator, List, Optional
+
+import numpy as np
+
+
+class ImageFeature(dict):
+    """One image record: a dict of named slots (ImageFeature.scala keys)."""
+
+    # canonical keys (ImageFeature.scala:262-300)
+    BYTES = "bytes"
+    MAT = "floats"          # decoded HWC float32 (BGR)
+    URI = "uri"
+    LABEL = "label"
+    ORIGINAL_SIZE = "originalSize"
+    SAMPLE = "sample"
+    PREDICT = "predict"
+    BOUNDING_BOX = "boundingBox"
+
+    def __init__(self, image: Optional[np.ndarray] = None, label=None,
+                 uri: Optional[str] = None, **kw):
+        super().__init__(**kw)
+        if image is not None:
+            self[self.MAT] = np.asarray(image, np.float32)
+            self[self.ORIGINAL_SIZE] = self[self.MAT].shape
+        if label is not None:
+            self[self.LABEL] = label
+        if uri is not None:
+            self[self.URI] = uri
+
+    @property
+    def image(self) -> np.ndarray:
+        return self[self.MAT]
+
+    @image.setter
+    def image(self, v: np.ndarray):
+        self[self.MAT] = np.asarray(v, np.float32)
+
+    @property
+    def label(self):
+        return self.get(self.LABEL)
+
+    def height(self) -> int:
+        return self[self.MAT].shape[0]
+
+    def width(self) -> int:
+        return self[self.MAT].shape[1]
+
+    @staticmethod
+    def read(path: str, label=None, to_bgr: bool = True) -> "ImageFeature":
+        """Decode an image file (PIL host-side; reference used OpenCV
+        imread which yields BGR — we match that byte order)."""
+        from PIL import Image
+        with Image.open(path) as im:
+            arr = np.asarray(im.convert("RGB"), np.float32)
+        if to_bgr:
+            arr = arr[..., ::-1]
+        f = ImageFeature(arr, label=label, uri=path)
+        return f
+
+    @staticmethod
+    def from_bytes(data: bytes, label=None, uri=None,
+                   to_bgr: bool = True) -> "ImageFeature":
+        from PIL import Image
+        with Image.open(io.BytesIO(data)) as im:
+            arr = np.asarray(im.convert("RGB"), np.float32)
+        if to_bgr:
+            arr = arr[..., ::-1]
+        return ImageFeature(arr, label=label, uri=uri)
+
+
+class FeatureTransformer:
+    """Base vision transformer (FeatureTransformer.scala): maps ImageFeature
+    -> ImageFeature in place; compose with `>>`. Randomness draws from a
+    per-transformer numpy Generator seeded explicitly for reproducibility."""
+
+    def __init__(self, seed: Optional[int] = None):
+        self.rng = np.random.RandomState(seed)
+
+    def set_seed(self, seed: int):
+        self.rng = np.random.RandomState(seed)
+        return self
+
+    def transform_mat(self, feature: ImageFeature) -> None:
+        """Override: mutate feature['floats'] (and related slots)."""
+        raise NotImplementedError
+
+    def transform(self, feature: ImageFeature) -> ImageFeature:
+        self.transform_mat(feature)
+        return feature
+
+    def __call__(self, feature: ImageFeature) -> ImageFeature:
+        return self.transform(feature)
+
+    def __rshift__(self, other: "FeatureTransformer") -> "FeatureTransformer":
+        return _ChainedFeature(self, other)
+
+    def apply_frame(self, frame: "ImageFrame") -> "ImageFrame":
+        return frame.transform(self)
+
+
+class _ChainedFeature(FeatureTransformer):
+    def __init__(self, a: FeatureTransformer, b: FeatureTransformer):
+        super().__init__()
+        self.a, self.b = a, b
+
+    def transform(self, feature: ImageFeature) -> ImageFeature:
+        return self.b.transform(self.a.transform(feature))
+
+
+class ImageFrame:
+    """A collection of ImageFeatures (ImageFrame.scala). `read` builds a
+    LocalImageFrame from files/dir; `transform` maps a FeatureTransformer."""
+
+    @staticmethod
+    def read(path: str, with_label: bool = False) -> "LocalImageFrame":
+        exts = (".jpg", ".jpeg", ".png", ".bmp")
+        if os.path.isdir(path):
+            files = sorted(os.path.join(path, f) for f in os.listdir(path)
+                           if f.lower().endswith(exts))
+        else:
+            files = [path]
+        return LocalImageFrame([ImageFeature.read(f) for f in files])
+
+    @staticmethod
+    def array(features: Iterable[ImageFeature]) -> "LocalImageFrame":
+        return LocalImageFrame(list(features))
+
+    def transform(self, t: FeatureTransformer) -> "ImageFrame":
+        raise NotImplementedError
+
+    def is_local(self) -> bool:
+        return isinstance(self, LocalImageFrame)
+
+
+class LocalImageFrame(ImageFrame):
+    def __init__(self, features: List[ImageFeature]):
+        self.features = features
+
+    def transform(self, t) -> "LocalImageFrame":
+        if isinstance(t, FeatureTransformer):
+            return LocalImageFrame([t.transform(f) for f in self.features])
+        return LocalImageFrame([t(f) for f in self.features])
+
+    def __len__(self):
+        return len(self.features)
+
+    def __iter__(self) -> Iterator[ImageFeature]:
+        return iter(self.features)
+
+    def __getitem__(self, i):
+        return self.features[i]

@@ -1,0 +1,135 @@
+"""ROI label transforms + bbox containers.
+
+Parity: DL/transform/vision/image/label/roi/*.scala (RoiLabel, RoiNormalize,
+RoiHFlip, RoiResize, BatchSampler) and util/{BboxUtil,BoundingBox}.scala.
+Box math reuses bigdl_tpu.nn.detection (single source of truth).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from bigdl_tpu.transform.vision.image import FeatureTransformer, ImageFeature
+
+
+class BoundingBox:
+    """(util/BoundingBox.scala) corner-format box, normalized or absolute."""
+
+    def __init__(self, x1: float, y1: float, x2: float, y2: float,
+                 normalized: bool = True):
+        self.x1, self.y1, self.x2, self.y2 = x1, y1, x2, y2
+        self.normalized = normalized
+
+    def area(self) -> float:
+        return max(self.x2 - self.x1, 0.0) * max(self.y2 - self.y1, 0.0)
+
+    def jaccard(self, other: "BoundingBox") -> float:
+        ix = max(min(self.x2, other.x2) - max(self.x1, other.x1), 0.0)
+        iy = max(min(self.y2, other.y2) - max(self.y1, other.y1), 0.0)
+        inter = ix * iy
+        union = self.area() + other.area() - inter
+        return inter / union if union > 0 else 0.0
+
+    def to_array(self) -> np.ndarray:
+        return np.asarray([self.x1, self.y1, self.x2, self.y2], np.float32)
+
+    def __repr__(self):
+        return f"BoundingBox({self.x1}, {self.y1}, {self.x2}, {self.y2})"
+
+
+class RoiLabel:
+    """(label/roi/RoiLabel.scala) classes + boxes for one image.
+    `classes`: [N] or [2, N] (labels + difficult flags); `bboxes`: [N, 4]."""
+
+    def __init__(self, classes: np.ndarray, bboxes: np.ndarray):
+        self.classes = np.asarray(classes, np.float32)
+        self.bboxes = np.asarray(bboxes, np.float32).reshape(-1, 4)
+
+    def size(self) -> int:
+        return self.bboxes.shape[0]
+
+
+class RoiNormalize(FeatureTransformer):
+    """(label/roi/RoiTransformer.scala RoiNormalize) divide box coords by
+    image size."""
+
+    def transform_mat(self, f: ImageFeature):
+        label: Optional[RoiLabel] = f.get(ImageFeature.LABEL)
+        if isinstance(label, RoiLabel):
+            h, w = f.height(), f.width()
+            label.bboxes[:, 0::2] /= w
+            label.bboxes[:, 1::2] /= h
+
+
+class RoiHFlip(FeatureTransformer):
+    """(RoiHFlip) mirror boxes to match a horizontally flipped image."""
+
+    def __init__(self, normalized: bool = True, seed=None):
+        super().__init__(seed)
+        self.normalized = normalized
+
+    def transform_mat(self, f: ImageFeature):
+        label: Optional[RoiLabel] = f.get(ImageFeature.LABEL)
+        if isinstance(label, RoiLabel):
+            w = 1.0 if self.normalized else float(f.width())
+            x1 = label.bboxes[:, 0].copy()
+            label.bboxes[:, 0] = w - label.bboxes[:, 2]
+            label.bboxes[:, 2] = w - x1
+
+
+class RoiResize(FeatureTransformer):
+    """(RoiResize) scale absolute boxes when the image was resized."""
+
+    def __init__(self, scale_x: float, scale_y: float, seed=None):
+        super().__init__(seed)
+        self.sx, self.sy = scale_x, scale_y
+
+    def transform_mat(self, f: ImageFeature):
+        label: Optional[RoiLabel] = f.get(ImageFeature.LABEL)
+        if isinstance(label, RoiLabel):
+            label.bboxes[:, 0::2] *= self.sx
+            label.bboxes[:, 1::2] *= self.sy
+
+
+class BatchSampler:
+    """(label/roi/BatchSampler.scala) sample a crop box satisfying IoU
+    constraints against ground-truth boxes (SSD patch sampling)."""
+
+    def __init__(self, max_trials: int = 50, min_scale: float = 0.3,
+                 max_scale: float = 1.0, min_aspect: float = 0.5,
+                 max_aspect: float = 2.0,
+                 min_overlap: Optional[float] = None,
+                 max_overlap: Optional[float] = None,
+                 seed: Optional[int] = None):
+        self.max_trials = max_trials
+        self.min_scale, self.max_scale = min_scale, max_scale
+        self.min_aspect, self.max_aspect = min_aspect, max_aspect
+        self.min_overlap, self.max_overlap = min_overlap, max_overlap
+        self.rng = np.random.RandomState(seed)
+
+    def _satisfies(self, box: BoundingBox, gts: List[BoundingBox]) -> bool:
+        if self.min_overlap is None and self.max_overlap is None:
+            return True
+        for gt in gts:
+            j = box.jaccard(gt)
+            if ((self.min_overlap is None or j >= self.min_overlap) and
+                    (self.max_overlap is None or j <= self.max_overlap)):
+                return True
+        return False
+
+    def sample(self, gts: List[BoundingBox]) -> Optional[BoundingBox]:
+        for _ in range(self.max_trials):
+            scale = self.rng.uniform(self.min_scale, self.max_scale)
+            aspect = self.rng.uniform(
+                max(self.min_aspect, scale ** 2),
+                min(self.max_aspect, 1.0 / scale ** 2))
+            w = scale * np.sqrt(aspect)
+            h = scale / np.sqrt(aspect)
+            x1 = self.rng.uniform(0.0, 1.0 - w)
+            y1 = self.rng.uniform(0.0, 1.0 - h)
+            box = BoundingBox(x1, y1, x1 + w, y1 + h)
+            if self._satisfies(box, gts):
+                return box
+        return None

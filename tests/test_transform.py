@@ -1,0 +1,249 @@
+"""Vision transform + text pipeline tests (reference
+TEST/transform/vision/* and TEST/dataset/* spec patterns)."""
+
+import numpy as np
+import pytest
+
+import bigdl_tpu.transform.vision as V
+from bigdl_tpu.dataset import image as DI
+from bigdl_tpu.dataset import text as DT
+from bigdl_tpu.dataset.transformer import chain
+from bigdl_tpu.dataset.sample import Sample
+
+
+def _img(h=8, w=10, c=3, seed=0):
+    rs = np.random.RandomState(seed)
+    return V.ImageFeature(rs.rand(h, w, c).astype(np.float32) * 255.0,
+                          label=1.0)
+
+
+class TestImageFeatureFrame:
+    def test_feature_slots(self):
+        f = _img()
+        assert f.height() == 8 and f.width() == 10
+        assert f.label == 1.0
+        assert f[V.ImageFeature.ORIGINAL_SIZE] == (8, 10, 3)
+
+    def test_frame_transform_chain(self):
+        frame = V.LocalImageFrame([_img(seed=i) for i in range(4)])
+        t = V.Resize(4, 4) >> V.ChannelNormalize(1.0, 2.0, 3.0)
+        out = frame.transform(t)
+        assert len(out) == 4
+        assert all(f.image.shape == (4, 4, 3) for f in out)
+
+    def test_read_roundtrip(self, tmp_path):
+        from PIL import Image
+        p = tmp_path / "x.png"
+        arr = (np.arange(48).reshape(4, 4, 3) * 5).astype(np.uint8)
+        Image.fromarray(arr).save(p)
+        f = V.ImageFeature.read(str(p))
+        # BGR order: channel 0 is the original R reversed
+        np.testing.assert_allclose(f.image[..., ::-1], arr.astype(np.float32))
+
+
+class TestAugmentation:
+    def test_resize(self):
+        f = V.Resize(16, 12).transform(_img())
+        assert f.image.shape == (16, 12, 3)
+
+    def test_aspect_scale_keeps_ratio(self):
+        f = V.AspectScale(16).transform(_img(8, 10))
+        assert min(f.image.shape[:2]) == 16
+        assert abs(f.image.shape[1] / f.image.shape[0] - 10 / 8) < 0.1
+
+    def test_brightness_contrast_deterministic_with_seed(self):
+        a = V.Brightness(10, 10, seed=1).transform(_img()).image
+        b = _img().image + 10.0
+        np.testing.assert_allclose(a, b, rtol=1e-5)
+        c = V.Contrast(2.0, 2.0).transform(_img()).image
+        np.testing.assert_allclose(c, _img().image * 2.0, rtol=1e-5)
+
+    def test_hue_saturation_bounded(self):
+        f = V.Hue(seed=0).transform(_img())
+        assert f.image.shape == (8, 10, 3)
+        g = V.Saturation(seed=0).transform(_img())
+        assert np.isfinite(g.image).all()
+
+    def test_hsv_roundtrip(self):
+        from bigdl_tpu.transform.vision.augmentation import (_bgr_to_hsv,
+                                                             _hsv_to_bgr)
+        img = _img().image
+        back = _hsv_to_bgr(_bgr_to_hsv(img))
+        np.testing.assert_allclose(back, img, atol=0.5)
+
+    def test_channel_normalize(self):
+        f = V.ChannelNormalize(10.0, 20.0, 30.0, 2.0, 2.0, 2.0).transform(_img())
+        raw = _img().image
+        np.testing.assert_allclose(
+            f.image, (raw - [10, 20, 30]) / 2.0, rtol=1e-5)
+
+    def test_crops(self):
+        assert V.CenterCrop(4, 4).transform(_img()).image.shape == (4, 4, 3)
+        assert V.RandomCrop(4, 4, seed=0).transform(_img()).image.shape == (4, 4, 3)
+        f = V.FixedCrop(0.0, 0.0, 0.5, 0.5).transform(_img())
+        assert f.image.shape == (4, 5, 3)
+
+    def test_expand_places_image(self):
+        f = _img()
+        orig = f.image.copy()
+        V.Expand(max_expand_ratio=2.0, seed=3).transform(f)
+        x0, y0, ratio = f["expand_offset"]
+        assert f.image.shape[0] >= 8 and f.image.shape[1] >= 10
+        np.testing.assert_allclose(f.image[y0:y0 + 8, x0:x0 + 10], orig)
+
+    def test_hflip_mirrors(self):
+        f = _img()
+        orig = f.image.copy()
+        V.HFlip().transform(f)
+        np.testing.assert_allclose(f.image, orig[:, ::-1])
+
+    def test_random_alter_aspect_fixed_output(self):
+        f = V.RandomAlterAspect(target_size=6, seed=0).transform(_img(32, 32))
+        assert f.image.shape == (6, 6, 3)
+
+    def test_random_transformer_prob(self):
+        inner = V.Brightness(100, 100)
+        never = V.RandomTransformer(inner, 0.0, seed=0)
+        orig = _img().image
+        np.testing.assert_allclose(never.transform(_img()).image, orig)
+
+    def test_color_jitter_and_lighting_run(self):
+        f = V.ColorJitter(seed=0).transform(_img())
+        assert np.isfinite(f.image).all()
+        g = V.Lighting(seed=0).transform(_img())
+        assert np.isfinite(g.image).all()
+
+    def test_filler(self):
+        f = V.Filler(0.0, 0.0, 0.5, 0.5, value=7.0).transform(_img())
+        assert (f.image[:4, :5] == 7.0).all()
+
+
+class TestRoiLabel:
+    def test_normalize_and_flip(self):
+        label = V.RoiLabel([1.0], [[2.0, 2.0, 8.0, 6.0]])
+        f = _img()
+        f[V.ImageFeature.LABEL] = label
+        V.RoiNormalize().transform(f)
+        np.testing.assert_allclose(label.bboxes[0], [0.2, 0.25, 0.8, 0.75])
+        V.RoiHFlip().transform(f)
+        np.testing.assert_allclose(label.bboxes[0], [0.2, 0.25, 0.8, 0.75],
+                                   atol=1e-6)  # symmetric box is unchanged
+
+    def test_bounding_box_jaccard(self):
+        a = V.BoundingBox(0, 0, 1, 1)
+        b = V.BoundingBox(0.5, 0, 1.5, 1)
+        assert abs(a.jaccard(b) - 1 / 3) < 1e-6
+
+    def test_batch_sampler_satisfies(self):
+        gts = [V.BoundingBox(0.4, 0.4, 0.6, 0.6)]
+        s = V.BatchSampler(min_overlap=0.1, seed=0)
+        box = s.sample(gts)
+        assert box is not None and box.jaccard(gts[0]) >= 0.1
+
+
+class TestConvertors:
+    def test_mat_to_tensor_chw(self):
+        f = _img()
+        V.MatToTensor(to_chw=True).transform(f)
+        assert f["tensor"].shape == (3, 8, 10)
+
+    def test_image_frame_to_sample(self):
+        frame = V.LocalImageFrame([_img(seed=i) for i in range(3)])
+        samples = V.ImageFrameToSample(frame)
+        assert len(samples) == 3
+        assert samples[0].feature.shape == (8, 10, 3)
+        assert float(samples[0].label) == 1.0
+
+    def test_mt_batcher_shapes_and_threads(self):
+        feats = [_img(16, 16, seed=i) for i in range(10)]
+        batcher = V.MTImageFeatureToBatch(8, 8, batch_size=4,
+                                          transformer=V.Resize(8, 8),
+                                          num_threads=3)
+        batches = list(batcher(feats))
+        assert [b.size() for b in batches] == [4, 4, 2]
+        assert batches[0].get_input().shape == (4, 8, 8, 3)
+        assert batches[0].get_target().shape == (4,)
+
+
+class TestGreyBGRPipelines:
+    def test_mnist_style_pipeline(self):
+        rs = np.random.RandomState(0)
+        raw = [(rs.randint(0, 255, 32 * 32, dtype=np.uint8).tobytes(), i % 10 + 1)
+               for i in range(6)]
+        pipe = chain(DI.BytesToGreyImg(32, 32),
+                     DI.GreyImgNormalizer(0.5, 0.25),
+                     DI.GreyImgCropper(28, 28, seed=0),
+                     DI.GreyImgToBatch(3))
+        batches = list(pipe(raw))
+        assert len(batches) == 2
+        assert batches[0].get_input().shape == (3, 28, 28)
+        assert batches[0].get_target().tolist() == [1.0, 2.0, 3.0]
+
+    def test_bgr_pipeline(self):
+        rs = np.random.RandomState(0)
+        raw = [(rs.randint(0, 255, (8, 8, 3), dtype=np.uint8), 1.0)
+               for _ in range(4)]
+        pipe = chain(DI.BytesToBGRImg(),
+                     DI.BGRImgNormalizer((0.5, 0.5, 0.5), (0.25, 0.25, 0.25)),
+                     DI.BGRImgCropper(6, 6, "center"),
+                     DI.BGRImgToBatch(2))
+        batches = list(pipe(raw))
+        assert batches[0].get_input().shape == (2, 6, 6, 3)
+
+    def test_normalizer_stats_from_dataset(self):
+        imgs = [DI.LabeledGreyImage(np.full((2, 2), v), 1.0)
+                for v in (0.0, 1.0)]
+        norm = DI.GreyImgNormalizer(imgs)
+        assert abs(norm.mean - 0.5) < 1e-6 and abs(norm.std - 0.5) < 1e-6
+
+    def test_local_image_files(self, tmp_path):
+        from PIL import Image
+        for cls in ("cat", "dog"):
+            d = tmp_path / cls
+            d.mkdir()
+            Image.fromarray(np.zeros((2, 2, 3), np.uint8)).save(d / "a.png")
+        files = DI.local_image_files(str(tmp_path))
+        assert [l for _, l in files] == [1.0, 2.0]
+
+
+class TestTextPipeline:
+    CORPUS = ["The cat sat. The dog ran!", "A cat ran."]
+
+    def test_split_tokenize_pad(self):
+        pipe = chain(DT.SentenceSplitter(), DT.SentenceTokenizer(),
+                     DT.SentenceBiPadding())
+        out = list(pipe(self.CORPUS))
+        assert len(out) == 3
+        assert out[0][0] == DT.SENTENCE_START and out[0][-1] == DT.SENTENCE_END
+        assert "cat" in out[0]
+
+    def test_dictionary(self):
+        toks = list(chain(DT.SentenceSplitter(), DT.SentenceTokenizer())(self.CORPUS))
+        d = DT.Dictionary(toks, vocab_size=5)
+        assert d.vocab_size() == 5
+        i = d.get_index("cat")
+        assert d.get_word(i) == "cat"
+        assert d.get_index("zebra") == 5  # unknown -> vocab_size
+
+    def test_dictionary_save_load(self, tmp_path):
+        d = DT.Dictionary([["a", "b", "a"]])
+        p = tmp_path / "dict.json"
+        d.save(str(p))
+        d2 = DT.Dictionary.load(str(p))
+        assert d2.get_index("a") == d.get_index("a")
+
+    def test_lm_pipeline_to_samples(self):
+        toks = list(chain(DT.SentenceSplitter(), DT.SentenceTokenizer(),
+                          DT.SentenceBiPadding())(self.CORPUS))
+        d = DT.Dictionary(toks)
+        pipe = chain(DT.TextToLabeledSentence(d),
+                     DT.LabeledSentenceToSample(
+                         one_hot_vocab_size=d.vocab_size() + 1,
+                         fixed_length=6))
+        samples = list(pipe(iter(toks)))
+        assert len(samples) == 3
+        s = samples[0]
+        assert s.feature.shape == (6, d.vocab_size() + 1)
+        # labels are shifted-by-one inputs, 1-based
+        assert s.label.shape == (6,)
+        assert (s.label >= 1).all()
