@@ -154,8 +154,13 @@ class LabeledSentenceToSample(Transformer):
                 data = np.pad(data[:n], (0, max(0, n - len(data))))
                 labels = np.pad(labels[:n], (0, max(0, n - len(labels))))
             if self.vocab:
+                # Unknown words carry index == dictionary vocab_size(); use
+                # width vocab_size()+1 to give them their own column. Clip
+                # so a width of exactly vocab_size() folds unknowns into the
+                # last column instead of crashing.
                 feat = np.zeros((len(data), self.vocab), np.float32)
-                feat[np.arange(len(data)), data.astype(int)] = 1.0
+                idx = np.minimum(data.astype(int), self.vocab - 1)
+                feat[np.arange(len(data)), idx] = 1.0
             else:
                 feat = data
             yield Sample(feat, labels + 1.0)

@@ -438,7 +438,12 @@ class Dilation2D(Operation):
             # would NaN inside the conv-based patch extraction (0 * -inf)
             ekh = (kh - 1) * self.rates[0] + 1
             ekw = (kw - 1) * self.rates[1] + 1
-            ph, pw = ekh - 1, ekw - 1
+            # TF SAME: pad_total depends on input size and stride
+            ih, iw = x.shape[1], x.shape[2]
+            oh = -(-ih // self.strides[0])
+            ow = -(-iw // self.strides[1])
+            ph = max((oh - 1) * self.strides[0] + ekh - ih, 0)
+            pw = max((ow - 1) * self.strides[1] + ekw - iw, 0)
             x = jnp.pad(x, ((0, 0), (ph // 2, ph - ph // 2),
                             (pw // 2, pw - pw // 2), (0, 0)),
                         constant_values=float(jnp.finfo(x.dtype).min) / 4)

@@ -1,0 +1,21 @@
+"""Torch-semantics tensor library over JAX arrays.
+
+Parity surface for the reference's L1 tensor layer (SURVEY.md C1-C4):
+`DL/tensor/Tensor.scala:37` (strided dense tensor, 1-based indexing,
+narrow/select/view share storage), `DL/tensor/SparseTensor.scala` (COO),
+`DL/tensor/QuantizedTensor.scala` (int8). The functional model core uses raw
+jax arrays; this facade exists for API parity — user-facing code that
+manipulates tensors Torch-style (init methods, data prep, interop loaders)
+— and it *stages pure XLA ops* underneath: a `Storage` holds one flat
+device array, views record (offset, size, stride), and every in-place op
+rewrites the viewed region with `array.at[...].set`, so all aliases observe
+the mutation exactly like Torch storage sharing.
+"""
+
+from bigdl_tpu.tensor.numeric import TensorNumeric
+from bigdl_tpu.tensor.tensor import Storage, Tensor
+from bigdl_tpu.tensor.sparse import SparseTensor
+from bigdl_tpu.tensor.quantized import QuantizedTensor
+
+__all__ = ["Tensor", "Storage", "SparseTensor", "QuantizedTensor",
+           "TensorNumeric"]

@@ -1,0 +1,55 @@
+"""Numeric typeclass registry.
+
+Parity: `TensorNumeric[T]` (DL/tensor/TensorNumeric.scala) provides the
+per-dtype arithmetic the Scala generics need. Python/JAX dispatches on the
+array dtype natively, so this reduces to a dtype registry + conversion
+helpers; kept as an explicit object so user code and the serializer can name
+dtypes the way the reference does ("float", "double", ...).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+
+class TensorNumeric:
+    """Named dtype registry (reference TensorNumeric.scala:22 object table)."""
+
+    _BY_NAME = {
+        "float": jnp.float32,
+        "double": jnp.float64,
+        "half": jnp.float16,
+        "bfloat16": jnp.bfloat16,
+        "int": jnp.int32,
+        "long": jnp.int64,
+        "short": jnp.int16,
+        "char": jnp.int8,
+        "boolean": jnp.bool_,
+        "string": np.dtype("O"),  # TF string ops run host-side
+    }
+
+    @classmethod
+    def dtype(cls, name):
+        """Resolve a reference-style dtype name or pass a dtype through."""
+        if isinstance(name, str):
+            key = name.lower()
+            if key not in cls._BY_NAME:
+                raise ValueError(f"unknown numeric type: {name}")
+            return cls._BY_NAME[key]
+        return name
+
+    @classmethod
+    def name_of(cls, dtype) -> str:
+        dt = np.dtype(dtype) if not isinstance(dtype, np.dtype) else dtype
+        for name, d in cls._BY_NAME.items():
+            try:
+                if np.dtype(d) == dt:
+                    return name
+            except TypeError:
+                continue
+        return str(dt)
+
+    @classmethod
+    def is_floating(cls, dtype) -> bool:
+        return jnp.issubdtype(jnp.dtype(dtype), jnp.floating)

@@ -1,0 +1,89 @@
+"""Int8 quantized tensor.
+
+Parity: `QuantizedTensor` (DL/tensor/QuantizedTensor.scala:305) + the
+BigQuant scheme (whitepaper docs/docs/whitepaper.md:192): post-training int8
+quantization with *local* per-window/per-channel max-abs scales rather than
+one global scale, which is what keeps the <0.1% accuracy drop.
+
+TPU-first: the quantized payload is an int8 jax array + a float32 scale
+vector. Matmuls run as int8 x int8 -> int32 via
+`lax.dot_general(..., preferred_element_type=int32)`, which XLA lowers onto
+the MXU's native int8 path (2-4x the bf16 throughput on modern TPU gens),
+then rescale to float once per output tile — the same structure as
+BigQuant's MixPrecisionGEMM (DL/nn/quantized/Linear.scala:89) without the
+hand-written C++ kernel.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class QuantizedTensor:
+    """Symmetric int8 tensor: value ~= int8 * scale (per-channel scales)."""
+
+    def __init__(self, data: jnp.ndarray, scale: jnp.ndarray,
+                 channel_axis: Optional[int] = None):
+        self.data = jnp.asarray(data, jnp.int8)
+        self.scale = jnp.asarray(scale, jnp.float32)
+        self.channel_axis = channel_axis  # None = per-tensor scale
+        self.shape = tuple(self.data.shape)
+
+    @classmethod
+    def from_float(cls, arr, channel_axis: Optional[int] = 0
+                   ) -> "QuantizedTensor":
+        """Symmetric max-abs quantization; `channel_axis` selects the
+        per-channel (local min/max) scheme of BigQuant's Desc
+        (DL/nn/quantized/Desc.scala:125-170); None = per-tensor."""
+        x = jnp.asarray(arr, jnp.float32)
+        if channel_axis is None:
+            amax = jnp.max(jnp.abs(x))
+            scale = jnp.maximum(amax, 1e-8) / 127.0
+            q = jnp.clip(jnp.round(x / scale), -127, 127).astype(jnp.int8)
+            return cls(q, scale, None)
+        axes = tuple(d for d in range(x.ndim) if d != channel_axis)
+        amax = jnp.max(jnp.abs(x), axis=axes, keepdims=True)
+        scale = jnp.maximum(amax, 1e-8) / 127.0
+        q = jnp.clip(jnp.round(x / scale), -127, 127).astype(jnp.int8)
+        return cls(q, scale, channel_axis)
+
+    def dequantize(self) -> jnp.ndarray:
+        return self.data.astype(jnp.float32) * self.scale
+
+    def nElement(self) -> int:
+        n = 1
+        for s in self.shape:
+            n *= s
+        return n
+
+    def matmul_t(self, x: jnp.ndarray) -> jnp.ndarray:
+        """x [B, K] @ self[N, K].T -> [B, N] with on-the-fly int8 activation
+        quantization (per-row) — the MixPrecisionGEMM contract
+        (DL/nn/quantized/Linear.scala:79-92)."""
+        if self.data.ndim != 2:
+            raise ValueError("matmul_t expects a 2-D quantized weight")
+        if self.channel_axis not in (None, 0):
+            # per-K scales cannot be applied after the K-contraction
+            raise ValueError(
+                "matmul_t needs per-tensor or output-channel (axis 0) scales;"
+                f" got channel_axis={self.channel_axis}")
+        x = jnp.asarray(x, jnp.float32)
+        x_amax = jnp.max(jnp.abs(x), axis=-1, keepdims=True)
+        x_scale = jnp.maximum(x_amax, 1e-8) / 127.0
+        xq = jnp.clip(jnp.round(x / x_scale), -127, 127).astype(jnp.int8)
+        acc = jax.lax.dot_general(
+            xq, self.data,
+            (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.int32)  # [B, N] int32 on the MXU
+        w_scale = self.scale.reshape(1, -1) if self.channel_axis is not None \
+            else self.scale
+        return acc.astype(jnp.float32) * x_scale * w_scale
+
+    def __repr__(self):
+        kind = ("per-tensor" if self.channel_axis is None
+                else f"per-channel(axis={self.channel_axis})")
+        return f"QuantizedTensor(shape={list(self.shape)}, {kind})"

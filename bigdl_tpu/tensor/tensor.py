@@ -1,0 +1,727 @@
+"""Strided Torch-semantics Tensor facade staging pure JAX ops.
+
+Parity: `Tensor[T]` trait (DL/tensor/Tensor.scala:37) + `TensorMath`
+(DL/tensor/TensorMath.scala), implemented by `DenseTensor`
+(DL/tensor/DenseTensor.scala). Torch contract preserved here:
+
+- **1-based indexing** for `select/narrow/apply/setValue` (Lua-Torch
+  heritage, reference Tensor.scala:37 scaladoc).
+- **Views share storage**: `narrow/select/view/t/transpose/set` return
+  tensors aliasing the same `Storage`; in-place ops through any alias are
+  visible through all others (DenseTensor.scala narrow/select/set).
+- **In-place math**: `add/sub/cmul/cdiv/fill/zero/copy/...` mutate the
+  receiver and return it; operators `+ - * /` allocate.
+
+TPU-first twist: storage is ONE flat `jax.numpy` array. A view is
+(offset, size, stride) metadata; reads gather through the strides, writes
+are `flat.at[idx].set(...)` — every mutation is a staged pure XLA op, so
+this facade interoperates with jit'd code while presenting the mutable
+Torch API the reference's users expect. The hot training path does NOT go
+through this class (models are functional, SURVEY.md §7(4)); this is the
+API-parity and interop surface.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Iterable, Optional, Sequence, Tuple, Union
+
+import jax.numpy as jnp
+import numpy as np
+
+from bigdl_tpu.tensor.numeric import TensorNumeric
+
+
+def _contiguous_strides(size: Tuple[int, ...]) -> Tuple[int, ...]:
+    stride = [1] * len(size)
+    for d in range(len(size) - 2, -1, -1):
+        stride[d] = stride[d + 1] * size[d + 1]
+    return tuple(stride)
+
+
+class Storage:
+    """Flat element buffer shared by views (reference DL/tensor/Storage.scala).
+
+    Holds a single 1-D jax array plus a version counter so views can cache
+    their materialization. All mutation funnels through `write_flat`.
+    """
+
+    def __init__(self, data):
+        arr = jnp.asarray(data)
+        if arr.ndim != 1:
+            arr = arr.reshape(-1)
+        self.array = arr
+        self.version = 0
+
+    def __len__(self):
+        return int(self.array.shape[0])
+
+    def write_flat(self, flat_indices, values):
+        self.array = self.array.at[flat_indices].set(
+            jnp.asarray(values, self.array.dtype).reshape(-1))
+        self.version += 1
+
+    def write_all(self, values):
+        self.array = jnp.asarray(values, self.array.dtype).reshape(-1)
+        self.version += 1
+
+    def to_numpy(self):
+        return np.asarray(self.array)
+
+
+class Tensor:
+    """Strided dense tensor with Torch view/in-place semantics.
+
+    Constructors::
+
+        Tensor(3, 4)            # zeros of shape (3, 4)
+        Tensor(ndarray)         # copy data (host or jax array, nested list)
+        Tensor()                # empty 0-element tensor
+    """
+
+    def __init__(self, *args, dtype=None):
+        dtype = TensorNumeric.dtype(dtype) if dtype is not None else None
+        if len(args) == 0:
+            arr = jnp.zeros((0,), dtype or jnp.float32)
+            self._init_from_array(arr, (0,))
+        elif all(isinstance(a, (int, np.integer)) for a in args) and args:
+            size = tuple(int(a) for a in args)
+            arr = jnp.zeros(size, dtype or jnp.float32)
+            self._init_from_array(arr, size)
+        elif len(args) == 1:
+            arr = jnp.asarray(args[0])
+            if dtype is not None:
+                arr = arr.astype(dtype)
+            elif arr.dtype == jnp.float64:
+                arr = arr.astype(jnp.float32)
+            self._init_from_array(arr, tuple(arr.shape))
+        else:
+            raise ValueError(f"bad Tensor(...) arguments: {args}")
+
+    def _init_from_array(self, arr, size):
+        self._storage = Storage(arr.reshape(-1))
+        self._offset = 0  # 0-based into storage; public storageOffset() is 1-based
+        self._size = tuple(size)
+        self._stride = _contiguous_strides(self._size)
+        self._cache = None  # (version, materialized ndarray-shaped jax array)
+
+    @classmethod
+    def _from_view(cls, storage, offset, size, stride):
+        t = cls.__new__(cls)
+        t._storage = storage
+        t._offset = offset
+        t._size = tuple(size)
+        t._stride = tuple(stride)
+        t._cache = None
+        return t
+
+    # ------------------------------------------------------------- metadata
+    def dim(self) -> int:
+        return len(self._size)
+
+    nDimension = dim
+
+    def size(self, d: Optional[int] = None):
+        if d is None:
+            return self._size
+        return self._size[d - 1]  # 1-based (Tensor.scala size(dim))
+
+    def stride(self, d: Optional[int] = None):
+        if d is None:
+            return self._stride
+        return self._stride[d - 1]
+
+    def nElement(self) -> int:
+        n = 1
+        for s in self._size:
+            n *= s
+        return n
+
+    def storage(self) -> Storage:
+        return self._storage
+
+    def storageOffset(self) -> int:
+        return self._offset + 1  # 1-based like Torch
+
+    @property
+    def dtype(self):
+        return self._storage.array.dtype
+
+    def isContiguous(self) -> bool:
+        return self._stride == _contiguous_strides(self._size)
+
+    def isSameSizeAs(self, other: "Tensor") -> bool:
+        return self._size == other._size
+
+    # --------------------------------------------------------- materialize
+    def _flat_indices(self):
+        """Flat storage indices of every element of this view, view-shaped."""
+        idx = jnp.full(self._size or (1,), self._offset, jnp.int32)
+        if not self._size:
+            return idx.reshape(())
+        for d, (n, st) in enumerate(zip(self._size, self._stride)):
+            shape = [1] * len(self._size)
+            shape[d] = n
+            idx = idx + (jnp.arange(n, dtype=jnp.int32) * st).reshape(shape)
+        return idx
+
+    def to_jax(self):
+        """Materialize the view as a jax array of shape `size()`."""
+        if self._cache is not None and self._cache[0] == self._storage.version:
+            return self._cache[1]
+        flat = self._storage.array
+        if (self._offset == 0 and self.isContiguous()
+                and self.nElement() == len(self._storage)):
+            out = flat.reshape(self._size)
+        else:
+            out = flat[self._flat_indices().reshape(-1)].reshape(self._size)
+        self._cache = (self._storage.version, out)
+        return out
+
+    def to_numpy(self):
+        return np.asarray(self.to_jax())
+
+    def _write(self, values):
+        """Overwrite this view's elements (staged pure update)."""
+        if any(st == 0 and n > 1 for n, st in zip(self._size, self._stride)):
+            raise RuntimeError("cannot write through an expanded (stride-0) view")
+        vals = jnp.asarray(values, self.dtype)
+        vals = jnp.broadcast_to(vals, self._size)
+        if (self._offset == 0 and self.isContiguous()
+                and self.nElement() == len(self._storage)):
+            self._storage.write_all(vals)
+        else:
+            self._storage.write_flat(self._flat_indices().reshape(-1), vals)
+        return self
+
+    # ------------------------------------------------------------ elements
+    def valueAt(self, *indices) -> float:
+        """1-based scalar read (reference Tensor.valueAt)."""
+        flat = self._offset + sum(
+            (i - 1) * st for i, st in zip(indices, self._stride))
+        return self._storage.array[flat].item()
+
+    def setValue(self, *args):
+        """setValue(i, j, ..., value) — 1-based scalar write."""
+        *indices, value = args
+        flat = self._offset + sum(
+            (i - 1) * st for i, st in zip(indices, self._stride))
+        self._storage.write_flat(jnp.array([flat]), jnp.array([value]))
+        return self
+
+    def __getitem__(self, i):
+        """1-based: `t[i]` = `select(1, i)` for dim>1, scalar for 1-D."""
+        if isinstance(i, Tensor):  # boolean-mask read (maskedSelect sugar)
+            return self.maskedSelect(i)
+        if self.dim() == 1:
+            return self.valueAt(i)
+        return self.select(1, i)
+
+    def __setitem__(self, i, value):
+        if self.dim() == 1:
+            self.setValue(i, value)
+        else:
+            self.select(1, i).copy(value)
+
+    # --------------------------------------------------------------- views
+    def narrow(self, dim: int, index: int, size: int) -> "Tensor":
+        """1-based narrow sharing storage (DenseTensor.scala narrow)."""
+        d = dim - 1
+        if not (1 <= index and index - 1 + size <= self._size[d]):
+            raise IndexError(
+                f"narrow({dim},{index},{size}) out of range for {self._size}")
+        new_size = list(self._size)
+        new_size[d] = size
+        return Tensor._from_view(
+            self._storage, self._offset + (index - 1) * self._stride[d],
+            new_size, self._stride)
+
+    def select(self, dim: int, index: int) -> "Tensor":
+        """1-based select: drops `dim` (DenseTensor.scala select)."""
+        d = dim - 1
+        if not 1 <= index <= self._size[d]:
+            raise IndexError(f"select({dim},{index}) out of range {self._size}")
+        new_size = self._size[:d] + self._size[d + 1:]
+        new_stride = self._stride[:d] + self._stride[d + 1:]
+        return Tensor._from_view(
+            self._storage, self._offset + (index - 1) * self._stride[d],
+            new_size, new_stride)
+
+    def view(self, *sizes) -> "Tensor":
+        if len(sizes) == 1 and isinstance(sizes[0], (tuple, list)):
+            sizes = tuple(sizes[0])
+        sizes = list(int(s) for s in sizes)
+        if -1 in sizes:
+            known = 1
+            for s in sizes:
+                if s != -1:
+                    known *= s
+            sizes[sizes.index(-1)] = self.nElement() // known
+        if not self.isContiguous():
+            raise RuntimeError("view requires a contiguous tensor")
+        n = 1
+        for s in sizes:
+            n *= s
+        if n != self.nElement():
+            raise ValueError(f"view {sizes} incompatible with {self._size}")
+        return Tensor._from_view(self._storage, self._offset, sizes,
+                                 _contiguous_strides(tuple(sizes)))
+
+    def reshape(self, *sizes) -> "Tensor":
+        if len(sizes) == 1 and isinstance(sizes[0], (tuple, list)):
+            sizes = tuple(sizes[0])
+        if self.isContiguous():
+            return self.view(*sizes)
+        return Tensor(self.to_jax().reshape(sizes))
+
+    def transpose(self, dim1: int, dim2: int) -> "Tensor":
+        """1-based transpose sharing storage."""
+        d1, d2 = dim1 - 1, dim2 - 1
+        size, stride = list(self._size), list(self._stride)
+        size[d1], size[d2] = size[d2], size[d1]
+        stride[d1], stride[d2] = stride[d2], stride[d1]
+        return Tensor._from_view(self._storage, self._offset, size, stride)
+
+    def t(self) -> "Tensor":
+        if self.dim() != 2:
+            raise RuntimeError("t() expects a 2-D tensor")
+        return self.transpose(1, 2)
+
+    def squeeze(self, dim: Optional[int] = None) -> "Tensor":
+        if dim is None:
+            keep = [(n, st) for n, st in zip(self._size, self._stride) if n != 1]
+            if not keep:
+                keep = [(1, 1)]
+            size, stride = zip(*keep)
+        else:
+            d = dim - 1
+            if self._size[d] != 1:
+                return self
+            size = self._size[:d] + self._size[d + 1:]
+            stride = self._stride[:d] + self._stride[d + 1:]
+        return Tensor._from_view(self._storage, self._offset, size, stride)
+
+    def addSingletonDimension(self, dim: int = 1) -> "Tensor":
+        """Insert a size-1 dim at 1-based position (Tensor.scala)."""
+        d = dim - 1
+        size = self._size[:d] + (1,) + self._size[d:]
+        inner = self._stride[d] * self._size[d] if d < len(self._size) else 1
+        stride = self._stride[:d] + (inner,) + self._stride[d:]
+        return Tensor._from_view(self._storage, self._offset, size, stride)
+
+    unsqueeze = addSingletonDimension
+
+    def expand(self, *sizes) -> "Tensor":
+        if len(sizes) == 1 and isinstance(sizes[0], (tuple, list)):
+            sizes = tuple(sizes[0])
+        stride = list(self._stride)
+        for d, (have, want) in enumerate(zip(self._size, sizes)):
+            if have != want:
+                if have != 1:
+                    raise ValueError(f"expand {self._size} -> {sizes}")
+                stride[d] = 0
+        return Tensor._from_view(self._storage, self._offset, sizes, stride)
+
+    def set_(self, other: Optional["Tensor"] = None) -> "Tensor":
+        """Alias `other`'s storage/offset/size/stride (Tensor.set)."""
+        if other is None:
+            self._init_from_array(jnp.zeros((0,), self.dtype), (0,))
+            return self
+        self._storage = other._storage
+        self._offset = other._offset
+        self._size = other._size
+        self._stride = other._stride
+        self._cache = None
+        return self
+
+    def contiguous(self) -> "Tensor":
+        if self.isContiguous():
+            return self
+        return Tensor(self.to_jax())
+
+    def clone(self) -> "Tensor":
+        return Tensor(self.to_jax())
+
+    def resize(self, *sizes) -> "Tensor":
+        """Resize in place; keeps the flat prefix that fits (Torch resize)."""
+        if len(sizes) == 1 and isinstance(sizes[0], (tuple, list)):
+            sizes = tuple(sizes[0])
+        sizes = tuple(int(s) for s in sizes)
+        n = 1
+        for s in sizes:
+            n *= s
+        if self.isContiguous() and self._offset + n <= len(self._storage):
+            # capacity suffices: metadata-only change keeps storage aliasing
+            # (Torch resize semantics; aliases via set_ keep observing writes)
+            self._size = sizes
+            self._stride = _contiguous_strides(sizes)
+            self._cache = None
+            return self
+        old_flat = self.to_jax().reshape(-1) if self.nElement() else \
+            jnp.zeros((0,), self.dtype)
+        if n <= old_flat.shape[0]:
+            flat = old_flat[:n]
+        else:
+            flat = jnp.concatenate(
+                [old_flat, jnp.zeros((n - old_flat.shape[0],), self.dtype)])
+        self._storage = Storage(flat)
+        self._offset = 0
+        self._size = sizes
+        self._stride = _contiguous_strides(sizes)
+        self._cache = None
+        return self
+
+    def resizeAs(self, other: "Tensor") -> "Tensor":
+        return self.resize(*other.size())
+
+    def repeatTensor(self, *reps) -> "Tensor":
+        if len(reps) == 1 and isinstance(reps[0], (tuple, list)):
+            reps = tuple(reps[0])
+        return Tensor(jnp.tile(self.to_jax(), reps))
+
+    # ------------------------------------------------------- in-place math
+    def fill(self, value) -> "Tensor":
+        return self._write(jnp.full(self._size, value, self.dtype))
+
+    def zero(self) -> "Tensor":
+        return self.fill(0)
+
+    def copy(self, other) -> "Tensor":
+        src = other.to_jax() if isinstance(other, Tensor) else jnp.asarray(other)
+        return self._write(src.reshape(self._size))
+
+    def _coerce(self, other):
+        return other.to_jax() if isinstance(other, Tensor) else other
+
+    def add(self, a, b=None) -> "Tensor":
+        """add(t) / add(scalar) / add(scalar, t): in-place accumulate."""
+        if b is None:
+            return self._write(self.to_jax() + self._coerce(a))
+        return self._write(self.to_jax() + a * self._coerce(b))
+
+    def sub(self, a, b=None) -> "Tensor":
+        if b is None:
+            return self._write(self.to_jax() - self._coerce(a))
+        return self._write(self.to_jax() - a * self._coerce(b))
+
+    def mul(self, s) -> "Tensor":
+        return self._write(self.to_jax() * self._coerce(s))
+
+    def div(self, s) -> "Tensor":
+        return self._write(self.to_jax() / self._coerce(s))
+
+    def cmul(self, t: "Tensor") -> "Tensor":
+        return self._write(self.to_jax() * t.to_jax())
+
+    def cdiv(self, t: "Tensor") -> "Tensor":
+        return self._write(self.to_jax() / t.to_jax())
+
+    def cadd(self, scale, t: "Tensor") -> "Tensor":
+        return self._write(self.to_jax() + scale * t.to_jax())
+
+    def cmax(self, t: "Tensor") -> "Tensor":
+        return self._write(jnp.maximum(self.to_jax(), t.to_jax()))
+
+    def cmin(self, t: "Tensor") -> "Tensor":
+        return self._write(jnp.minimum(self.to_jax(), t.to_jax()))
+
+    def pow_(self, p) -> "Tensor":
+        return self._write(self.to_jax() ** p)
+
+    def sqrt_(self) -> "Tensor":
+        return self._write(jnp.sqrt(self.to_jax()))
+
+    def clamp(self, lo, hi) -> "Tensor":
+        return self._write(jnp.clip(self.to_jax(), lo, hi))
+
+    def apply1(self, fn) -> "Tensor":
+        """Elementwise host function, like DenseTensorApply (host-side)."""
+        arr = self.to_numpy()
+        out = np.vectorize(fn)(arr) if arr.size else arr
+        return self._write(jnp.asarray(out, self.dtype))
+
+    def addmm(self, mat1: "Tensor", mat2: "Tensor", beta=1.0, alpha=1.0
+              ) -> "Tensor":
+        """self = beta*self + alpha * mat1 @ mat2 (TensorMath.addmm)."""
+        prod = jnp.matmul(mat1.to_jax(), mat2.to_jax())
+        return self._write(beta * self.to_jax() + alpha * prod)
+
+    def addmv(self, mat: "Tensor", vec: "Tensor", beta=1.0, alpha=1.0
+              ) -> "Tensor":
+        prod = jnp.matmul(mat.to_jax(), vec.to_jax())
+        return self._write(beta * self.to_jax() + alpha * prod)
+
+    def addr(self, vec1: "Tensor", vec2: "Tensor", alpha=1.0) -> "Tensor":
+        return self._write(
+            self.to_jax() + alpha * jnp.outer(vec1.to_jax(), vec2.to_jax()))
+
+    def baddbmm(self, batch1: "Tensor", batch2: "Tensor", beta=1.0, alpha=1.0
+                ) -> "Tensor":
+        prod = jnp.matmul(batch1.to_jax(), batch2.to_jax())
+        return self._write(beta * self.to_jax() + alpha * prod)
+
+    # ------------------------------------------------------ random fills
+    def randn(self, mean: float = 0.0, stdv: float = 1.0) -> "Tensor":
+        from bigdl_tpu.utils.random_generator import RNG
+        return self._write(
+            RNG.normal(mean, stdv, self._size).astype(np.float32))
+
+    def rand(self, lo: float = 0.0, hi: float = 1.0) -> "Tensor":
+        from bigdl_tpu.utils.random_generator import RNG
+        return self._write(RNG.uniform(lo, hi, self._size).astype(np.float32))
+
+    def bernoulli(self, p: float) -> "Tensor":
+        from bigdl_tpu.utils.random_generator import RNG
+        return self._write(
+            (RNG.uniform(0.0, 1.0, self._size) < p).astype(np.float32))
+
+    # ------------------------------------------------- allocating math ops
+    def __add__(self, other):
+        return Tensor(self.to_jax() + self._coerce(other))
+
+    def __radd__(self, other):
+        return Tensor(self._coerce(other) + self.to_jax())
+
+    def __sub__(self, other):
+        return Tensor(self.to_jax() - self._coerce(other))
+
+    def __rsub__(self, other):
+        return Tensor(self._coerce(other) - self.to_jax())
+
+    def __mul__(self, other):
+        return Tensor(self.to_jax() * self._coerce(other))
+
+    def __rmul__(self, other):
+        return Tensor(self._coerce(other) * self.to_jax())
+
+    def __truediv__(self, other):
+        return Tensor(self.to_jax() / self._coerce(other))
+
+    def __neg__(self):
+        return Tensor(-self.to_jax())
+
+    def abs(self):
+        return Tensor(jnp.abs(self.to_jax()))
+
+    def sqrt(self):
+        return Tensor(jnp.sqrt(self.to_jax()))
+
+    def exp(self):
+        return Tensor(jnp.exp(self.to_jax()))
+
+    def log(self):
+        return Tensor(jnp.log(self.to_jax()))
+
+    def log1p(self):
+        return Tensor(jnp.log1p(self.to_jax()))
+
+    def tanh(self):
+        return Tensor(jnp.tanh(self.to_jax()))
+
+    def sigmoid(self):
+        return Tensor(1.0 / (1.0 + jnp.exp(-self.to_jax())))
+
+    def floor(self):
+        return Tensor(jnp.floor(self.to_jax()))
+
+    def ceil(self):
+        return Tensor(jnp.ceil(self.to_jax()))
+
+    def pow(self, p):
+        return Tensor(self.to_jax() ** p)
+
+    def sign(self):
+        return Tensor(jnp.sign(self.to_jax()))
+
+    def negative(self):
+        return Tensor(-self.to_jax())
+
+    # ---------------------------------------------------------- reductions
+    def sum(self, dim: Optional[int] = None):
+        if dim is None:
+            return float(jnp.sum(self.to_jax()))
+        return Tensor(jnp.sum(self.to_jax(), axis=dim - 1, keepdims=True))
+
+    def mean(self, dim: Optional[int] = None):
+        if dim is None:
+            return float(jnp.mean(self.to_jax()))
+        return Tensor(jnp.mean(self.to_jax(), axis=dim - 1, keepdims=True))
+
+    def prod(self):
+        return float(jnp.prod(self.to_jax()))
+
+    def max(self, dim: Optional[int] = None):
+        """max() -> scalar; max(dim) -> (values, 1-based indices)."""
+        if dim is None:
+            return float(jnp.max(self.to_jax()))
+        arr = self.to_jax()
+        vals = jnp.max(arr, axis=dim - 1, keepdims=True)
+        idx = jnp.argmax(arr, axis=dim - 1, keepdims=True) + 1
+        return Tensor(vals), Tensor(idx.astype(jnp.float32))
+
+    def min(self, dim: Optional[int] = None):
+        if dim is None:
+            return float(jnp.min(self.to_jax()))
+        arr = self.to_jax()
+        vals = jnp.min(arr, axis=dim - 1, keepdims=True)
+        idx = jnp.argmin(arr, axis=dim - 1, keepdims=True) + 1
+        return Tensor(vals), Tensor(idx.astype(jnp.float32))
+
+    def std(self):
+        return float(jnp.std(self.to_jax(), ddof=1))
+
+    def var(self):
+        return float(jnp.var(self.to_jax(), ddof=1))
+
+    def norm(self, p: int = 2):
+        arr = self.to_jax()
+        if p == 1:
+            return float(jnp.sum(jnp.abs(arr)))
+        if p == 2:
+            return float(jnp.sqrt(jnp.sum(arr * arr)))
+        return float(jnp.sum(jnp.abs(arr) ** p) ** (1.0 / p))
+
+    # -------------------------------------------------------------- linalg
+    def dot(self, other: "Tensor") -> float:
+        return float(jnp.vdot(self.to_jax(), other.to_jax()))
+
+    def mm(self, other: "Tensor") -> "Tensor":
+        return Tensor(jnp.matmul(self.to_jax(), other.to_jax()))
+
+    def mv(self, vec: "Tensor") -> "Tensor":
+        return Tensor(jnp.matmul(self.to_jax(), vec.to_jax()))
+
+    def bmm(self, other: "Tensor") -> "Tensor":
+        return Tensor(jnp.matmul(self.to_jax(), other.to_jax()))
+
+    # --------------------------------------------------------- comparisons
+    def _cmp(self, op, other):
+        return Tensor(op(self.to_jax(), self._coerce(other))
+                      .astype(jnp.float32))
+
+    def eq(self, other):
+        return self._cmp(jnp.equal, other)
+
+    def ne(self, other):
+        return self._cmp(jnp.not_equal, other)
+
+    def lt(self, other):
+        return self._cmp(jnp.less, other)
+
+    def le(self, other):
+        return self._cmp(jnp.less_equal, other)
+
+    def gt(self, other):
+        return self._cmp(jnp.greater, other)
+
+    def ge(self, other):
+        return self._cmp(jnp.greater_equal, other)
+
+    def almostEqual(self, other: "Tensor", eps: float = 1e-6) -> bool:
+        if self._size != other._size:
+            return False
+        return bool(jnp.all(jnp.abs(self.to_jax() - other.to_jax()) <= eps))
+
+    # -------------------------------------------------- select-style ops
+    def indexSelect(self, dim: int, indices) -> "Tensor":
+        """1-based gather along dim (TensorMath.index)."""
+        idx = (indices.to_jax() if isinstance(indices, Tensor)
+               else jnp.asarray(indices))
+        idx = idx.astype(jnp.int32) - 1
+        return Tensor(jnp.take(self.to_jax(), idx, axis=dim - 1))
+
+    index = indexSelect
+
+    def maskedSelect(self, mask: "Tensor") -> "Tensor":
+        m = mask.to_jax().astype(bool)
+        return Tensor(self.to_jax()[m])
+
+    def maskedFill(self, mask: "Tensor", value) -> "Tensor":
+        m = mask.to_jax().astype(bool)
+        return self._write(jnp.where(m, value, self.to_jax()))
+
+    def gather(self, dim: int, index: "Tensor") -> "Tensor":
+        idx = index.to_jax().astype(jnp.int32) - 1
+        return Tensor(jnp.take_along_axis(self.to_jax(), idx, axis=dim - 1)
+                      .astype(self.dtype))
+
+    def scatter(self, dim: int, index: "Tensor", src: "Tensor") -> "Tensor":
+        idx = index.to_jax().astype(jnp.int32) - 1
+        arr = self.to_jax()
+        # build full coordinate grid to place src values along `dim`
+        coords = jnp.meshgrid(
+            *[jnp.arange(s) for s in idx.shape], indexing="ij")
+        coords[dim - 1] = idx
+        return self._write(arr.at[tuple(coords)].set(src.to_jax()))
+
+    def topk(self, k: int, dim: Optional[int] = None, increase: bool = False):
+        """(values, 1-based indices); increase=False -> largest first
+        (TensorMath.topk)."""
+        arr = self.to_jax()
+        d = (dim if dim is not None else self.dim()) - 1
+        if increase:
+            idx = jnp.argsort(arr, axis=d)
+        else:
+            idx = jnp.argsort(-arr, axis=d)
+        idx = jnp.take(idx, jnp.arange(k), axis=d)
+        vals = jnp.take_along_axis(arr, idx, axis=d)
+        return Tensor(vals), Tensor((idx + 1).astype(jnp.float32))
+
+    def sort(self, dim: Optional[int] = None, descending: bool = False):
+        arr = self.to_jax()
+        d = (dim if dim is not None else self.dim()) - 1
+        idx = jnp.argsort(-arr if descending else arr, axis=d)
+        vals = jnp.take_along_axis(arr, idx, axis=d)
+        return Tensor(vals), Tensor((idx + 1).astype(jnp.float32))
+
+    # ----------------------------------------------------------- conversion
+    def astype(self, dtype) -> "Tensor":
+        return Tensor(self.to_jax().astype(TensorNumeric.dtype(dtype)))
+
+    def float(self):
+        return self.astype("float")
+
+    def double(self):
+        return self.astype("double")
+
+    def int(self):
+        return self.astype("int")
+
+    def long(self):
+        return self.astype("long")
+
+    def toSparse(self):
+        from bigdl_tpu.tensor.sparse import SparseTensor
+        return SparseTensor.from_dense(self)
+
+    # -------------------------------------------------------------- dunder
+    def __len__(self):
+        return self._size[0] if self._size else 0
+
+    def __eq__(self, other):
+        if not isinstance(other, Tensor):
+            return NotImplemented
+        return (self._size == other._size
+                and bool(jnp.array_equal(self.to_jax(), other.to_jax())))
+
+    def __hash__(self):
+        return id(self)
+
+    def __repr__(self):
+        return (f"Tensor(size={list(self._size)}, dtype={self.dtype})\n"
+                f"{self.to_numpy()}")
+
+
+# -------------------------------------------------------------- factories
+def zeros(*sizes, dtype="float") -> Tensor:
+    return Tensor(jnp.zeros(sizes, TensorNumeric.dtype(dtype)))
+
+
+def ones(*sizes, dtype="float") -> Tensor:
+    return Tensor(jnp.ones(sizes, TensorNumeric.dtype(dtype)))
+
+
+def arange(start, end, step=1) -> Tensor:
+    """Inclusive range like Torch's `torch.range` (TensorMath.range)."""
+    n = int(math.floor((end - start) / step)) + 1
+    return Tensor(start + step * jnp.arange(n, dtype=jnp.float32))

@@ -21,9 +21,28 @@ from bigdl_tpu.transform.vision.image import FeatureTransformer, ImageFeature
 
 
 def _resize_arr(arr: np.ndarray, h: int, w: int) -> np.ndarray:
-    from PIL import Image
-    im = Image.fromarray(np.clip(arr, 0, 255).astype(np.uint8))
-    return np.asarray(im.resize((w, h), Image.BILINEAR), np.float32)
+    """Float-preserving bilinear resize with half-pixel centers (matches
+    OpenCV INTER_LINEAR); no uint8 round-trip, so normalized / negative
+    pixel values survive transforms applied after ChannelNormalize."""
+    arr = np.asarray(arr, np.float32)
+    H, W = arr.shape[:2]
+    if (H, W) == (h, w):
+        return arr.copy()
+    ys = (np.arange(h, dtype=np.float32) + 0.5) * (H / h) - 0.5
+    xs = (np.arange(w, dtype=np.float32) + 0.5) * (W / w) - 0.5
+    yf, xf = np.floor(ys), np.floor(xs)
+    wy, wx = ys - yf, xs - xf
+    y0 = np.clip(yf, 0, H - 1).astype(np.int64)
+    y1 = np.clip(yf + 1, 0, H - 1).astype(np.int64)
+    x0 = np.clip(xf, 0, W - 1).astype(np.int64)
+    x1 = np.clip(xf + 1, 0, W - 1).astype(np.int64)
+    if arr.ndim == 3:
+        wy_, wx_ = wy[:, None, None], wx[None, :, None]
+    else:
+        wy_, wx_ = wy[:, None], wx[None, :]
+    top = (1 - wx_) * arr[y0][:, x0] + wx_ * arr[y0][:, x1]
+    bot = (1 - wx_) * arr[y1][:, x0] + wx_ * arr[y1][:, x1]
+    return ((1 - wy_) * top + wy_ * bot).astype(np.float32)
 
 
 class Resize(FeatureTransformer):

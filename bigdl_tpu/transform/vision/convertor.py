@@ -100,10 +100,25 @@ class MTImageFeatureToBatch:
         return f
 
     def __call__(self, features: Iterable[ImageFeature]) -> Iterator[MiniBatch]:
+        # Bounded prefetch: at most num_threads*2 decoded images in flight,
+        # so a streaming epoch is never fully materialized in host memory
+        # (the reference's MTImageFeatureToBatch likewise pulls lazily).
+        from collections import deque
         buf: List[ImageFeature] = []
+        limit = self.num_threads * 2
         with ThreadPoolExecutor(max_workers=self.num_threads) as pool:
-            for f in pool.map(self._prep, features):
-                buf.append(f)
+            pending: deque = deque()
+            it = iter(features)
+            exhausted = False
+            while True:
+                while not exhausted and len(pending) < limit:
+                    try:
+                        pending.append(pool.submit(self._prep, next(it)))
+                    except StopIteration:
+                        exhausted = True
+                if not pending:
+                    break
+                buf.append(pending.popleft().result())
                 if len(buf) == self.batch_size:
                     yield self._to_batch(buf)
                     buf = []

@@ -127,13 +127,27 @@ class ImageFrame:
 
     @staticmethod
     def read(path: str, with_label: bool = False) -> "LocalImageFrame":
+        """Read image file / directory (recursively). With `with_label`,
+        the parent directory name becomes the class, mapped to a 1-based
+        label in sorted-name order (reference DataSet.ImageFolder
+        convention)."""
         exts = (".jpg", ".jpeg", ".png", ".bmp")
         if os.path.isdir(path):
-            files = sorted(os.path.join(path, f) for f in os.listdir(path)
-                           if f.lower().endswith(exts))
+            files = sorted(
+                os.path.join(root, f)
+                for root, _, names in os.walk(path)
+                for f in names if f.lower().endswith(exts))
         else:
             files = [path]
-        return LocalImageFrame([ImageFeature.read(f) for f in files])
+        features = [ImageFeature.read(f) for f in files]
+        if with_label:
+            classes = sorted({os.path.basename(os.path.dirname(f))
+                              for f in files})
+            class_to_label = {c: i + 1.0 for i, c in enumerate(classes)}
+            for f, feat in zip(files, features):
+                feat[ImageFeature.LABEL] = class_to_label[
+                    os.path.basename(os.path.dirname(f))]
+        return LocalImageFrame(features)
 
     @staticmethod
     def array(features: Iterable[ImageFeature]) -> "LocalImageFrame":

@@ -1,0 +1,65 @@
+"""Host-side seedable RNG for Torch-parity initialization.
+
+Parity: `RandomGenerator` (DL/utils/RandomGenerator.scala:56) is a
+Mersenne-twister clone so layer init matches Torch exactly; tests seed it via
+`RandomGenerator.RNG.setSeed`. numpy's `RandomState` IS MT19937, so we get
+the same generator family natively; the Torch-specific draw order (e.g.
+Box-Muller normal) differs, which only matters for bit-exact Torch fixture
+tests — our numerical oracle is jax/numpy instead (SURVEY.md §4.2 note).
+
+Device-side randomness (dropout etc.) uses jax PRNG keys threaded through
+ApplyContext; this generator is for host-side init and data augmentation,
+mirroring how the reference keeps RNG on the JVM side.
+"""
+
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+
+
+class RandomGenerator:
+    """MT19937-backed generator with the reference's API shape."""
+
+    def __init__(self, seed: int = 5489):  # MT19937's canonical default seed
+        self._lock = threading.Lock()
+        self._seed = seed
+        self._rs = np.random.RandomState(seed)
+
+    def setSeed(self, seed: int) -> "RandomGenerator":
+        with self._lock:
+            self._seed = seed
+            self._rs = np.random.RandomState(seed)
+        return self
+
+    def getSeed(self) -> int:
+        return self._seed
+
+    def uniform(self, a: float = 0.0, b: float = 1.0, size=None):
+        with self._lock:
+            return self._rs.uniform(a, b, size)
+
+    def normal(self, mean: float = 0.0, stdv: float = 1.0, size=None):
+        with self._lock:
+            return self._rs.normal(mean, stdv, size)
+
+    def bernoulli(self, p: float, size=None):
+        with self._lock:
+            return (self._rs.uniform(0.0, 1.0, size) < p).astype(np.float32)
+
+    def exponential(self, lam: float = 1.0, size=None):
+        with self._lock:
+            return self._rs.exponential(1.0 / lam, size)
+
+    def permutation(self, n: int):
+        with self._lock:
+            return self._rs.permutation(n)
+
+    def randint(self, low: int, high: int, size=None):
+        with self._lock:
+            return self._rs.randint(low, high, size)
+
+
+# Global instance, mirrors `RandomGenerator.RNG` in the reference.
+RNG = RandomGenerator()
