@@ -1,1 +1,8 @@
 from bigdl_tpu.utils.table import T, Table
+from bigdl_tpu.utils.engine import Engine
+from bigdl_tpu.utils.shape import MultiShape, Shape, SingleShape
+from bigdl_tpu.utils.random_generator import RNG, RandomGenerator
+from bigdl_tpu.utils.logger import redirect_noisy_logs, show_info_logs
+
+__all__ = ["T", "Table", "Engine", "Shape", "SingleShape", "MultiShape",
+           "RNG", "RandomGenerator", "redirect_noisy_logs", "show_info_logs"]

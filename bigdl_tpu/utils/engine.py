@@ -1,0 +1,122 @@
+"""Engine: runtime topology + the single config system.
+
+Parity: `Engine` (DL/utils/Engine.scala:41) — a global singleton that
+detects node count and cores-per-executor from SparkConf
+(Engine.scala:455-556), owns thread pools, engine type, and a singleton
+check. The reference spreads configuration over THREE mechanisms (SURVEY.md
+§5.6: `bigdl.*` JVM properties, spark-bigdl.conf, per-example scopt CLIs);
+this build replaces all of them with ONE: `Engine.config`, a typed dict
+seeded from defaults and overridable by `BIGDL_TPU_*` environment variables
+or `Engine.init(**kwargs)`.
+
+TPU translation of the topology model:
+  node_number   — jax process count (multi-host pod slice),
+                  reference: Spark executor count
+  core_number   — local device (chip) count per process,
+                  reference: cores per executor
+  engine_type   — 'xla' | 'pallas-preferred' (reference MklBlas | MklDnn,
+                  Engine.scala:35-38)
+There are no thread pools: XLA owns device parallelism; host-side IO
+threading lives in the data pipeline (MTImageFeatureToBatch) and the native
+loader.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+from typing import Any, Dict, Optional
+
+_DEFAULTS: Dict[str, Any] = {
+    # engine type: 'xla' = let XLA lower everything; 'pallas' = prefer
+    # hand-written pallas kernels where registered (reference MklBlas|MklDnn)
+    "engine_type": "xla",
+    # failure handling (reference bigdl.failure.retryTimes / retryTimeInterval,
+    # DistriOptimizer.scala:863)
+    "failure_retry_times": 5,
+    "failure_retry_interval_s": 120,
+    # data pipeline host threads (reference bigdl.Parameter.syncPoolSize etc.)
+    "io_threads": 4,
+    # singleton check (reference bigdl.check.singleton, Engine.scala:263)
+    "check_singleton": False,
+    # default matmul precision for the compute path
+    "matmul_dtype": "bfloat16",
+}
+
+_ENV_PREFIX = "BIGDL_TPU_"
+
+
+class _Engine:
+    """Module-level singleton (import `Engine` from this module)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._inited = False
+        self.config: Dict[str, Any] = dict(_DEFAULTS)
+        self._mesh = None
+
+    def init(self, **overrides) -> "_Engine":
+        """Initialize topology + config. Idempotent; later calls only merge
+        config overrides (reference Engine.init, Engine.scala:105)."""
+        with self._lock:
+            for k, v in os.environ.items():
+                if k.startswith(_ENV_PREFIX):
+                    key = k[len(_ENV_PREFIX):].lower()
+                    if key in self.config:
+                        self.config[key] = type(_DEFAULTS.get(key, v))(
+                            _coerce(v, _DEFAULTS.get(key)))
+            for k, v in overrides.items():
+                if k not in self.config:
+                    raise KeyError(f"unknown Engine config key: {k}")
+                self.config[k] = v
+            if self._inited:
+                return self
+            if self.config["check_singleton"] and _SINGLETON.locked():
+                raise RuntimeError(
+                    "Engine already initialized in this process "
+                    "(check_singleton, reference Engine.scala:263)")
+            _SINGLETON.acquire(blocking=False)
+            self._inited = True
+            return self
+
+    # ------------------------------------------------------------ topology
+    def node_number(self) -> int:
+        """jax process count (multi-host); reference executor count."""
+        import jax
+        return jax.process_count()
+
+    def core_number(self) -> int:
+        """Local chip count; reference cores-per-executor."""
+        import jax
+        return jax.local_device_count()
+
+    def total_devices(self) -> int:
+        import jax
+        return jax.device_count()
+
+    def engine_type(self) -> str:
+        return self.config["engine_type"]
+
+    def get_mesh(self, data: Optional[int] = None, model: int = 1):
+        """Build (and cache) the global device mesh."""
+        if self._mesh is None or data is not None or model != 1:
+            from bigdl_tpu.parallel.mesh import build_mesh
+            self._mesh = build_mesh(data=data, model=model)
+        return self._mesh
+
+
+_SINGLETON = threading.Lock()
+
+
+def _coerce(raw: str, default):
+    if isinstance(default, bool):
+        return raw.lower() in ("1", "true", "yes")
+    if isinstance(default, int):
+        return int(raw)
+    if isinstance(default, float):
+        return float(raw)
+    return raw
+
+
+Engine = _Engine()

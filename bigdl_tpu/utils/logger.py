@@ -1,0 +1,47 @@
+"""Logging setup.
+
+Parity: `LoggerFilter` (DL/utils/LoggerFilter.scala) — the reference
+redirects noisy Spark logs to a file and keeps the per-iteration training
+INFO lines on the console (exposed in python as `redire_spark_logs` /
+`show_bigdl_info_logs`, PY/util/common.py:432). Here the noisy party is
+jax/XLA compilation chatter instead of Spark.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Optional
+
+_FMT = "%(asctime)s %(levelname)s %(name)s - %(message)s"
+
+_NOISY = ("jax._src", "jax.experimental", "absl")
+
+
+def redirect_noisy_logs(log_path: Optional[str] = None,
+                        level: int = logging.WARNING):
+    """Send jax/XLA internals to `log_path` (default bigdl-tpu.log in cwd)
+    at WARNING+, keeping the training loop's INFO lines on the console —
+    the LoggerFilter contract."""
+    path = log_path or os.path.join(os.getcwd(), "bigdl-tpu.log")
+    handler = logging.FileHandler(path)
+    handler.setFormatter(logging.Formatter(_FMT))
+    for name in _NOISY:
+        lg = logging.getLogger(name)
+        lg.addHandler(handler)
+        lg.setLevel(level)
+        lg.propagate = False
+    return path
+
+
+def show_info_logs(name: str = "bigdl_tpu", level: int = logging.INFO
+                   ) -> logging.Logger:
+    """Console logger for training progress (the reference's per-iteration
+    'Throughput is X records/second' lines, DistriOptimizer.scala:405-410)."""
+    logger = logging.getLogger(name)
+    if not logger.handlers:
+        h = logging.StreamHandler()
+        h.setFormatter(logging.Formatter(_FMT))
+        logger.addHandler(h)
+    logger.setLevel(level)
+    return logger

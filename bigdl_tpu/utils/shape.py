@@ -1,0 +1,54 @@
+"""Shape types for the Keras-style shape-inference surface.
+
+Parity: `Shape` (DL/utils/Shape.scala) — `SingleShape` wraps one dim list,
+`MultiShape` a list of shapes (multi-input layers). The Keras layer stack
+infers output shapes at `add()` time through these (InferShape.scala).
+Batch dim is position 0 and conventionally -1 (unknown).
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence, Union
+
+
+class Shape:
+    @staticmethod
+    def of(*dims) -> "SingleShape":
+        return SingleShape(list(dims))
+
+    @staticmethod
+    def multi(shapes: Sequence["Shape"]) -> "MultiShape":
+        return MultiShape(list(shapes))
+
+
+class SingleShape(Shape):
+    def __init__(self, dims: Sequence[int]):
+        self.dims = [int(d) for d in dims]
+
+    def to_list(self) -> List[int]:
+        return list(self.dims)
+
+    def copy_and_update(self, index: int, value: int) -> "SingleShape":
+        dims = list(self.dims)
+        dims[index] = value
+        return SingleShape(dims)
+
+    def __eq__(self, other):
+        return isinstance(other, SingleShape) and self.dims == other.dims
+
+    def __repr__(self):
+        return f"SingleShape({self.dims})"
+
+
+class MultiShape(Shape):
+    def __init__(self, shapes: Sequence[Shape]):
+        self.shapes = list(shapes)
+
+    def to_list(self) -> List[Shape]:
+        return list(self.shapes)
+
+    def __eq__(self, other):
+        return isinstance(other, MultiShape) and self.shapes == other.shapes
+
+    def __repr__(self):
+        return f"MultiShape({self.shapes})"

@@ -1,0 +1,82 @@
+"""Utils tests: Engine config/topology, Shape, RNG, logger.
+
+Mirrors TEST/utils/*Spec.scala (SURVEY.md §4.1).
+"""
+
+import logging
+import os
+
+import numpy as np
+import pytest
+
+from bigdl_tpu.utils import (Engine, MultiShape, RNG, Shape, SingleShape,
+                             redirect_noisy_logs, show_info_logs)
+
+
+class TestEngine:
+    def test_topology_matches_jax(self):
+        import jax
+        Engine.init()
+        assert Engine.node_number() == jax.process_count()
+        assert Engine.core_number() == jax.local_device_count()
+        assert Engine.total_devices() == 8  # conftest virtual mesh
+
+    def test_config_defaults_and_override(self):
+        Engine.init(failure_retry_times=3)
+        assert Engine.config["failure_retry_times"] == 3
+        assert Engine.engine_type() == "xla"
+        with pytest.raises(KeyError):
+            Engine.init(not_a_key=1)
+        Engine.init(failure_retry_times=5)  # restore
+
+    def test_env_override(self, monkeypatch):
+        monkeypatch.setenv("BIGDL_TPU_IO_THREADS", "9")
+        Engine.init()
+        assert Engine.config["io_threads"] == 9
+        monkeypatch.delenv("BIGDL_TPU_IO_THREADS")
+        Engine.init(io_threads=4)
+
+    def test_mesh(self):
+        mesh = Engine.get_mesh(data=4, model=2)
+        assert dict(zip(mesh.axis_names, mesh.devices.shape)) == {
+            "data": 4, "model": 2}
+
+
+class TestShape:
+    def test_single(self):
+        s = Shape.of(-1, 28, 28)
+        assert s.to_list() == [-1, 28, 28]
+        assert s.copy_and_update(0, 32).to_list() == [32, 28, 28]
+        assert s == SingleShape([-1, 28, 28])
+
+    def test_multi(self):
+        m = Shape.multi([Shape.of(-1, 10), Shape.of(-1, 5)])
+        assert isinstance(m, MultiShape)
+        assert m.to_list()[1] == Shape.of(-1, 5)
+
+
+class TestRNG:
+    def test_seed_repeatability(self):
+        RNG.setSeed(7)
+        a = RNG.uniform(0, 1, 5)
+        RNG.setSeed(7)
+        b = RNG.uniform(0, 1, 5)
+        np.testing.assert_allclose(a, b)
+        assert RNG.getSeed() == 7
+
+    def test_distributions(self):
+        RNG.setSeed(1)
+        assert 0.2 < RNG.bernoulli(0.5, 1000).mean() < 0.8
+        assert set(RNG.permutation(5)) == set(range(5))
+        e = RNG.exponential(2.0, 2000)
+        assert abs(e.mean() - 0.5) < 0.1  # mean = 1/lambda
+
+
+class TestLogger:
+    def test_redirect_and_console(self, tmp_path):
+        path = redirect_noisy_logs(str(tmp_path / "noise.log"))
+        assert os.path.exists(os.path.dirname(path)) or os.path.exists(path)
+        lg = show_info_logs("bigdl_tpu.test")
+        assert lg.level == logging.INFO
+        noisy = logging.getLogger("jax._src")
+        assert not noisy.propagate
