@@ -1,0 +1,171 @@
+"""ctypes bindings to the native host-runtime library.
+
+Parity role: the reference's native layer (bigdl-core, SURVEY.md C24/C25)
+serves two masters — compute kernels (MKL/MKL-DNN) and host plumbing
+(CRC32C, OpenCV decode, threaded loaders). On TPU the compute half IS
+XLA/Pallas; what stays native is the host data plane. This package loads
+`native/libbigdl_tpu_native.so` (built by `make -C native`), attempts an
+on-demand build if g++ is available, and falls back to pure Python so the
+framework works without a toolchain.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+from typing import Optional
+
+_LIB: Optional[ctypes.CDLL] = None
+_TRIED = False
+
+_NATIVE_DIR = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+    "native")
+_LIB_PATH = os.path.join(_NATIVE_DIR, "libbigdl_tpu_native.so")
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _LIB, _TRIED
+    if _TRIED:
+        return _LIB
+    _TRIED = True
+    if not os.path.exists(_LIB_PATH) and os.path.exists(
+            os.path.join(_NATIVE_DIR, "Makefile")):
+        try:
+            subprocess.run(["make", "-C", _NATIVE_DIR], check=True,
+                           capture_output=True, timeout=120)
+        except Exception:
+            pass
+    if os.path.exists(_LIB_PATH):
+        try:
+            lib = ctypes.CDLL(_LIB_PATH)
+            lib.bigdl_crc32c.restype = ctypes.c_uint32
+            lib.bigdl_crc32c.argtypes = [ctypes.c_uint32, ctypes.c_char_p,
+                                         ctypes.c_size_t]
+            lib.bigdl_tfrecord_open.restype = ctypes.c_void_p
+            lib.bigdl_tfrecord_open.argtypes = [ctypes.c_char_p,
+                                                ctypes.c_int64]
+            lib.bigdl_tfrecord_next_len.restype = ctypes.c_int64
+            lib.bigdl_tfrecord_next_len.argtypes = [ctypes.c_void_p]
+            lib.bigdl_tfrecord_read.restype = ctypes.c_int64
+            lib.bigdl_tfrecord_read.argtypes = [ctypes.c_void_p,
+                                                ctypes.c_char_p]
+            lib.bigdl_tfrecord_close.restype = None
+            lib.bigdl_tfrecord_close.argtypes = [ctypes.c_void_p]
+            _LIB = lib
+        except OSError:
+            _LIB = None
+    return _LIB
+
+
+def native_available() -> bool:
+    return _load() is not None
+
+
+# ---------------------------------------------------------------- CRC32C
+_PY_TABLE = None
+
+
+def _py_table():
+    global _PY_TABLE
+    if _PY_TABLE is None:
+        poly = 0x82F63B78
+        table = []
+        for i in range(256):
+            crc = i
+            for _ in range(8):
+                crc = (crc >> 1) ^ (poly if crc & 1 else 0)
+            table.append(crc)
+        _PY_TABLE = table
+    return _PY_TABLE
+
+
+def crc32c(data: bytes, crc: int = 0) -> int:
+    """CRC32C of `data` (incremental via `crc`). Native when available
+    (slice-by-8, native/crc32c.cc); table-driven Python otherwise."""
+    lib = _load()
+    if lib is not None:
+        return lib.bigdl_crc32c(crc, data, len(data))
+    table = _py_table()
+    c = crc ^ 0xFFFFFFFF
+    for b in data:
+        c = (c >> 8) ^ table[(c ^ b) & 0xFF]
+    return c ^ 0xFFFFFFFF
+
+
+def masked_crc32c(data: bytes) -> int:
+    """TFRecord masked CRC (RecordWriter.scala:40-47 masking constant)."""
+    c = crc32c(data)
+    return (((c >> 15) | (c << 17)) + 0xA282EAD8) & 0xFFFFFFFF
+
+
+# ----------------------------------------------------- TFRecord reading
+class NativeTFRecordReader:
+    """Iterate records of a TFRecord file with background-thread prefetch
+    (native/loader.cc). Falls back to single-threaded Python framing."""
+
+    def __init__(self, path: str, queue_capacity: int = 64):
+        self.path = path
+        self._lib = _load()
+        self._handle = None
+        self._pyfile = None
+        if self._lib is not None:
+            self._handle = self._lib.bigdl_tfrecord_open(
+                path.encode(), queue_capacity)
+            if not self._handle:
+                raise FileNotFoundError(path)
+        else:
+            self._pyfile = open(path, "rb")
+
+    def __iter__(self):
+        return self
+
+    def __next__(self) -> bytes:
+        if self._handle is not None:
+            n = self._lib.bigdl_tfrecord_next_len(self._handle)
+            if n == -2:
+                raise StopIteration
+            if n < 0:
+                raise IOError(f"corrupt TFRecord file: {self.path}")
+            buf = ctypes.create_string_buffer(max(n, 1))
+            got = self._lib.bigdl_tfrecord_read(self._handle, buf)
+            if got != n:
+                raise IOError(f"short TFRecord read: {self.path}")
+            return buf.raw[:n]
+        return self._py_next()
+
+    def _py_next(self) -> bytes:
+        import struct
+        header = self._pyfile.read(12)
+        if len(header) < 12:
+            raise StopIteration
+        (length,) = struct.unpack("<Q", header[:8])
+        (len_crc,) = struct.unpack("<I", header[8:12])
+        if masked_crc32c(header[:8]) != len_crc:
+            raise IOError(f"corrupt TFRecord length: {self.path}")
+        data = self._pyfile.read(length)
+        (data_crc,) = struct.unpack("<I", self._pyfile.read(4))
+        if masked_crc32c(data) != data_crc:
+            raise IOError(f"corrupt TFRecord data: {self.path}")
+        return data
+
+    def close(self):
+        if self._handle is not None:
+            self._lib.bigdl_tfrecord_close(self._handle)
+            self._handle = None
+        if self._pyfile is not None:
+            self._pyfile.close()
+            self._pyfile = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

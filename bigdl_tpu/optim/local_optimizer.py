@@ -252,6 +252,18 @@ class LocalOptimizer(BaseOptimizer):
                 self.train_summary.add_scalar("Loss", loss, it)
                 self.train_summary.add_scalar("LearningRate", lr, it)
                 self.train_summary.add_scalar("Throughput", throughput, it)
+                # Parameters histograms only behind an explicit trigger —
+                # they pull every weight to host (AbstractOptimizer.scala:47-92)
+                trig = getattr(self.train_summary, "get_summary_trigger",
+                               lambda _n: None)("Parameters")
+                if trig is not None and trig(driver_state):
+                    import jax as _jax
+                    flat = _jax.tree_util.tree_flatten_with_path(params)[0]
+                    for path, leaf in flat:
+                        tag = "/".join(
+                            str(getattr(p, "key", getattr(p, "idx", p)))
+                            for p in path)
+                        self.train_summary.add_histogram(tag, leaf, it)
 
             if driver_state["recordsProcessedThisEpoch"] >= epoch_size:
                 driver_state["epoch"] += 1

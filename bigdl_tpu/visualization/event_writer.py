@@ -1,0 +1,74 @@
+"""TensorBoard event-file writer.
+
+Parity: `EventWriter` (DL/visualization/tensorboard/EventWriter.scala:31) +
+`FileWriter` (FileWriter.scala:31): events are queued and drained by a
+background thread into `events.out.tfevents.<ts>.<host>`, starting with a
+file-version event ("brain.Event:2").
+"""
+
+from __future__ import annotations
+
+import os
+import queue
+import socket
+import threading
+import time
+
+from bigdl_tpu.proto import tb_event_pb2
+from bigdl_tpu.visualization.record_writer import RecordWriter
+
+
+class EventWriter:
+    """Background-thread writer of Event protos to one events file."""
+
+    _FLUSH_SECS = 5.0
+
+    def __init__(self, logdir: str):
+        os.makedirs(logdir, exist_ok=True)
+        fname = f"events.out.tfevents.{int(time.time())}.{socket.gethostname()}"
+        self.path = os.path.join(logdir, fname)
+        self._fh = open(self.path, "wb")
+        self._writer = RecordWriter(self._fh)
+        self._queue: "queue.Queue" = queue.Queue()
+        self._closed = threading.Event()
+        first = tb_event_pb2.Event(wall_time=time.time(),
+                                   file_version="brain.Event:2")
+        self._queue.put(first)
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def add_event(self, event: tb_event_pb2.Event):
+        if self._closed.is_set():
+            raise RuntimeError("EventWriter is closed")
+        self._queue.put(event)
+
+    def _run(self):
+        last_flush = time.time()
+        while True:
+            try:
+                ev = self._queue.get(timeout=0.2)
+            except queue.Empty:
+                if self._closed.is_set():
+                    break
+                continue
+            if ev is None:
+                self._queue.task_done()
+                break
+            self._writer.write_record(ev.SerializeToString())
+            self._queue.task_done()
+            if time.time() - last_flush > self._FLUSH_SECS:
+                self._writer.flush()
+                last_flush = time.time()
+        self._writer.flush()
+
+    def flush(self):
+        """Block until queued events hit the file."""
+        self._queue.join()
+        self._writer.flush()
+
+    def close(self):
+        if not self._closed.is_set():
+            self._closed.set()
+            self._queue.put(None)
+            self._thread.join()
+            self._fh.close()

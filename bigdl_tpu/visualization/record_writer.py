@@ -1,0 +1,50 @@
+"""TFRecord framing writer.
+
+Parity: `RecordWriter` (DL/visualization/tensorboard/RecordWriter.scala:31)
+— frames each payload as
+  uint64 length | uint32 masked_crc32c(length) | data | masked_crc32c(data)
+using the masked CRC32C from the native lib (netty/Crc32c.java in the
+reference). Shared by the TensorBoard event writer and TFRecord dataset IO.
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import BinaryIO
+
+from bigdl_tpu.native import masked_crc32c
+
+
+class RecordWriter:
+    def __init__(self, fileobj: BinaryIO):
+        self.f = fileobj
+
+    def write_record(self, data: bytes):
+        header = struct.pack("<Q", len(data))
+        self.f.write(header)
+        self.f.write(struct.pack("<I", masked_crc32c(header)))
+        self.f.write(data)
+        self.f.write(struct.pack("<I", masked_crc32c(data)))
+
+    def flush(self):
+        self.f.flush()
+
+
+class TFRecordFileWriter:
+    """Standalone .tfrecord file writer (reference TFRecordWriter.scala)."""
+
+    def __init__(self, path: str):
+        self._fh = open(path, "wb")
+        self._writer = RecordWriter(self._fh)
+
+    def write(self, record: bytes):
+        self._writer.write_record(record)
+
+    def close(self):
+        self._fh.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

@@ -1,0 +1,138 @@
+"""Training/validation summaries + event-file reader.
+
+Parity: `Summary.addScalar` (DL/visualization/Summary.scala:44),
+`TrainSummary`/`ValidationSummary` (DL/visualization/*.scala) attached to
+the optimizer via `setTrainSummary` (Optimizer.scala:217); scalars (Loss,
+Throughput, LearningRate) are logged every step, `Parameters` histograms
+behind a trigger (AbstractOptimizer.saveSummary:47-92). `FileReader` reads
+scalars back for notebooks.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from bigdl_tpu.proto import tb_event_pb2
+from bigdl_tpu.visualization.event_writer import EventWriter
+
+
+def scalar_event(tag: str, value: float, step: int) -> tb_event_pb2.Event:
+    ev = tb_event_pb2.Event(wall_time=time.time(), step=step)
+    ev.summary.value.add(tag=tag, simple_value=float(value))
+    return ev
+
+
+def histogram_event(tag: str, values, step: int) -> tb_event_pb2.Event:
+    """TF-style exponential-bucket histogram of a flat array."""
+    vals = np.asarray(values).reshape(-1).astype(np.float64)
+    ev = tb_event_pb2.Event(wall_time=time.time(), step=step)
+    v = ev.summary.value.add(tag=tag)
+    h = v.histo
+    if vals.size == 0:
+        return ev
+    h.min, h.max = float(vals.min()), float(vals.max())
+    h.num = float(vals.size)
+    h.sum = float(vals.sum())
+    h.sum_squares = float((vals * vals).sum())
+    limits = _bucket_limits()
+    counts, _ = np.histogram(vals, bins=[-np.inf] + limits)
+    # drop empty leading/trailing buckets like TF's writer
+    nz = np.nonzero(counts)[0]
+    if nz.size:
+        lo, hi = nz[0], nz[-1] + 1
+        h.bucket_limit.extend(limits[lo:hi])
+        h.bucket.extend(counts[lo:hi].astype(float))
+    return ev
+
+
+_LIMITS: Optional[List[float]] = None
+
+
+def _bucket_limits() -> List[float]:
+    global _LIMITS
+    if _LIMITS is None:
+        pos = []
+        v = 1e-12
+        while v < 1e20:
+            pos.append(v)
+            v *= 1.1
+        _LIMITS = [-x for x in reversed(pos)] + [0.0] + pos + [float("inf")]
+    return _LIMITS
+
+
+class Summary:
+    """Base writer bound to <log_dir>/<app_name>/<phase>."""
+
+    def __init__(self, log_dir: str, app_name: str, phase: str):
+        self.log_dir = os.path.join(log_dir, app_name, phase)
+        self._writer = EventWriter(self.log_dir)
+
+    def add_scalar(self, tag: str, value: float, step: int) -> "Summary":
+        self._writer.add_event(scalar_event(tag, value, step))
+        return self
+
+    def add_histogram(self, tag: str, values, step: int) -> "Summary":
+        self._writer.add_event(histogram_event(tag, values, step))
+        return self
+
+    def read_scalar(self, tag: str) -> List[Tuple[int, float]]:
+        self._writer.flush()
+        return FileReader.read_scalar(self.log_dir, tag)
+
+    def close(self):
+        self._writer.close()
+
+
+class TrainSummary(Summary):
+    """Per-iteration Loss/Throughput/LearningRate scalars; `Parameters`
+    histograms gated by a trigger (TrainSummary.scala)."""
+
+    def __init__(self, log_dir: str, app_name: str):
+        super().__init__(log_dir, app_name, "train")
+        self._triggers: Dict[str, object] = {}
+
+    def set_summary_trigger(self, name: str, trigger) -> "TrainSummary":
+        if name not in ("Loss", "Throughput", "LearningRate", "Parameters"):
+            raise ValueError(f"unknown summary name: {name}")
+        self._triggers[name] = trigger
+        return self
+
+    def get_summary_trigger(self, name: str):
+        return self._triggers.get(name)
+
+
+class ValidationSummary(Summary):
+    """ValidationMethod results per validation pass
+    (ValidationSummary.scala)."""
+
+    def __init__(self, log_dir: str, app_name: str):
+        super().__init__(log_dir, app_name, "validation")
+
+
+class FileReader:
+    """Read scalars back from events files (tensorboard/FileReader.scala)."""
+
+    @staticmethod
+    def list_events(path: str) -> List[str]:
+        if os.path.isfile(path):
+            return [path]
+        return sorted(
+            os.path.join(path, f) for f in os.listdir(path)
+            if f.startswith("events.out.tfevents"))
+
+    @staticmethod
+    def read_scalar(path: str, tag: str) -> List[Tuple[int, float]]:
+        from bigdl_tpu.native import NativeTFRecordReader
+        out: List[Tuple[int, float]] = []
+        for fname in FileReader.list_events(path):
+            with NativeTFRecordReader(fname) as reader:
+                for record in reader:
+                    ev = tb_event_pb2.Event.FromString(record)
+                    for v in ev.summary.value:
+                        if v.tag == tag:
+                            out.append((int(ev.step), float(v.simple_value)))
+        return sorted(out)

@@ -1,0 +1,58 @@
+// CRC32C (Castagnoli) — slice-by-8 table implementation.
+//
+// Parity role: the reference ships netty/Crc32c.java (in-tree Java,
+// SURVEY.md C25) for TFRecord framing + TensorBoard event masking
+// (RecordWriter.scala:40-47) and TFRecord dataset IO. Here it is the first
+// piece of the native host-side runtime: Python calls through ctypes, with
+// a pure-python fallback when the shared library is absent.
+//
+// Build: `make` in this directory -> libbigdl_tpu_native.so
+
+#include <cstddef>
+#include <cstdint>
+
+namespace {
+
+uint32_t kTable[8][256];
+bool kInit = false;
+
+void InitTables() {
+  const uint32_t poly = 0x82F63B78u;  // reflected Castagnoli
+  for (uint32_t i = 0; i < 256; ++i) {
+    uint32_t crc = i;
+    for (int j = 0; j < 8; ++j)
+      crc = (crc >> 1) ^ ((crc & 1) ? poly : 0);
+    kTable[0][i] = crc;
+  }
+  for (uint32_t i = 0; i < 256; ++i)
+    for (int t = 1; t < 8; ++t)
+      kTable[t][i] = (kTable[t - 1][i] >> 8) ^ kTable[0][kTable[t - 1][i] & 0xFF];
+  kInit = true;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Incremental CRC32C: pass crc=0 to start, feed back the return value.
+uint32_t bigdl_crc32c(uint32_t crc, const uint8_t* data, size_t n) {
+  if (!kInit) InitTables();
+  crc = ~crc;
+  // Process 8 bytes at a time (slice-by-8).
+  while (n >= 8) {
+    uint32_t lo = crc ^ (static_cast<uint32_t>(data[0]) |
+                         (static_cast<uint32_t>(data[1]) << 8) |
+                         (static_cast<uint32_t>(data[2]) << 16) |
+                         (static_cast<uint32_t>(data[3]) << 24));
+    crc = kTable[7][lo & 0xFF] ^ kTable[6][(lo >> 8) & 0xFF] ^
+          kTable[5][(lo >> 16) & 0xFF] ^ kTable[4][lo >> 24] ^
+          kTable[3][data[4]] ^ kTable[2][data[5]] ^
+          kTable[1][data[6]] ^ kTable[0][data[7]];
+    data += 8;
+    n -= 8;
+  }
+  while (n--) crc = (crc >> 8) ^ kTable[0][(crc ^ *data++) & 0xFF];
+  return ~crc;
+}
+
+}  // extern "C"

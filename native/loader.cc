@@ -1,0 +1,138 @@
+// Threaded TFRecord reader: background prefetch off the Python GIL.
+//
+// Parity role: the reference's data plane reads TFRecord/SeqFiles through
+// Hadoop input formats on Spark executor threads (TFRecordInputFormat,
+// SURVEY.md C28; MTLabeledBGRImgToBatch worker threads, C13). The TPU-host
+// equivalent: a C++ reader thread streams records from disk into a bounded
+// queue while Python/JAX consumes batches — disk IO never blocks the step
+// loop and never holds the GIL.
+//
+// TFRecord framing (checked with CRC32C from crc32c.cc):
+//   uint64 length | uint32 masked_crc32c(length) | bytes data |
+//   uint32 masked_crc32c(data)
+
+#include <condition_variable>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <deque>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+extern "C" uint32_t bigdl_crc32c(uint32_t crc, const uint8_t* data, size_t n);
+
+namespace {
+
+uint32_t Mask(uint32_t crc) {
+  return ((crc >> 15) | (crc << 17)) + 0xa282ead8u;
+}
+
+struct Reader {
+  FILE* f = nullptr;
+  std::thread worker;
+  std::mutex mu;
+  std::condition_variable cv_pop, cv_push;
+  std::deque<std::vector<uint8_t>> queue;
+  size_t capacity = 64;
+  bool eof = false;
+  bool error = false;
+  bool stop = false;
+
+  void Run() {
+    for (;;) {
+      uint8_t header[12];
+      if (fread(header, 1, 12, f) != 12) break;  // clean EOF
+      uint64_t len;
+      uint32_t len_crc;
+      memcpy(&len, header, 8);
+      memcpy(&len_crc, header + 8, 4);
+      if (Mask(bigdl_crc32c(0, header, 8)) != len_crc) {
+        SetError();
+        return;
+      }
+      std::vector<uint8_t> data(len);
+      uint8_t footer[4];
+      if (fread(data.data(), 1, len, f) != len ||
+          fread(footer, 1, 4, f) != 4) {
+        SetError();
+        return;
+      }
+      uint32_t data_crc;
+      memcpy(&data_crc, footer, 4);
+      if (Mask(bigdl_crc32c(0, data.data(), len)) != data_crc) {
+        SetError();
+        return;
+      }
+      std::unique_lock<std::mutex> lk(mu);
+      cv_push.wait(lk, [this] { return queue.size() < capacity || stop; });
+      if (stop) return;
+      queue.push_back(std::move(data));
+      cv_pop.notify_one();
+    }
+    std::lock_guard<std::mutex> lk(mu);
+    eof = true;
+    cv_pop.notify_all();
+  }
+
+  void SetError() {
+    std::lock_guard<std::mutex> lk(mu);
+    error = true;
+    eof = true;
+    cv_pop.notify_all();
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+void* bigdl_tfrecord_open(const char* path, int64_t queue_capacity) {
+  FILE* f = fopen(path, "rb");
+  if (!f) return nullptr;
+  Reader* r = new Reader();
+  r->f = f;
+  if (queue_capacity > 0) r->capacity = static_cast<size_t>(queue_capacity);
+  r->worker = std::thread([r] { r->Run(); });
+  return r;
+}
+
+// Length of the next record (>=0); -2 = EOF, -1 = corrupt file. Blocks on
+// prefetch. Zero-length records are valid, hence the distinct EOF code.
+int64_t bigdl_tfrecord_next_len(void* handle) {
+  Reader* r = static_cast<Reader*>(handle);
+  std::unique_lock<std::mutex> lk(r->mu);
+  r->cv_pop.wait(lk, [r] { return !r->queue.empty() || r->eof; });
+  if (!r->queue.empty()) return static_cast<int64_t>(r->queue.front().size());
+  return r->error ? -1 : -2;
+}
+
+// Copy the next record into buf (must hold next_len bytes) and advance.
+// Returns the record length; -2 = EOF, -1 = corrupt.
+int64_t bigdl_tfrecord_read(void* handle, uint8_t* buf) {
+  Reader* r = static_cast<Reader*>(handle);
+  std::unique_lock<std::mutex> lk(r->mu);
+  r->cv_pop.wait(lk, [r] { return !r->queue.empty() || r->eof; });
+  if (r->queue.empty()) return r->error ? -1 : -2;
+  std::vector<uint8_t> rec = std::move(r->queue.front());
+  r->queue.pop_front();
+  r->cv_push.notify_one();
+  lk.unlock();
+  memcpy(buf, rec.data(), rec.size());
+  return static_cast<int64_t>(rec.size());
+}
+
+void bigdl_tfrecord_close(void* handle) {
+  Reader* r = static_cast<Reader*>(handle);
+  {
+    std::lock_guard<std::mutex> lk(r->mu);
+    r->stop = true;
+    r->cv_push.notify_all();
+  }
+  if (r->worker.joinable()) r->worker.join();
+  fclose(r->f);
+  delete r;
+}
+
+}  // extern "C"

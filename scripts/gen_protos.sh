@@ -1,0 +1,5 @@
+#!/bin/bash
+# Regenerate bigdl_tpu/proto/*_pb2.py from protos/*.proto.
+set -e
+cd "$(dirname "$0")/.."
+protoc --proto_path=protos --python_out=bigdl_tpu/proto protos/*.proto
