@@ -94,6 +94,28 @@ class Module:
         self._params: Optional[Dict] = None  # cached stateful params
         self._state: Dict = {}
 
+    def __init_subclass__(cls, **kwargs):
+        """Capture constructor args on every subclass instance — the
+        reflection hook the protobuf serializer uses to rebuild modules
+        (reference: reflection-driven default serialization,
+        ModuleSerializer.scala:34 / DataConverter). The outermost __init__
+        in the MRO wins, so `self._ctor_spec` records the concrete class."""
+        super().__init_subclass__(**kwargs)
+        orig = cls.__dict__.get("__init__")
+        if orig is None or getattr(orig, "_ctor_capture", False):
+            return
+
+        import functools
+
+        @functools.wraps(orig)
+        def wrapper(self, *args, **kw):
+            if "_ctor_spec" not in self.__dict__:
+                self._ctor_spec = (type(self).__name__, args, dict(kw))
+            orig(self, *args, **kw)
+
+        wrapper._ctor_capture = True
+        cls.__init__ = wrapper
+
     # ------------------------------------------------------------------ #
     # functional contract
     # ------------------------------------------------------------------ #

@@ -1,3 +1,9 @@
 from bigdl_tpu.serialization.checkpoint import (load_checkpoint,
                                                 save_checkpoint,
                                                 latest_checkpoint)
+from bigdl_tpu.serialization.module_serializer import (ModuleSerializer,
+                                                       register_module,
+                                                       registered_modules)
+
+__all__ = ["load_checkpoint", "save_checkpoint", "latest_checkpoint",
+           "ModuleSerializer", "register_module", "registered_modules"]
