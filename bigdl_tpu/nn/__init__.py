@@ -99,3 +99,7 @@ from bigdl_tpu.nn import initialization
 from bigdl_tpu.nn.initialization import (BilinearFiller, ConstInitMethod,
                                          MsraFiller, Ones, RandomNormal,
                                          RandomUniform, Xavier, Zeros)
+from bigdl_tpu.nn.quantized import (QuantizedLinear,
+                                    QuantizedSpatialConvolution,
+                                    QuantizedSpatialDilatedConvolution,
+                                    Quantizer)

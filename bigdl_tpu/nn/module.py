@@ -181,6 +181,12 @@ class Module:
 
     __call__ = forward
 
+    def quantize(self) -> "Module":
+        """Post-training int8 quantization of supported layers (reference
+        `AbstractModule.quantize` -> nn/quantized/Quantizer.scala)."""
+        from bigdl_tpu.nn.quantized import Quantizer
+        return Quantizer.quantize(self)
+
     def training(self):
         self.training_mode = True
         return self

@@ -1,0 +1,217 @@
+"""Int8 post-training quantized inference layers.
+
+Parity: `DL/nn/quantized/` (Linear.scala, SpatialConvolution.scala,
+SpatialDilatedConvolution.scala, Quantizer.scala) over the BigQuant native
+kernels — int8 weights with local (per-output-channel) max-abs scales and
+dynamic per-sample activation quantization, the scheme the whitepaper
+credits for 2x speed / 4x size at <0.1% accuracy drop
+(docs/docs/whitepaper.md:192-196).
+
+TPU-first: int8 x int8 -> int32 runs natively on the MXU via
+`dot_general/conv_general_dilated(preferred_element_type=int32)`; the
+dequantize rescale fuses into the surrounding elementwise ops under XLA, so
+there is no hand-written MixPrecisionGEMM — the structure of
+`DL/nn/quantized/Linear.scala:79-92` falls out of the compiler.
+
+Inference-only, like the reference (Operation-style: no backward).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from bigdl_tpu.nn.module import ApplyContext, Module
+
+
+def _quantize_weight(w: jnp.ndarray, channel_axis: int):
+    """Symmetric per-output-channel int8 (Desc.scala:125-170 local scheme)."""
+    axes = tuple(d for d in range(w.ndim) if d != channel_axis)
+    amax = jnp.max(jnp.abs(w), axis=axes, keepdims=True)
+    scale = jnp.maximum(amax, 1e-8) / 127.0
+    q = jnp.clip(jnp.round(w / scale), -127, 127).astype(jnp.int8)
+    return q, scale.astype(jnp.float32)
+
+
+def _quantize_activation(x: jnp.ndarray, axes):
+    """Dynamic symmetric int8 over `axes` (per-sample), returns (q, scale)."""
+    amax = jnp.max(jnp.abs(x), axis=axes, keepdims=True)
+    scale = jnp.maximum(amax, 1e-8) / 127.0
+    q = jnp.clip(jnp.round(x / scale), -127, 127).astype(jnp.int8)
+    return q, scale
+
+
+class QuantizedLinear(Module):
+    """Int8 Linear (DL/nn/quantized/Linear.scala). Params: int8 `weight`
+    [in, out], f32 `scale` [1, out], optional f32 `bias`."""
+
+    def __init__(self, input_size: int, output_size: int,
+                 with_bias: bool = True, name: Optional[str] = None):
+        super().__init__(name)
+        self.input_size, self.output_size = input_size, output_size
+        self.with_bias = with_bias
+
+    @classmethod
+    def from_float(cls, module, params) -> "QuantizedLinear":
+        q = cls(module.input_size, module.output_size, module.with_bias,
+                name=f"Quantized{module.name}")
+        w = jnp.asarray(params["weight"])          # [in, out]
+        wq, scale = _quantize_weight(w, channel_axis=1)
+        p = {"weight": wq, "scale": scale}
+        if module.with_bias:
+            p["bias"] = jnp.asarray(params["bias"])
+        q.set_params(p)
+        q._state = {}
+        q.evaluate()
+        return q
+
+    def init(self, rng):
+        # fresh init is meaningless for a PTQ layer; zeros keep shapes right
+        p = {"weight": jnp.zeros((self.input_size, self.output_size), jnp.int8),
+             "scale": jnp.ones((1, self.output_size), jnp.float32)}
+        if self.with_bias:
+            p["bias"] = jnp.zeros((self.output_size,), jnp.float32)
+        return p
+
+    def apply(self, params, input, ctx: ApplyContext):
+        x = input
+        flat = x.reshape(-1, x.shape[-1])
+        xq, xs = _quantize_activation(flat, axes=(1,))
+        acc = jax.lax.dot_general(
+            xq, params["weight"], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.int32)
+        out = acc.astype(jnp.float32) * xs * params["scale"]
+        if self.with_bias:
+            out = out + params["bias"]
+        return out.reshape(x.shape[:-1] + (self.output_size,))
+
+
+class QuantizedSpatialConvolution(Module):
+    """Int8 NHWC conv (DL/nn/quantized/SpatialConvolution.scala). Params:
+    int8 `weight` HWIO, f32 `scale` [1,1,1,out], optional f32 `bias`."""
+
+    def __init__(self, n_input_plane: int, n_output_plane: int,
+                 kernel_w: int, kernel_h: int, stride_w: int = 1,
+                 stride_h: int = 1, pad_w=0, pad_h=0, n_group: int = 1,
+                 with_bias: bool = True, dilation_w: int = 1,
+                 dilation_h: int = 1, name: Optional[str] = None):
+        super().__init__(name)
+        self.n_in, self.n_out = n_input_plane, n_output_plane
+        self.kw, self.kh = kernel_w, kernel_h
+        self.sw, self.sh = stride_w, stride_h
+        self.pad_w, self.pad_h = pad_w, pad_h
+        self.groups = n_group
+        self.with_bias = with_bias
+        self.dw, self.dh = dilation_w, dilation_h
+
+    @classmethod
+    def from_float(cls, module, params, dilation_w: int = 1,
+                   dilation_h: int = 1) -> "QuantizedSpatialConvolution":
+        q = cls(module.n_in, module.n_out, module.kw, module.kh, module.sw,
+                module.sh, module.pad_w, module.pad_h, module.groups,
+                module.with_bias,
+                dilation_w=getattr(module, "dil_w", dilation_w),
+                dilation_h=getattr(module, "dil_h", dilation_h),
+                name=f"Quantized{module.name}")
+        w = jnp.asarray(params["weight"])          # HWIO
+        wq, scale = _quantize_weight(w, channel_axis=3)
+        p = {"weight": wq, "scale": scale}
+        if module.with_bias:
+            p["bias"] = jnp.asarray(params["bias"])
+        q.set_params(p)
+        q._state = {}
+        q.evaluate()
+        return q
+
+    def init(self, rng):
+        p = {"weight": jnp.zeros(
+                (self.kh, self.kw, self.n_in // self.groups, self.n_out),
+                jnp.int8),
+             "scale": jnp.ones((1, 1, 1, self.n_out), jnp.float32)}
+        if self.with_bias:
+            p["bias"] = jnp.zeros((self.n_out,), jnp.float32)
+        return p
+
+    def _padding(self):
+        if isinstance(self.pad_w, str):
+            return self.pad_w  # 'SAME'/'VALID'
+        if self.pad_w == -1 or self.pad_h == -1:
+            return "SAME"
+        return [(self.pad_h, self.pad_h), (self.pad_w, self.pad_w)]
+
+    def apply(self, params, input, ctx: ApplyContext):
+        x = input
+        # per-sample (per-image) dynamic activation scale over H,W,C
+        xq, xs = _quantize_activation(x, axes=(1, 2, 3))
+        acc = jax.lax.conv_general_dilated(
+            xq, params["weight"], (self.sh, self.sw), self._padding(),
+            rhs_dilation=(self.dh, self.dw),
+            feature_group_count=self.groups,
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+            preferred_element_type=jnp.int32)
+        out = acc.astype(jnp.float32) * xs * params["scale"]
+        if self.with_bias:
+            out = out + params["bias"]
+        return out
+
+
+class QuantizedSpatialDilatedConvolution(QuantizedSpatialConvolution):
+    """Alias family parity (DL/nn/quantized/SpatialDilatedConvolution.scala);
+    dilation is already a first-class arg on the base class."""
+
+
+class Quantizer:
+    """Walk a trained model and swap supported layers for int8 versions
+    (reference Quantizer.scala, user surface `module.quantize()`)."""
+
+    QUANTIZABLE = ("Linear", "SpatialConvolution", "SpatialDilatedConvolution")
+
+    @staticmethod
+    def quantize(module: Module) -> Module:
+        from bigdl_tpu.nn.containers import Container
+        params = module.ensure_params()
+        q = Quantizer._convert(module, params)
+        if q is not None:
+            return q
+        if isinstance(module, Container):
+            Quantizer._walk(module, params)
+            module.set_params(params)
+        return module
+
+    @staticmethod
+    def _convert(module: Module, params) -> Optional[Module]:
+        from bigdl_tpu.nn.linear import Linear
+        from bigdl_tpu.nn.conv import (SpatialConvolution,
+                                       SpatialDilatedConvolution)
+        if type(module) is Linear:
+            return QuantizedLinear.from_float(module, params)
+        if type(module) is SpatialConvolution:
+            return QuantizedSpatialConvolution.from_float(module, params)
+        if type(module) is SpatialDilatedConvolution:
+            return QuantizedSpatialConvolution.from_float(module, params)
+        return None
+
+    @staticmethod
+    def _walk(container, params):
+        from bigdl_tpu.nn.containers import Container, Graph
+        for i, (key, child) in enumerate(
+                zip(list(container._child_keys), container.children)):
+            q = Quantizer._convert(child, params.get(key, {}))
+            if q is not None:
+                container.children[i] = q
+                if isinstance(container, Graph):
+                    # graph keys are serialized explicitly; keep them stable
+                    container.exec_order[i].module = q
+                    params[key] = q.parameters()
+                else:
+                    # add()-style keys embed the module name; rename so a
+                    # deserialized container rebuilds the same pytree keys
+                    new_key = f"{i}_{q.name}"
+                    container._child_keys[i] = new_key
+                    params.pop(key, None)
+                    params[new_key] = q.parameters()
+            elif isinstance(child, Container):
+                Quantizer._walk(child, params.get(key, {}))
