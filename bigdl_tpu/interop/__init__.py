@@ -1,0 +1,22 @@
+"""Model & data interop: Caffe, TensorFlow, Torch .t7, Keras.
+
+Parity: the reference's L7 interop layer (SURVEY.md C27-C29, C34):
+CaffeLoader/CaffePersister, TensorflowLoader/TensorflowSaver + TFRecord IO,
+TorchFile, and the python Keras 1.2.2 converter. Coverage is gated by the
+baseline configs (SURVEY.md §7 hard-part (e)): the op/layer subsets cover
+the zoo model families, with clear errors for unsupported ops.
+"""
+
+from bigdl_tpu.interop.tfrecord import (TFRecordDataset, bytes_feature,
+                                        float_feature, int64_feature,
+                                        make_example, parse_example,
+                                        write_tfrecord)
+from bigdl_tpu.interop.caffe import CaffeLoader, CaffePersister
+from bigdl_tpu.interop.tensorflow import TensorflowLoader, TensorflowSaver
+from bigdl_tpu.interop.torch_file import TorchFile
+from bigdl_tpu.interop.keras_converter import load_keras
+
+__all__ = ["TFRecordDataset", "make_example", "parse_example",
+           "bytes_feature", "float_feature", "int64_feature",
+           "write_tfrecord", "CaffeLoader", "CaffePersister",
+           "TensorflowLoader", "TensorflowSaver", "TorchFile", "load_keras"]

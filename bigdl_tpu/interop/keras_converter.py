@@ -1,0 +1,228 @@
+"""Keras 1.2.2 model import.
+
+Parity: the reference's python Keras converter (PY/keras/converter.py —
+`DefinitionLoader` for json, `WeightLoader` for hdf5; user surface
+`Model.load_keras(json_path, hdf5_path)`, PY/nn/layer.py:783). Builds
+models on this framework's Keras-style API (bigdl_tpu.keras), then loads
+weights from the Keras hdf5 checkpoint via h5py.
+
+Supports the tf dim-ordering; Theano-ordered models raise with a clear
+message (the reference converts both, but th-ordering is legacy even for
+the reference's era).
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, List, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+import bigdl_tpu.keras as K
+
+
+def load_keras(json_path: Optional[str] = None,
+               hdf5_path: Optional[str] = None):
+    """Build a model from Keras 1.2.2 json (and optional hdf5 weights).
+    If only hdf5 is given, the model config is read from its attrs."""
+    if json_path is not None:
+        with open(json_path) as f:
+            config = json.load(f)
+    elif hdf5_path is not None:
+        import h5py
+        with h5py.File(hdf5_path, "r") as f:
+            config = json.loads(f.attrs["model_config"])
+    else:
+        raise ValueError("need json_path or hdf5_path")
+    model = DefinitionLoader.from_config(config)
+    if hdf5_path is not None:
+        WeightLoader.load_weights(model, hdf5_path)
+    return model
+
+
+class DefinitionLoader:
+    @staticmethod
+    def from_config(config: Dict[str, Any]):
+        cls = config["class_name"]
+        if cls == "Sequential":
+            model = K.Sequential()
+            layer_list = config["config"]
+            if isinstance(layer_list, dict):  # keras2-style nesting
+                layer_list = layer_list.get("layers", [])
+            for lc in layer_list:
+                layer = DefinitionLoader._layer(lc)
+                if layer is not None:
+                    model.add(layer)
+            return model
+        raise ValueError(
+            f"unsupported Keras model class {cls}; Sequential json is "
+            "supported (functional-API graphs: build with bigdl_tpu.keras "
+            "directly)")
+
+    @staticmethod
+    def _layer(lc: Dict[str, Any]):
+        cls = lc["class_name"]
+        cfg = dict(lc.get("config", {}))
+        name = cfg.get("name")
+        if cfg.get("dim_ordering") == "th":
+            raise ValueError(
+                "Theano dim_ordering models are unsupported; re-save with "
+                "tf ordering")
+        in_shape = cfg.get("batch_input_shape")
+        input_shape = tuple(in_shape[1:]) if in_shape else None
+        act = cfg.get("activation")
+
+        def with_act(layer):
+            return layer
+
+        if cls == "Dense":
+            return K.Dense(cfg["output_dim"], activation=_act(act),
+                           bias=cfg.get("bias", True),
+                           input_shape=input_shape, name=name)
+        if cls == "Activation":
+            return K.Activation(cfg["activation"], name=name)
+        if cls == "Dropout":
+            return K.Dropout(cfg.get("p", 0.5), name=name)
+        if cls == "Flatten":
+            return K.Flatten(input_shape=input_shape, name=name)
+        if cls == "Reshape":
+            return K.Reshape(tuple(cfg["target_shape"]),
+                             input_shape=input_shape, name=name)
+        if cls == "Convolution2D":
+            return K.Convolution2D(
+                cfg["nb_filter"], cfg["nb_row"], cfg["nb_col"],
+                activation=_act(act),
+                border_mode=cfg.get("border_mode", "valid"),
+                subsample=tuple(cfg.get("subsample", (1, 1))),
+                bias=cfg.get("bias", True),
+                input_shape=input_shape, name=name)
+        if cls == "MaxPooling2D":
+            return K.MaxPooling2D(
+                pool_size=tuple(cfg.get("pool_size", (2, 2))),
+                strides=tuple(cfg["strides"]) if cfg.get("strides") else None,
+                border_mode=cfg.get("border_mode", "valid"), name=name)
+        if cls == "AveragePooling2D":
+            return K.AveragePooling2D(
+                pool_size=tuple(cfg.get("pool_size", (2, 2))),
+                strides=tuple(cfg["strides"]) if cfg.get("strides") else None,
+                border_mode=cfg.get("border_mode", "valid"), name=name)
+        if cls == "Embedding":
+            return K.Embedding(cfg["input_dim"], cfg["output_dim"],
+                               input_length=cfg.get("input_length"),
+                               input_shape=input_shape, name=name)
+        if cls == "LSTM":
+            return K.LSTM(cfg["output_dim"],
+                          return_sequences=cfg.get("return_sequences", False),
+                          input_shape=input_shape, name=name)
+        if cls == "GRU":
+            return K.GRU(cfg["output_dim"],
+                         return_sequences=cfg.get("return_sequences", False),
+                         input_shape=input_shape, name=name)
+        if cls == "SimpleRNN":
+            return K.SimpleRNN(
+                cfg["output_dim"],
+                return_sequences=cfg.get("return_sequences", False),
+                input_shape=input_shape, name=name)
+        if cls == "BatchNormalization":
+            return K.BatchNormalization(epsilon=cfg.get("epsilon", 1e-3),
+                                        momentum=cfg.get("momentum", 0.99),
+                                        input_shape=input_shape, name=name)
+        raise ValueError(f"unsupported Keras layer {cls} "
+                         "(PY/keras/converter.py parity subset)")
+
+
+def _act(name: Optional[str]):
+    if name in (None, "linear"):
+        return None
+    return name
+
+
+class WeightLoader:
+    """Load Keras 1.x hdf5 weights into the built model, matching layers by
+    order (the converter's layer list mirrors the json order)."""
+
+    @staticmethod
+    def load_weights(model, hdf5_path: str):
+        import h5py
+        with h5py.File(hdf5_path, "r") as f:
+            g = f["model_weights"] if "model_weights" in f else f
+            layer_names = [n.decode() if isinstance(n, bytes) else n
+                           for n in g.attrs.get("layer_names", [])]
+            weights: Dict[str, List[np.ndarray]] = {}
+            for lname in layer_names:
+                lg = g[lname]
+                wnames = [n.decode() if isinstance(n, bytes) else n
+                          for n in lg.attrs.get("weight_names", [])]
+                if wnames:
+                    weights[lname] = [np.asarray(lg[w]) for w in wnames]
+        WeightLoader._apply(model, weights)
+
+    @staticmethod
+    def _apply(model, weights: Dict[str, List[np.ndarray]]):
+        params = model.ensure_params()
+        # keras Sequential wraps an inner nn.Sequential (`_seq`) whose
+        # children are the KerasLayer wrappers themselves
+        inner = getattr(model, "_seq", model)
+        for key, layer in zip(inner._child_keys, inner.children):
+            w = weights.get(layer.name)
+            if not w:
+                continue
+            params[key] = WeightLoader._map_layer(layer, params.get(key, {}),
+                                                  w)
+            if type(layer).__name__ == "BatchNormalization" and len(w) >= 4:
+                # running mean/std live in the state pytree, keyed by the
+                # module path that starts with this child's key
+                for spath in list(model._state):
+                    if spath and spath[0] == key:
+                        model._state[spath] = {
+                            "mean": jnp.asarray(w[2].reshape(-1)),
+                            "var": jnp.asarray(w[3].reshape(-1))}
+        model.set_params(params)
+
+    @staticmethod
+    def _map_layer(layer, p, w: List[np.ndarray]):
+        """Keras-order weight arrays -> this framework's param dict (named
+        leaves replaced in place; keras 1.x orders [W, b] / BN
+        [gamma, beta, mean, std])."""
+        cls = type(layer).__name__
+        if cls in ("Dense", "Convolution2D", "Convolution1D"):
+            p = _set_named(p, "weight", w[0])
+            if len(w) > 1:
+                p = _set_named(p, "bias", w[1].reshape(-1))
+            return p
+        if cls == "Embedding":
+            return _set_named(p, "weight", w[0])
+        if cls == "BatchNormalization":
+            p = _set_named(p, "weight", w[0].reshape(-1))
+            p = _set_named(p, "bias", w[1].reshape(-1))
+            return p
+        raise ValueError(
+            f"Keras weight import not implemented for {cls} "
+            f"(shapes {[a.shape for a in w]})")
+
+
+def _set_named(tree, leaf_name: str, value):
+    """Replace every leaf called `leaf_name` (any depth) with `value`."""
+    found = [0]
+
+    def rec(node):
+        if isinstance(node, dict):
+            out = {}
+            for k, v in node.items():
+                if k == leaf_name and not isinstance(v, dict):
+                    if tuple(v.shape) != tuple(np.asarray(value).shape):
+                        raise ValueError(
+                            f"shape mismatch for {leaf_name}: model "
+                            f"{v.shape} vs keras {np.asarray(value).shape}")
+                    out[k] = jnp.asarray(value)
+                    found[0] += 1
+                else:
+                    out[k] = rec(v)
+            return out
+        return node
+
+    new = rec(tree)
+    if not found[0]:
+        raise ValueError(f"no leaf named {leaf_name} in layer params")
+    return new

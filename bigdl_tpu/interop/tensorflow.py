@@ -1,0 +1,379 @@
+"""TensorFlow frozen-GraphDef import/export.
+
+Parity: `TensorflowLoader.load` (DL/utils/tf/TensorflowLoader.scala:55) and
+`TensorflowSaver`/`BigDLToTensorflow` (SURVEY.md C28). Like the reference,
+import PATTERN-MATCHES fused layers out of primitive TF ops
+(TensorflowToBigDL.scala): Const weights fold into layer parameters, so
+`MatMul(+BiasAdd)` becomes `Linear`, `Conv2D(+BiasAdd)` becomes
+`SpatialConvolution`, `FusedBatchNorm` becomes `SpatialBatchNormalization` —
+the imported model is a regular layer graph that can be trained, quantized,
+and re-serialized. Op coverage is gated by the baseline model families
+(SURVEY.md §7 hard-part (e)), with a clear error naming unsupported ops.
+
+Layouts: TF NHWC / HWIO match this framework natively — no transposes.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+import bigdl_tpu.nn as nn
+from bigdl_tpu.nn.module import Module, Node
+from bigdl_tpu.proto import tf_graph_pb2 as pb
+
+_DTYPES = {
+    pb.DT_FLOAT: np.float32, pb.DT_DOUBLE: np.float64,
+    pb.DT_INT32: np.int32, pb.DT_INT64: np.int64,
+    pb.DT_UINT8: np.uint8, pb.DT_INT16: np.int16, pb.DT_INT8: np.int8,
+    pb.DT_BOOL: np.bool_,
+}
+
+
+def tensor_to_ndarray(tp: pb.TensorProto) -> np.ndarray:
+    dtype = _DTYPES.get(tp.dtype)
+    if dtype is None:
+        raise ValueError(f"unsupported TF dtype {tp.dtype}")
+    shape = tuple(d.size for d in tp.tensor_shape.dim)
+    if tp.tensor_content:
+        return np.frombuffer(tp.tensor_content, dtype).reshape(shape).copy()
+    for field in ("float_val", "double_val", "int_val", "int64_val",
+                  "bool_val"):
+        vals = getattr(tp, field)
+        if len(vals):
+            arr = np.asarray(vals, dtype)
+            if arr.size == 1 and int(np.prod(shape)) > 1:
+                arr = np.full(shape, arr[0], dtype)  # splat encoding
+            return arr.reshape(shape)
+    return np.zeros(shape, dtype)
+
+
+def ndarray_to_tensor(arr: np.ndarray) -> pb.TensorProto:
+    tp = pb.TensorProto()
+    rev = {v: k for k, v in _DTYPES.items()}
+    tp.dtype = rev[arr.dtype.type]
+    for s in arr.shape:
+        tp.tensor_shape.dim.add(size=int(s))
+    tp.tensor_content = np.ascontiguousarray(arr).tobytes()
+    return tp
+
+
+def _clean(name: str) -> str:
+    name = name.lstrip("^")
+    return name.split(":")[0]
+
+
+class TensorflowLoader:
+    """load(pb_path, inputs, outputs) -> Graph over standard layers."""
+
+    @staticmethod
+    def load(path: str, inputs: Sequence[str], outputs: Sequence[str]):
+        gd = pb.GraphDef.FromString(open(path, "rb").read())
+        return TensorflowLoader.from_graph_def(gd, inputs, outputs)
+
+    @staticmethod
+    def from_graph_def(gd: pb.GraphDef, inputs: Sequence[str],
+                       outputs: Sequence[str]):
+        nodes: Dict[str, pb.NodeDef] = {n.name: n for n in gd.node}
+        consts: Dict[str, np.ndarray] = {}
+        for n in gd.node:
+            if n.op == "Const":
+                consts[n.name] = tensor_to_ndarray(n.attr["value"].tensor)
+        # Identity-of-const folding (frozen graphs wrap weights in Identity)
+        changed = True
+        while changed:
+            changed = False
+            for n in gd.node:
+                if (n.op == "Identity" and n.name not in consts
+                        and n.input and _clean(n.input[0]) in consts):
+                    consts[n.name] = consts[_clean(n.input[0])]
+                    changed = True
+
+        built: Dict[str, Node] = {}
+        input_nodes: List[Node] = []
+
+        def data_inputs(nd: pb.NodeDef) -> List[str]:
+            return [_clean(i) for i in nd.input if not i.startswith("^")]
+
+        def build(name: str) -> Node:
+            if name in built:
+                return built[name]
+            nd = nodes[name]
+            if name in [_clean(i) for i in inputs] or nd.op == "Placeholder":
+                node = nn.InputNode(name=name)
+                input_nodes.append(node)
+                built[name] = node
+                return node
+            module, arg_names = TensorflowLoader._convert(nd, consts,
+                                                          data_inputs(nd))
+            prev = [build(a) for a in arg_names]
+            node = module.inputs(*prev) if prev else module.inputs()
+            built[name] = node
+            return node
+
+        out_nodes = [build(_clean(o)) for o in outputs]
+        # inputs may include names never reached (pruned); keep request order
+        ordered_inputs = [built[_clean(i)] for i in inputs
+                          if _clean(i) in built]
+        graph = nn.Graph(ordered_inputs or input_nodes, out_nodes)
+        graph.evaluate()
+        return graph
+
+    # ---------------------------------------------------------- op loaders
+    @staticmethod
+    def _convert(nd: pb.NodeDef, consts: Dict[str, np.ndarray],
+                 args: List[str]) -> Tuple[Module, List[str]]:
+        """Return (module, dynamic-input names); const args fold into the
+        module (161-loader registry parity: DL/utils/tf/loaders/)."""
+        op = nd.op
+        a = nd.attr
+
+        def const_arg(i):
+            if args[i] not in consts:
+                raise ValueError(
+                    f"op {op} ({nd.name}) needs a Const input #{i}")
+            return consts[args[i]]
+
+        if op in ("Identity", "CheckNumerics", "StopGradient"):
+            return nn.Identity(name=nd.name), args[:1]
+        if op == "Conv2D":
+            w = const_arg(1)  # HWIO
+            strides = list(a["strides"].list.i) or [1, 1, 1, 1]
+            padding = a["padding"].s.decode()
+            pad = -1 if padding == "SAME" else 0
+            m = nn.SpatialConvolution(
+                int(w.shape[2]), int(w.shape[3]), int(w.shape[1]),
+                int(w.shape[0]), int(strides[2]), int(strides[1]),
+                pad, pad, with_bias=False, name=nd.name)
+            m.set_params({"weight": jnp.asarray(w)})
+            return m, args[:1]
+        if op == "DepthwiseConv2dNative":
+            w = const_arg(1)  # [H, W, in, mult]
+            strides = list(a["strides"].list.i) or [1, 1, 1, 1]
+            padding = a["padding"].s.decode()
+            pad = -1 if padding == "SAME" else 0
+            cin, mult = int(w.shape[2]), int(w.shape[3])
+            m = nn.SpatialConvolution(
+                cin, cin * mult, int(w.shape[1]), int(w.shape[0]),
+                int(strides[2]), int(strides[1]), pad, pad, n_group=cin,
+                with_bias=False, name=nd.name)
+            m.set_params({"weight": jnp.asarray(
+                w.reshape(w.shape[0], w.shape[1], 1, cin * mult))})
+            return m, args[:1]
+        if op == "MatMul":
+            w = const_arg(1)
+            if a["transpose_b"].b:
+                w = w.T
+            m = nn.Linear(int(w.shape[0]), int(w.shape[1]), with_bias=False,
+                          name=nd.name)
+            m.set_params({"weight": jnp.asarray(w)})
+            return m, args[:1]
+        if op == "BiasAdd" or (op in ("Add", "AddV2")
+                               and args[1] in consts
+                               and consts[args[1]].ndim <= 1):
+            b = const_arg(1).reshape(-1)
+            m = nn.CAdd(size=(len(b),), name=nd.name)
+            m.set_params({"bias": jnp.asarray(b)})
+            return m, args[:1]
+        if op in ("Add", "AddV2"):
+            return nn.CAddTable(name=nd.name), args
+        if op == "Sub":
+            return nn.CSubTable(name=nd.name), args
+        if op == "Mul":
+            if args[1] in consts and consts[args[1]].size == 1:
+                return nn.MulConstant(float(consts[args[1]]),
+                                      name=nd.name), args[:1]
+            return nn.CMulTable(name=nd.name), args
+        if op in ("RealDiv", "Div"):
+            return nn.CDivTable(name=nd.name), args
+        if op == "Maximum":
+            return nn.CMaxTable(name=nd.name), args
+        if op == "Minimum":
+            return nn.CMinTable(name=nd.name), args
+        if op == "Relu":
+            return nn.ReLU(name=nd.name), args
+        if op == "Relu6":
+            return nn.ReLU6(name=nd.name), args
+        if op == "Sigmoid":
+            return nn.Sigmoid(name=nd.name), args
+        if op == "Tanh":
+            return nn.Tanh(name=nd.name), args
+        if op == "Softplus":
+            return nn.SoftPlus(name=nd.name), args
+        if op == "Softsign":
+            return nn.SoftSign(name=nd.name), args
+        if op == "Elu":
+            return nn.ELU(name=nd.name), args
+        if op == "Softmax":
+            return nn.SoftMax(name=nd.name), args
+        if op == "LogSoftmax":
+            return nn.LogSoftMax(name=nd.name), args
+        if op in ("MaxPool", "AvgPool"):
+            ksize = list(a["ksize"].list.i)
+            strides = list(a["strides"].list.i)
+            padding = a["padding"].s.decode()
+            pad = -1 if padding == "SAME" else 0
+            cls = nn.SpatialMaxPooling if op == "MaxPool" else \
+                nn.SpatialAveragePooling
+            return cls(int(ksize[2]), int(ksize[1]), int(strides[2]),
+                       int(strides[1]), pad, pad, name=nd.name), args
+        if op == "FusedBatchNorm" or op == "FusedBatchNormV2":
+            scale, offset = const_arg(1), const_arg(2)
+            mean, var = const_arg(3), const_arg(4)
+            eps = a["epsilon"].f or 1e-3
+            m = nn.SpatialBatchNormalization(len(scale), eps=float(eps),
+                                             name=nd.name)
+            m.set_params({"weight": jnp.asarray(scale),
+                          "bias": jnp.asarray(offset)})
+            m._state = {(): {"mean": jnp.asarray(mean),
+                             "var": jnp.asarray(var)}}
+            m.evaluate()
+            return m, args[:1]
+        if op == "Reshape":
+            shape = const_arg(1).reshape(-1).tolist()
+            return nn.InferReshape([int(s) for s in shape],
+                                   name=nd.name), args[:1]
+        if op == "Squeeze":
+            dims = list(a["squeeze_dims"].list.i)
+            return nn.Squeeze(tuple(int(d) for d in dims) or None,
+                              name=nd.name), args
+        if op == "ExpandDims":
+            dim = int(const_arg(1))
+            return nn.Unsqueeze(dim, name=nd.name), args[:1]
+        if op == "Mean":
+            axes = const_arg(1).reshape(-1).tolist()
+            keep = a["keep_dims"].b
+            return nn.Mean(dimension=tuple(int(x) for x in axes),
+                           squeeze=not keep, name=nd.name), args[:1]
+        if op == "ConcatV2":
+            axis = int(const_arg(len(args) - 1))
+            return nn.JoinTable(axis, name=nd.name), args[:-1]
+        if op == "Pad":
+            paddings = const_arg(1)
+            return _TFPad(paddings.tolist(), name=nd.name), args[:1]
+        if op == "Transpose":
+            perm = const_arg(1).reshape(-1).tolist()
+            return _TFPermute([int(p) for p in perm], name=nd.name), args[:1]
+        raise ValueError(
+            f"unsupported TF op '{op}' (node {nd.name}); extend "
+            "TensorflowLoader._convert (op-loader registry parity: "
+            "DL/utils/tf/loaders/)")
+
+
+class _TFPad(Module):
+    """Zero padding with a TF paddings table (loader-internal)."""
+
+    def __init__(self, paddings, name=None):
+        super().__init__(name)
+        self.paddings = [tuple(int(x) for x in p) for p in paddings]
+
+    def apply(self, params, input, ctx):
+        return jnp.pad(input, self.paddings)
+
+
+class _TFPermute(Module):
+    def __init__(self, perm, name=None):
+        super().__init__(name)
+        self.perm = tuple(perm)
+
+    def apply(self, params, input, ctx):
+        return jnp.transpose(input, self.perm)
+
+
+class TensorflowSaver:
+    """Export a Sequential/Graph of supported layers to a frozen GraphDef
+    (reference TensorflowSaver.scala / BigDLToTensorflow.scala)."""
+
+    @staticmethod
+    def save(model: Module, path: str, input_name: str = "input"):
+        gd = TensorflowSaver.to_graph_def(model, input_name)
+        with open(path, "wb") as f:
+            f.write(gd.SerializeToString())
+
+    @staticmethod
+    def to_graph_def(model: Module, input_name: str = "input") -> pb.GraphDef:
+        from bigdl_tpu.nn.containers import Sequential
+        gd = pb.GraphDef()
+        ph = gd.node.add(name=input_name, op="Placeholder")
+        ph.attr["dtype"].type = pb.DT_FLOAT
+        modules: List[Tuple[Module, dict]] = []
+
+        def collect(m, params):
+            if isinstance(m, Sequential):
+                for key, c in zip(m._child_keys, m.children):
+                    collect(c, params.get(key, {}))
+            else:
+                modules.append((m, params))
+
+        collect(model, model.ensure_params())
+        prev = input_name
+        for i, (m, mp) in enumerate(modules):
+            prev = TensorflowSaver._emit(gd, m, mp, prev,
+                                         f"layer{i}_{m.name}")
+        return gd
+
+    @staticmethod
+    def _const(gd, name, arr: np.ndarray) -> str:
+        n = gd.node.add(name=name, op="Const")
+        n.attr["dtype"].type = pb.DT_FLOAT if arr.dtype == np.float32 \
+            else pb.DT_INT32
+        n.attr["value"].tensor.CopyFrom(ndarray_to_tensor(arr))
+        return name
+
+    @staticmethod
+    def _emit(gd: pb.GraphDef, m: Module, mp: dict, prev: str,
+              base: str) -> str:
+        p = {k: np.asarray(v) for k, v in (mp or {}).items()
+             if not isinstance(v, dict)}
+        if isinstance(m, nn.Linear):
+            w = TensorflowSaver._const(gd, base + "/w", p["weight"])
+            node = gd.node.add(name=base, op="MatMul", input=[prev, w])
+            node.attr["transpose_b"].b = False
+            out = base
+            if m.with_bias:
+                b = TensorflowSaver._const(gd, base + "/b", p["bias"])
+                gd.node.add(name=base + "/bias", op="BiasAdd",
+                            input=[out, b])
+                out = base + "/bias"
+            return out
+        if isinstance(m, nn.SpatialConvolution):
+            w = TensorflowSaver._const(gd, base + "/w", p["weight"])
+            node = gd.node.add(name=base, op="Conv2D", input=[prev, w])
+            node.attr["strides"].list.i.extend([1, m.sh, m.sw, 1])
+            node.attr["padding"].s = (
+                b"SAME" if m.pad_h in ("SAME", -1) else b"VALID")
+            out = base
+            if m.with_bias:
+                b = TensorflowSaver._const(gd, base + "/b", p["bias"])
+                gd.node.add(name=base + "/bias", op="BiasAdd",
+                            input=[out, b])
+                out = base + "/bias"
+            return out
+        if isinstance(m, (nn.SpatialMaxPooling, nn.SpatialAveragePooling)):
+            op = "MaxPool" if isinstance(m, nn.SpatialMaxPooling) \
+                else "AvgPool"
+            node = gd.node.add(name=base, op=op, input=[prev])
+            node.attr["ksize"].list.i.extend([1, m.kh, m.kw, 1])
+            node.attr["strides"].list.i.extend([1, m.dh, m.dw, 1])
+            node.attr["padding"].s = (
+                b"SAME" if m.pad_h in ("SAME", -1) else b"VALID")
+            return base
+        simple = {nn.ReLU: "Relu", nn.Sigmoid: "Sigmoid", nn.Tanh: "Tanh",
+                  nn.SoftMax: "Softmax", nn.LogSoftMax: "LogSoftmax",
+                  nn.ReLU6: "Relu6", nn.Identity: "Identity"}
+        for cls, op in simple.items():
+            if type(m) is cls:
+                gd.node.add(name=base, op=op, input=[prev])
+                return base
+        if isinstance(m, (nn.Reshape, nn.InferReshape)):
+            size = list(getattr(m, "size", ()))
+            shape = TensorflowSaver._const(
+                gd, base + "/shape", np.asarray([-1] + size, np.int32))
+            gd.node.add(name=base, op="Reshape", input=[prev, shape])
+            return base
+        if isinstance(m, nn.Dropout):
+            return prev  # inference graph: dropout is identity
+        raise ValueError(
+            f"TensorflowSaver: unsupported layer {type(m).__name__}")

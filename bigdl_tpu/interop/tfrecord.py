@@ -1,0 +1,79 @@
+"""tf.Example construction/parsing + TFRecord dataset.
+
+Parity: TFRecord{InputFormat,Iterator,Writer} + ParseExample
+(DL/utils/tf/TFRecordIterator.scala etc., SURVEY.md C28). Reading rides the
+native prefetch reader (native/loader.cc) so record IO overlaps the step
+loop.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterable, Iterator, List, Optional, Sequence, Union
+
+import numpy as np
+
+from bigdl_tpu.native import NativeTFRecordReader
+from bigdl_tpu.proto import tf_example_pb2 as pb
+from bigdl_tpu.visualization.record_writer import TFRecordFileWriter
+
+
+def bytes_feature(values: Union[bytes, Sequence[bytes]]) -> pb.Feature:
+    if isinstance(values, bytes):
+        values = [values]
+    return pb.Feature(bytes_list=pb.BytesList(value=list(values)))
+
+
+def float_feature(values) -> pb.Feature:
+    arr = np.asarray(values, np.float32).reshape(-1)
+    return pb.Feature(float_list=pb.FloatList(value=arr.tolist()))
+
+
+def int64_feature(values) -> pb.Feature:
+    arr = np.asarray(values, np.int64).reshape(-1)
+    return pb.Feature(int64_list=pb.Int64List(value=arr.tolist()))
+
+
+def make_example(features: Dict[str, pb.Feature]) -> pb.Example:
+    ex = pb.Example()
+    for k, v in features.items():
+        ex.features.feature[k].CopyFrom(v)
+    return ex
+
+
+def parse_example(record: bytes) -> Dict[str, np.ndarray]:
+    """Decode a serialized Example into {name: ndarray|list[bytes]}
+    (reference ParseExample op, DL/utils/tf/loaders)."""
+    ex = pb.Example.FromString(record)
+    out: Dict[str, np.ndarray] = {}
+    for name, feat in ex.features.feature.items():
+        kind = feat.WhichOneof("kind")
+        if kind == "bytes_list":
+            out[name] = list(feat.bytes_list.value)
+        elif kind == "float_list":
+            out[name] = np.asarray(feat.float_list.value, np.float32)
+        elif kind == "int64_list":
+            out[name] = np.asarray(feat.int64_list.value, np.int64)
+        else:
+            out[name] = np.zeros((0,), np.float32)
+    return out
+
+
+def write_tfrecord(path: str, examples: Iterable[pb.Example]):
+    with TFRecordFileWriter(path) as w:
+        for ex in examples:
+            w.write(ex.SerializeToString())
+
+
+class TFRecordDataset:
+    """Iterate parsed Examples over one or more .tfrecord files."""
+
+    def __init__(self, paths: Union[str, Sequence[str]],
+                 parse: bool = True):
+        self.paths = [paths] if isinstance(paths, str) else list(paths)
+        self.parse = parse
+
+    def __iter__(self) -> Iterator:
+        for p in self.paths:
+            with NativeTFRecordReader(p) as reader:
+                for record in reader:
+                    yield parse_example(record) if self.parse else record

@@ -1,0 +1,220 @@
+"""Torch7 .t7 object file read/write.
+
+Parity: `TorchFile.{load,save}` (DL/utils/TorchFile.scala, SURVEY.md C29) —
+the legacy Lua-Torch binary serialization used by the reference's
+Torch-comparison test harness (TEST/torch/TH.scala) and for exchanging
+tensors with Torch tooling. Implements the binary ("b") mode: typed object
+stream with memoized references.
+
+Supported objects: nil, number, string, boolean, table, torch.{Float,Double,
+Long,Int,Byte}Tensor + matching Storage. Tensors load as numpy arrays,
+tables as dicts (Lua 1-based array tables become Python lists when their
+keys are 1..n).
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Any, BinaryIO, Dict, List, Optional
+
+import numpy as np
+
+TYPE_NIL = 0
+TYPE_NUMBER = 1
+TYPE_STRING = 2
+TYPE_TABLE = 3
+TYPE_TORCH = 4
+TYPE_BOOLEAN = 5
+
+_TENSOR_CLASSES = {
+    "torch.FloatTensor": np.float32,
+    "torch.DoubleTensor": np.float64,
+    "torch.LongTensor": np.int64,
+    "torch.IntTensor": np.int32,
+    "torch.ByteTensor": np.uint8,
+}
+_STORAGE_CLASSES = {
+    "torch.FloatStorage": np.float32,
+    "torch.DoubleStorage": np.float64,
+    "torch.LongStorage": np.int64,
+    "torch.IntStorage": np.int32,
+    "torch.ByteStorage": np.uint8,
+}
+_DTYPE_TO_TENSOR = {np.dtype(v): k for k, v in _TENSOR_CLASSES.items()}
+_DTYPE_TO_STORAGE = {np.dtype(v): k.replace("Tensor", "Storage")
+                     for k, v in _TENSOR_CLASSES.items()}
+
+
+class _Reader:
+    def __init__(self, f: BinaryIO):
+        self.f = f
+        self.memo: Dict[int, Any] = {}
+
+    def i32(self) -> int:
+        return struct.unpack("<i", self.f.read(4))[0]
+
+    def i64(self) -> int:
+        return struct.unpack("<q", self.f.read(8))[0]
+
+    def f64(self) -> float:
+        return struct.unpack("<d", self.f.read(8))[0]
+
+    def string(self) -> str:
+        n = self.i32()
+        return self.f.read(n).decode("utf-8", errors="replace")
+
+    def read_object(self) -> Any:
+        t = self.i32()
+        if t == TYPE_NIL:
+            return None
+        if t == TYPE_NUMBER:
+            v = self.f64()
+            return int(v) if v == int(v) else v
+        if t == TYPE_STRING:
+            return self.string()
+        if t == TYPE_BOOLEAN:
+            return self.i32() == 1
+        if t == TYPE_TABLE:
+            idx = self.i32()
+            if idx in self.memo:
+                return self.memo[idx]
+            out: Dict[Any, Any] = {}
+            self.memo[idx] = out
+            size = self.i32()
+            for _ in range(size):
+                k = self.read_object()
+                v = self.read_object()
+                out[k] = v
+            # Lua array table -> list
+            if out and all(isinstance(k, int) for k in out) and \
+                    sorted(out) == list(range(1, len(out) + 1)):
+                lst = [out[i] for i in range(1, len(out) + 1)]
+                self.memo[idx] = lst
+                return lst
+            return out
+        if t == TYPE_TORCH:
+            idx = self.i32()
+            if idx in self.memo:
+                return self.memo[idx]
+            version = self.string()
+            cls = self.string() if version.startswith("V ") else version
+            obj = self._read_torch(cls)
+            self.memo[idx] = obj
+            return obj
+        raise ValueError(f"unsupported t7 type code {t}")
+
+    def _read_torch(self, cls: str):
+        if cls in _TENSOR_CLASSES:
+            nd = self.i32()
+            size = [self.i64() for _ in range(nd)]
+            stride = [self.i64() for _ in range(nd)]
+            offset = self.i64() - 1  # stored 1-based
+            storage = self.read_object()  # Storage ndarray (flat)
+            if storage is None or nd == 0:
+                return np.zeros(size, _TENSOR_CLASSES[cls])
+            flat = np.asarray(storage)
+            idx = np.full(tuple(size), offset, np.int64)
+            for d, (n, st) in enumerate(zip(size, stride)):
+                shape = [1] * nd
+                shape[d] = n
+                idx = idx + (np.arange(n, dtype=np.int64) * st).reshape(shape)
+            return flat[idx]
+        if cls in _STORAGE_CLASSES:
+            n = self.i64()
+            dtype = np.dtype(_STORAGE_CLASSES[cls])
+            return np.frombuffer(self.f.read(n * dtype.itemsize),
+                                 dtype).copy()
+        raise ValueError(f"unsupported torch class in .t7: {cls}")
+
+
+class _Writer:
+    def __init__(self, f: BinaryIO):
+        self.f = f
+        self.next_index = 1
+
+    def i32(self, v: int):
+        self.f.write(struct.pack("<i", v))
+
+    def i64(self, v: int):
+        self.f.write(struct.pack("<q", v))
+
+    def f64(self, v: float):
+        self.f.write(struct.pack("<d", v))
+
+    def string(self, s: str):
+        b = s.encode("utf-8")
+        self.i32(len(b))
+        self.f.write(b)
+
+    def write_object(self, obj: Any):
+        if obj is None:
+            self.i32(TYPE_NIL)
+        elif isinstance(obj, bool):
+            self.i32(TYPE_BOOLEAN)
+            self.i32(1 if obj else 0)
+        elif isinstance(obj, (int, float)):
+            self.i32(TYPE_NUMBER)
+            self.f64(float(obj))
+        elif isinstance(obj, str):
+            self.i32(TYPE_STRING)
+            self.string(obj)
+        elif isinstance(obj, np.ndarray):
+            self._write_tensor(obj)
+        elif isinstance(obj, dict):
+            self.i32(TYPE_TABLE)
+            self.i32(self._index())
+            self.i32(len(obj))
+            for k, v in obj.items():
+                self.write_object(k)
+                self.write_object(v)
+        elif isinstance(obj, (list, tuple)):
+            self.i32(TYPE_TABLE)
+            self.i32(self._index())
+            self.i32(len(obj))
+            for i, v in enumerate(obj):
+                self.write_object(i + 1)  # Lua 1-based array
+                self.write_object(v)
+        else:
+            raise TypeError(f"cannot write {type(obj)} to .t7")
+
+    def _index(self) -> int:
+        i = self.next_index
+        self.next_index += 1
+        return i
+
+    def _write_tensor(self, arr: np.ndarray):
+        dt = arr.dtype
+        if dt not in _DTYPE_TO_TENSOR:
+            arr = arr.astype(np.float32)
+            dt = arr.dtype
+        arr = np.ascontiguousarray(arr)
+        self.i32(TYPE_TORCH)
+        self.i32(self._index())
+        self.string("V 1")
+        self.string(_DTYPE_TO_TENSOR[dt])
+        self.i32(arr.ndim)
+        for s in arr.shape:
+            self.i64(s)
+        stride = [st // arr.itemsize for st in arr.strides]
+        for st in stride:
+            self.i64(st)
+        self.i64(1)  # storageOffset, 1-based
+        # storage object
+        self.i32(TYPE_TORCH)
+        self.i32(self._index())
+        self.string("V 1")
+        self.string(_DTYPE_TO_STORAGE[dt])
+        self.i64(arr.size)
+        self.f.write(arr.tobytes())
+
+
+class TorchFile:
+    @staticmethod
+    def load(path: str) -> Any:
+        with open(path, "rb") as f:
+            return _Reader(f).read_object()
+
+    @staticmethod
+    def save(obj: Any, path: str):
+        with open(path, "wb") as f:
+            _Writer(f).write_object(obj)

@@ -34,7 +34,10 @@ class Container(Module):
         params = {}
         for key, child in zip(self._child_keys, self.children):
             rng, sub = jax.random.split(rng)
-            params[key] = child.init(sub)
+            # a child pre-loaded with weights (set_params before add —
+            # the interop loaders do this) keeps them; fresh init otherwise
+            params[key] = child._params if child._params is not None \
+                else child.init(sub)
         return params
 
     def _collect_state(self, out, path):

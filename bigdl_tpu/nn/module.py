@@ -134,7 +134,11 @@ class Module:
         return out
 
     def _collect_state(self, out: Dict, path: Tuple[str, ...]):
-        s = self._init_state()
+        # a leaf module preloaded with state (interop loaders set running
+        # stats before the model is assembled) contributes that state, not a
+        # fresh _init_state
+        own = self._state.get(()) if isinstance(self._state, dict) else None
+        s = own if own is not None else self._init_state()
         if s is not None:
             out[path] = s
 
