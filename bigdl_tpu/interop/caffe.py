@@ -300,6 +300,10 @@ class CaffePersister:
         name = m.name
         lp = pb.LayerParameter(name=name, bottom=[bottom], top=[name])
         if isinstance(m, nn.SpatialConvolution):
+            if m.pad_h in ("SAME", -1) or m.pad_w in ("SAME", -1):
+                raise ValueError(
+                    f"CaffePersister: caffe cannot express SAME padding "
+                    f"(layer {name}); set explicit pads before persisting")
             lp.type = "Convolution"
             cp = lp.convolution_param
             cp.num_output = m.n_out

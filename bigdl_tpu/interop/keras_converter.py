@@ -72,10 +72,6 @@ class DefinitionLoader:
         in_shape = cfg.get("batch_input_shape")
         input_shape = tuple(in_shape[1:]) if in_shape else None
         act = cfg.get("activation")
-
-        def with_act(layer):
-            return layer
-
         if cls == "Dense":
             return K.Dense(cfg["output_dim"], activation=_act(act),
                            bias=cfg.get("bias", True),

@@ -97,6 +97,12 @@ class TensorflowLoader:
         def data_inputs(nd: pb.NodeDef) -> List[str]:
             return [_clean(i) for i in nd.input if not i.startswith("^")]
 
+        import sys
+        # build() recurses once per chained op; deep frozen graphs
+        # (ResNet-152-scale) exceed the default limit
+        limit = max(sys.getrecursionlimit(), 3 * len(nodes) + 1000)
+        sys.setrecursionlimit(limit)
+
         def build(name: str) -> Node:
             if name in built:
                 return built[name]
@@ -136,6 +142,10 @@ class TensorflowLoader:
                     f"op {op} ({nd.name}) needs a Const input #{i}")
             return consts[args[i]]
 
+        if op == "Const":
+            # reached as a *dynamic* operand of a binary op
+            # (e.g. Sub(const, x)); emit a constant-producing node
+            return _TFConst(consts[nd.name], name=nd.name), []
         if op in ("Identity", "CheckNumerics", "StopGradient"):
             return nn.Identity(name=nd.name), args[:1]
         if op == "Conv2D":
@@ -262,6 +272,17 @@ class TensorflowLoader:
             "DL/utils/tf/loaders/)")
 
 
+class _TFConst(Module):
+    """Constant operand of a binary op (loader-internal)."""
+
+    def __init__(self, value, name=None):
+        super().__init__(name)
+        self.value = jnp.asarray(np.asarray(value))
+
+    def apply(self, params, input, ctx):
+        return self.value
+
+
 class _TFPad(Module):
     """Zero padding with a TF paddings table (loader-internal)."""
 
@@ -329,31 +350,48 @@ class TensorflowSaver:
              if not isinstance(v, dict)}
         if isinstance(m, nn.Linear):
             w = TensorflowSaver._const(gd, base + "/w", p["weight"])
-            node = gd.node.add(name=base, op="MatMul", input=[prev, w])
+            # the layer's public name goes on its FINAL op so users can
+            # request outputs by layer name
+            mm = base + "/mm" if m.with_bias else base
+            node = gd.node.add(name=mm, op="MatMul", input=[prev, w])
             node.attr["transpose_b"].b = False
-            out = base
+            out = mm
             if m.with_bias:
                 b = TensorflowSaver._const(gd, base + "/b", p["bias"])
-                gd.node.add(name=base + "/bias", op="BiasAdd",
-                            input=[out, b])
-                out = base + "/bias"
+                gd.node.add(name=base, op="BiasAdd", input=[out, b])
+                out = base
             return out
         if isinstance(m, nn.SpatialConvolution):
+            if (m.pad_h not in ("SAME", -1) and
+                    (int(m.pad_h) > 0 or int(m.pad_w) > 0)):
+                # TF has only SAME/VALID; emit an explicit Pad for the rest
+                paddings = np.asarray(
+                    [[0, 0], [m.pad_h, m.pad_h], [m.pad_w, m.pad_w], [0, 0]],
+                    np.int32)
+                pc = TensorflowSaver._const(gd, base + "/paddings", paddings)
+                gd.node.add(name=base + "/pad", op="Pad", input=[prev, pc])
+                prev = base + "/pad"
             w = TensorflowSaver._const(gd, base + "/w", p["weight"])
-            node = gd.node.add(name=base, op="Conv2D", input=[prev, w])
+            conv = base + "/conv" if m.with_bias else base
+            node = gd.node.add(name=conv, op="Conv2D", input=[prev, w])
             node.attr["strides"].list.i.extend([1, m.sh, m.sw, 1])
             node.attr["padding"].s = (
                 b"SAME" if m.pad_h in ("SAME", -1) else b"VALID")
-            out = base
+            out = conv
             if m.with_bias:
                 b = TensorflowSaver._const(gd, base + "/b", p["bias"])
-                gd.node.add(name=base + "/bias", op="BiasAdd",
-                            input=[out, b])
-                out = base + "/bias"
+                gd.node.add(name=base, op="BiasAdd", input=[out, b])
+                out = base
             return out
         if isinstance(m, (nn.SpatialMaxPooling, nn.SpatialAveragePooling)):
             op = "MaxPool" if isinstance(m, nn.SpatialMaxPooling) \
                 else "AvgPool"
+            if (m.pad_h not in ("SAME", -1) and
+                    (int(m.pad_h) > 0 or int(m.pad_w) > 0)):
+                raise ValueError(
+                    f"TensorflowSaver: TF pooling supports only SAME/VALID "
+                    f"padding; {base} has explicit pad "
+                    f"({m.pad_h}, {m.pad_w})")
             node = gd.node.add(name=base, op=op, input=[prev])
             node.attr["ksize"].list.i.extend([1, m.kh, m.kw, 1])
             node.attr["strides"].list.i.extend([1, m.dh, m.dw, 1])
@@ -368,9 +406,15 @@ class TensorflowSaver:
                 gd.node.add(name=base, op=op, input=[prev])
                 return base
         if isinstance(m, (nn.Reshape, nn.InferReshape)):
+            # Reshape sizes exclude the batch dim; InferReshape sizes are the
+            # full target shape already
             size = list(getattr(m, "size", ()))
+            if isinstance(m, nn.InferReshape) and not m.batch_mode:
+                full = size
+            else:
+                full = [-1] + size
             shape = TensorflowSaver._const(
-                gd, base + "/shape", np.asarray([-1] + size, np.int32))
+                gd, base + "/shape", np.asarray(full, np.int32))
             gd.node.add(name=base, op="Reshape", input=[prev, shape])
             return base
         if isinstance(m, nn.Dropout):

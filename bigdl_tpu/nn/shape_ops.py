@@ -33,7 +33,9 @@ class Reshape(Module):
 
 
 class InferReshape(Module):
-    """Reshape with -1 inference (DL/nn/InferReshape.scala)."""
+    """Reshape with -1 inference and 0 = copy-input-dim
+    (DL/nn/InferReshape.scala: 0 keeps the corresponding input dim — the
+    Caffe/TF Flatten convention `[0, -1]`)."""
 
     def __init__(self, size: Sequence[int], batch_mode: bool = False, name=None):
         super().__init__(name)
@@ -41,9 +43,11 @@ class InferReshape(Module):
         self.batch_mode = batch_mode
 
     def apply(self, params, input, ctx):
+        size = tuple(input.shape[i] if s == 0 else s
+                     for i, s in enumerate(self.size))
         if self.batch_mode:
-            return jnp.reshape(input, (input.shape[0],) + self.size)
-        return jnp.reshape(input, self.size)
+            return jnp.reshape(input, (input.shape[0],) + size)
+        return jnp.reshape(input, size)
 
 
 class View(Reshape):

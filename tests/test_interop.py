@@ -257,3 +257,73 @@ class TestKerasConverter:
         want = np.exp(logits) / np.exp(logits).sum(1, keepdims=True)
         got = np.asarray(model.forward(jnp.asarray(x), training=False))
         np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+class TestReviewRegressions:
+    def test_caffe_flatten_layer(self, tmp_path):
+        proto = tmp_path / "flat.prototxt"
+        proto.write_text("""
+input: "data"
+layer { name: "f" type: "Flatten" bottom: "data" top: "out" }
+""")
+        g = CaffeLoader.load(str(proto))
+        x = jnp.ones((2, 3, 4), jnp.float32)
+        assert np.asarray(g.forward(x)).shape == (2, 12)
+
+    def test_tf_saver_explicit_conv_pad(self, tmp_path):
+        m = nn.Sequential()
+        m.add(nn.SpatialConvolution(1, 2, 3, 3, 1, 1, 1, 1))  # pad=1
+        m.evaluate()
+        m.ensure_params()
+        path = str(tmp_path / "pad.pb")
+        TensorflowSaver.save(m, path)
+        g = TensorflowLoader.load(path, ["input"],
+                                  ["layer0_SpatialConvolution"])
+        x = jnp.asarray(np.random.RandomState(0).rand(1, 8, 8, 1),
+                        jnp.float32)
+        want = np.asarray(m.forward(x))
+        got = np.asarray(g.forward(x))
+        assert got.shape == want.shape == (1, 8, 8, 2)
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+    def test_tf_saver_explicit_pool_pad_raises(self, tmp_path):
+        m = nn.Sequential().add(nn.SpatialMaxPooling(2, 2, 2, 2, 1, 1))
+        m.ensure_params()
+        with pytest.raises(ValueError, match="SAME/VALID"):
+            TensorflowSaver.save(m, str(tmp_path / "x.pb"))
+
+    def test_caffe_persister_same_pad_raises(self, tmp_path):
+        m = nn.Sequential().add(nn.SpatialConvolution(1, 2, 3, 3, 1, 1, -1, -1))
+        m.ensure_params()
+        with pytest.raises(ValueError, match="SAME padding"):
+            CaffePersister.persist(str(tmp_path / "x.prototxt"),
+                                   str(tmp_path / "x.caffemodel"), m)
+
+    def test_tf_const_first_binary_op(self):
+        from bigdl_tpu.proto import tf_graph_pb2 as tpb
+        from bigdl_tpu.interop.tensorflow import ndarray_to_tensor
+        gd = tpb.GraphDef()
+        gd.node.add(name="x", op="Placeholder")
+        c = gd.node.add(name="one", op="Const")
+        c.attr["value"].tensor.CopyFrom(
+            ndarray_to_tensor(np.asarray([1.0, 1.0, 1.0], np.float32)))
+        gd.node.add(name="sub", op="Sub", input=["one", "x"])  # 1 - x
+        g = TensorflowLoader.from_graph_def(gd, ["x"], ["sub"])
+        x = np.asarray([[0.25, 0.5, 2.0]], np.float32)
+        np.testing.assert_allclose(
+            np.asarray(g.forward(jnp.asarray(x))), 1.0 - x, rtol=1e-6)
+
+    def test_tf_round_trip_of_imported_reshape(self, tmp_path):
+        # InferReshape (batch-included sizes) must survive save->load
+        m = nn.Sequential()
+        m.add(nn.InferReshape([-1, 6]))
+        m.add(nn.Linear(6, 2))
+        m.evaluate()
+        m.ensure_params()
+        path = str(tmp_path / "r.pb")
+        TensorflowSaver.save(m, path)
+        g = TensorflowLoader.load(path, ["input"], ["layer1_Linear"])
+        x = jnp.asarray(np.random.RandomState(1).rand(4, 2, 3), jnp.float32)
+        np.testing.assert_allclose(np.asarray(g.forward(x)),
+                                   np.asarray(m.forward(x)), rtol=1e-5,
+                                   atol=1e-6)
