@@ -1,0 +1,6 @@
+from bigdl_tpu.dlframes.dl_estimator import (DLClassifier, DLClassifierModel,
+                                             DLEstimator, DLModel)
+from bigdl_tpu.dlframes.dl_image import DLImageReader, DLImageTransformer
+
+__all__ = ["DLEstimator", "DLModel", "DLClassifier", "DLClassifierModel",
+           "DLImageReader", "DLImageTransformer"]

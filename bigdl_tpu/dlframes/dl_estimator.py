@@ -1,0 +1,152 @@
+"""DataFrame-native estimator/transformer pipeline stages.
+
+Parity: `DLEstimator`/`DLModel`/`DLClassifier`/`DLClassifierModel`
+(DL/dlframes/DLEstimator.scala:163,270,362, SURVEY.md C31) — the reference's
+Spark-ML pipeline integration: `estimator.fit(df)` trains and returns a
+model; `model.transform(df)` appends a prediction column. Here the
+"DataFrame" is a pandas DataFrame (or any dict-of-columns), the natural
+host-side tabular container in a python/TPU stack, and the fit runs the
+standard Optimizer on the extracted feature/label columns. The sklearn-style
+`fit/transform` surface doubles as a drop-in for sklearn pipelines.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence, Union
+
+import numpy as np
+
+from bigdl_tpu.nn.module import Module
+
+
+def _get_column(df, name: str) -> np.ndarray:
+    if hasattr(df, "loc") and hasattr(df, "columns"):  # pandas
+        col = df[name].tolist()
+    elif isinstance(df, dict):
+        col = list(df[name])
+    else:
+        raise TypeError(f"unsupported frame type {type(df)}")
+    return np.asarray([np.asarray(v, np.float32) for v in col])
+
+
+def _with_column(df, name: str, values: List):
+    if hasattr(df, "assign"):
+        return df.assign(**{name: values})
+    out = dict(df)
+    out[name] = list(values)
+    return out
+
+
+class DLEstimator:
+    """fit(df) -> DLModel. Feature/label columns hold scalars or
+    array-likes; `feature_size`/`label_size` reshape flat columns the way
+    the reference's `featureSize` does (DLEstimator.scala:163)."""
+
+    def __init__(self, model: Module, criterion, feature_size: Sequence[int],
+                 label_size: Sequence[int],
+                 features_col: str = "features", label_col: str = "label"):
+        self.model = model
+        self.criterion = criterion
+        self.feature_size = tuple(feature_size)
+        self.label_size = tuple(label_size)
+        self.features_col = features_col
+        self.label_col = label_col
+        self.batch_size = 32
+        self.max_epoch = 10
+        self.learning_rate = 1e-3
+        self.optim_method = None
+        self._flatten_labels = False  # DLClassifier: scalar class ids
+
+    # fluent setters (reference setBatchSize/setMaxEpoch/setLearningRate)
+    def set_batch_size(self, v: int):
+        self.batch_size = v
+        return self
+
+    def set_max_epoch(self, v: int):
+        self.max_epoch = v
+        return self
+
+    def set_learning_rate(self, v: float):
+        self.learning_rate = v
+        return self
+
+    def set_optim_method(self, method):
+        self.optim_method = method
+        return self
+
+    def fit(self, df) -> "DLModel":
+        import bigdl_tpu.optim as optim
+        X = _get_column(df, self.features_col).reshape(
+            (-1,) + self.feature_size)
+        Y = _get_column(df, self.label_col).reshape((-1,) + self.label_size)
+        if self._flatten_labels and self.label_size == (1,):
+            Y = Y.reshape(-1)
+        o = optim.Optimizer(self.model, (X, Y), self.criterion,
+                            batch_size=self.batch_size, local=True)
+        o.set_optim_method(self.optim_method
+                           or optim.Adam(learning_rate=self.learning_rate))
+        o.set_end_when(optim.max_epoch(self.max_epoch))
+        trained = o.optimize()
+        return self._make_model(trained)
+
+    def _make_model(self, trained: Module) -> "DLModel":
+        return DLModel(trained, self.feature_size,
+                       features_col=self.features_col)
+
+
+class DLModel:
+    """transform(df): append a `prediction` column
+    (DLModel.transform, DLEstimator.scala:362)."""
+
+    def __init__(self, model: Module, feature_size: Sequence[int],
+                 features_col: str = "features",
+                 prediction_col: str = "prediction"):
+        self.model = model
+        self.feature_size = tuple(feature_size)
+        self.features_col = features_col
+        self.prediction_col = prediction_col
+        self.batch_size = 128
+
+    def set_batch_size(self, v: int):
+        self.batch_size = v
+        return self
+
+    def _predict_raw(self, df) -> np.ndarray:
+        import jax.numpy as jnp
+        X = _get_column(df, self.features_col).reshape(
+            (-1,) + self.feature_size)
+        outs = []
+        for i in range(0, len(X), self.batch_size):
+            batch = jnp.asarray(X[i:i + self.batch_size])
+            outs.append(np.asarray(
+                self.model.forward(batch, training=False)))
+        return np.concatenate(outs)
+
+    def transform(self, df):
+        preds = self._predict_raw(df)
+        return _with_column(df, self.prediction_col,
+                            [p for p in preds])
+
+
+class DLClassifier(DLEstimator):
+    """Classifier sugar: scalar class labels, argmax prediction
+    (DLClassifier, DLEstimator.scala:270)."""
+
+    def __init__(self, model: Module, criterion, feature_size: Sequence[int],
+                 features_col: str = "features", label_col: str = "label"):
+        super().__init__(model, criterion, feature_size, (1,),
+                         features_col, label_col)
+        self._flatten_labels = True
+
+    def _make_model(self, trained: Module) -> "DLClassifierModel":
+        return DLClassifierModel(trained, self.feature_size,
+                                 features_col=self.features_col)
+
+
+class DLClassifierModel(DLModel):
+    """Appends 1-based class predictions (argmax over the output row)."""
+
+    def transform(self, df):
+        preds = self._predict_raw(df)
+        classes = (np.argmax(preds, axis=-1) + 1).astype(np.float64)
+        return _with_column(df, self.prediction_col, classes.tolist())

@@ -1,0 +1,74 @@
+"""Image DataFrame reader + transformer pipeline stages.
+
+Parity: `DLImageReader` / `DLImageTransformer`
+(DL/dlframes/{DLImageReader,DLImageTransformer}.scala, SURVEY.md C31) — read
+a directory of images into a DataFrame with a struct 'image' column, and
+apply a vision FeatureTransformer to that column inside a pipeline.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import numpy as np
+
+from bigdl_tpu.transform.vision.image import (FeatureTransformer,
+                                              ImageFeature, ImageFrame)
+
+
+def _image_row(feature: ImageFeature) -> dict:
+    img = np.asarray(feature.image, np.float32)
+    h, w = img.shape[:2]
+    c = img.shape[2] if img.ndim == 3 else 1
+    return {"origin": feature.get(ImageFeature.URI),
+            "height": h, "width": w, "n_channels": c,
+            "data": img}
+
+
+class DLImageReader:
+    """read(path) -> DataFrame with an 'image' struct column
+    (origin/height/width/nChannels/data like the reference's schema)."""
+
+    @staticmethod
+    def read(path: str, with_label: bool = False):
+        frame = ImageFrame.read(path, with_label=with_label)
+        rows = []
+        for f in frame.features:
+            row = {"image": _image_row(f)}
+            if with_label:
+                row["label"] = f.get(ImageFeature.LABEL)
+            rows.append(row)
+        try:
+            import pandas as pd
+            return pd.DataFrame(rows)
+        except ImportError:
+            return {k: [r.get(k) for r in rows] for k in rows[0]}
+
+
+class DLImageTransformer:
+    """Apply a FeatureTransformer to the image column, producing a new
+    column of transformed float tensors (DLImageTransformer.transform)."""
+
+    def __init__(self, transformer: FeatureTransformer,
+                 input_col: str = "image", output_col: str = "output"):
+        self.transformer = transformer
+        self.input_col = input_col
+        self.output_col = output_col
+
+    def transform(self, df):
+        if hasattr(df, "iterrows"):
+            images = df[self.input_col].tolist()
+        else:
+            images = list(df[self.input_col])
+        outs = []
+        for row in images:
+            f = ImageFeature(np.asarray(row["data"], np.float32),
+                             uri=row.get("origin"))
+            f = self.transformer.transform(f)
+            outs.append(_image_row(f))
+        if hasattr(df, "assign"):
+            return df.assign(**{self.output_col: outs})
+        out = dict(df)
+        out[self.output_col] = outs
+        return out

@@ -1,0 +1,91 @@
+"""dlframes tests (reference pyspark/test/bigdl/test_dl_classifier.py +
+TEST/dlframes specs, SURVEY.md C31): estimator fit/transform over DataFrames,
+classifier argmax semantics, image reader/transformer stages.
+"""
+
+import numpy as np
+import pytest
+
+import bigdl_tpu.nn as nn
+from bigdl_tpu.dlframes import (DLClassifier, DLEstimator, DLImageReader,
+                                DLImageTransformer, DLModel)
+
+pd = pytest.importorskip("pandas")
+
+
+def _toy_df(n=96, d=6, classes=3, seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, d).astype(np.float32)
+    W = rng.randn(d, classes).astype(np.float32) * 2
+    y = np.argmax(X @ W, axis=1) + 1  # 1-based labels
+    return pd.DataFrame({"features": [x for x in X],
+                         "label": y.astype(np.float64)}), X, y
+
+
+class TestDLClassifier:
+    def test_fit_transform(self):
+        df, X, y = _toy_df()
+        model = nn.Sequential().add(nn.Linear(6, 16)).add(nn.ReLU()) \
+            .add(nn.Linear(16, 3)).add(nn.LogSoftMax())
+        est = DLClassifier(model, nn.ClassNLLCriterion(), [6]) \
+            .set_batch_size(16).set_max_epoch(30).set_learning_rate(1e-2)
+        fitted = est.fit(df)
+        out = fitted.transform(df)
+        acc = (np.asarray(out["prediction"]) == y).mean()
+        assert acc > 0.9, acc
+        assert "prediction" in out.columns
+
+    def test_regression_estimator(self):
+        rng = np.random.RandomState(1)
+        X = rng.randn(128, 4).astype(np.float32)
+        w = np.asarray([1.0, -2.0, 0.5, 3.0], np.float32)
+        y = X @ w
+        df = pd.DataFrame({"features": [x for x in X],
+                           "label": [np.asarray([v]) for v in y]})
+        model = nn.Sequential().add(nn.Linear(4, 1))
+        est = DLEstimator(model, nn.MSECriterion(), [4], [1]) \
+            .set_batch_size(32).set_max_epoch(60).set_learning_rate(5e-2)
+        fitted = est.fit(df)
+        out = fitted.transform(df)
+        preds = np.asarray([p.reshape(-1)[0] for p in out["prediction"]])
+        assert np.abs(preds - y).mean() < 0.3
+
+    def test_dict_frame_support(self):
+        df, X, y = _toy_df(n=32)
+        plain = {"features": list(df["features"]), "label": list(df["label"])}
+        model = nn.Sequential().add(nn.Linear(6, 3)).add(nn.LogSoftMax())
+        est = DLClassifier(model, nn.ClassNLLCriterion(), [6]) \
+            .set_max_epoch(2)
+        fitted = est.fit(plain)
+        out = fitted.transform(plain)
+        assert len(out["prediction"]) == 32
+
+
+class TestDLImage:
+    def _img_dir(self, tmp_path):
+        from PIL import Image
+        rng = np.random.RandomState(0)
+        for cls in ("a", "b"):
+            d = tmp_path / cls
+            d.mkdir()
+            for i in range(3):
+                arr = rng.randint(0, 255, (12, 10, 3)).astype(np.uint8)
+                Image.fromarray(arr).save(str(d / f"{i}.png"))
+        return str(tmp_path)
+
+    def test_reader_schema(self, tmp_path):
+        df = DLImageReader.read(self._img_dir(tmp_path), with_label=True)
+        assert len(df) == 6
+        row = df.iloc[0]["image"]
+        assert row["height"] == 12 and row["width"] == 10
+        assert row["n_channels"] == 3
+        assert set(df["label"]) == {1.0, 2.0}
+
+    def test_transformer_stage(self, tmp_path):
+        from bigdl_tpu.transform.vision.augmentation import Resize
+        df = DLImageReader.read(self._img_dir(tmp_path))
+        out = DLImageTransformer(Resize(6, 5)).transform(df)
+        assert out.iloc[0]["output"]["height"] == 6
+        assert out.iloc[0]["output"]["width"] == 5
+        # original column untouched
+        assert out.iloc[0]["image"]["height"] == 12
