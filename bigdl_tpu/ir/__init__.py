@@ -1,0 +1,3 @@
+from bigdl_tpu.ir.ir_graph import ConversionUtils, IRElement, IRGraph
+
+__all__ = ["IRGraph", "IRElement", "ConversionUtils"]

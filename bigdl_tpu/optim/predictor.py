@@ -23,7 +23,13 @@ from bigdl_tpu.utils.table import Table
 
 
 class LocalPredictor:
-    def __init__(self, model: Module, batch_size: int = 32):
+    def __init__(self, model: Module, batch_size: int = 32,
+                 convert: bool = True):
+        if convert:
+            # inference-graph rewrites (BN fold, noise elision) — the
+            # reference converts via IR here too (DistriOptimizer.scala:552)
+            from bigdl_tpu.ir import ConversionUtils
+            model = ConversionUtils.convert(model.evaluate(), inference=True)
         self.model = model
         self.batch_size = batch_size
         self._jitted = None

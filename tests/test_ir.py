@@ -1,0 +1,87 @@
+"""IR / backend-conversion tests (reference TEST/utils/intermediate +
+mkldnn Fusion specs, SURVEY.md C12): BN folding preserves outputs exactly,
+noise layers vanish at inference, predictor path converts automatically.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import bigdl_tpu.nn as nn
+from bigdl_tpu.ir import ConversionUtils, IRGraph
+
+
+def _train_bn_model():
+    m = nn.Sequential()
+    m.add(nn.SpatialConvolution(3, 8, 3, 3, 1, 1, 1, 1))
+    m.add(nn.SpatialBatchNormalization(8))
+    m.add(nn.ReLU())
+    m.add(nn.Reshape([8 * 6 * 6]))
+    m.add(nn.Linear(8 * 6 * 6, 4))
+    m.add(nn.BatchNormalization(4))
+    m.add(nn.Dropout(0.5))
+    m.add(nn.LogSoftMax())
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.rand(8, 6, 6, 3), jnp.float32)
+    import jax
+    m.forward(x, training=True, rng=jax.random.PRNGKey(0))  # build stats
+    m.evaluate()
+    return m, x
+
+
+class TestFoldBatchnorm:
+    def test_outputs_preserved_and_bn_removed(self):
+        m, x = _train_bn_model()
+        want = np.asarray(m.forward(x))
+        converted = ConversionUtils.convert(m, inference=True)
+        got = np.asarray(converted.forward(x))
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+        types = [type(c).__name__ for c in converted.children]
+        assert "SpatialBatchNormalization" not in types
+        assert "BatchNormalization" not in types
+        assert "Dropout" not in types
+        # two Identities replaced BNs + one replaced Dropout
+        assert types.count("Identity") == 3
+
+    def test_folded_weights_differ(self):
+        m, x = _train_bn_model()
+        w_before = np.asarray(m.ensure_params()["0_SpatialConvolution"]
+                              ["weight"]).copy()
+        converted = ConversionUtils.convert(m, inference=True)
+        w_after = np.asarray(
+            converted.ensure_params()["0_SpatialConvolution"]["weight"])
+        assert not np.allclose(w_before, w_after)
+
+    def test_train_mode_bn_not_folded(self):
+        m, x = _train_bn_model()
+        m.training()
+        for c in m.children:
+            c.training()
+        converted = ConversionUtils.convert(m, inference=False)
+        types = [type(c).__name__ for c in converted.children]
+        assert "SpatialBatchNormalization" in types
+
+
+class TestIRGraph:
+    def test_elements_flatten(self):
+        m, _ = _train_bn_model()
+        ir = IRGraph.from_module(m)
+        ops = [e.op_type for e in ir.elements()]
+        assert ops[0] == "SpatialConvolution"
+        assert "LogSoftMax" in ops
+        assert len(ops) == 8
+
+
+class TestPredictorConversion:
+    def test_predictor_applies_conversion(self):
+        from bigdl_tpu.optim.predictor import LocalPredictor
+        from bigdl_tpu.dataset.sample import Sample
+        m, x = _train_bn_model()
+        want = np.asarray(m.forward(x))
+        pred = LocalPredictor(m, batch_size=4)
+        types = [type(c).__name__ for c in pred.model.children]
+        assert "SpatialBatchNormalization" not in types
+        samples = [Sample(np.asarray(x)[i]) for i in range(8)]
+        outs = pred.predict(samples)
+        np.testing.assert_allclose(np.stack(outs), want, rtol=1e-4,
+                                   atol=1e-5)
