@@ -29,7 +29,9 @@ class LocalPredictor:
             # inference-graph rewrites (BN fold, noise elision) — the
             # reference converts via IR here too (DistriOptimizer.scala:552)
             from bigdl_tpu.ir import ConversionUtils
-            model = ConversionUtils.convert(model.evaluate(), inference=True)
+            # set the flag directly: KerasModel overloads .evaluate(x, y)
+            model.training_mode = False
+            model = ConversionUtils.convert(model, inference=True)
         self.model = model
         self.batch_size = batch_size
         self._jitted = None

@@ -74,9 +74,14 @@ class Top1Accuracy(ValidationMethod):
 
     def apply(self, output, target):
         pred = jnp.argmax(output, axis=-1)
-        t = jnp.asarray(target).astype(jnp.int32).reshape((-1,))
-        if not self.zero_based:
-            t = t - 1
+        t = jnp.asarray(target)
+        if t.ndim == jnp.ndim(output) and t.shape[-1] > 1:
+            # one-hot / probability targets (Keras categorical labels)
+            t = jnp.argmax(t, axis=-1).reshape((-1,))
+        else:
+            t = t.astype(jnp.int32).reshape((-1,))
+            if not self.zero_based:
+                t = t - 1
         correct = jnp.sum((pred.reshape((-1,)) == t).astype(jnp.float32))
         return AccuracyResult(float(correct), t.shape[0])
 

@@ -1,0 +1,65 @@
+"""Keras-style API example: mnist_cnn.
+
+Parity: PY/examples/keras/mnist_cnn.py (SURVEY.md C38) — the reference runs
+the stock Keras 1.2.2 mnist_cnn through its Keras API. Same model here on
+the bigdl_tpu.keras surface, synthetic data by default.
+"""
+
+from __future__ import annotations
+
+import argparse
+
+import numpy as np
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--batch-size", type=int, default=64)
+    p.add_argument("--nb-epoch", type=int, default=2)
+    p.add_argument("--data-dir", default=None)
+    args = p.parse_args(argv)
+
+    import bigdl_tpu.keras as K
+    from examples.lenet_local import load_mnist, synthetic_mnist
+
+    if args.data_dir:
+        X, Y = load_mnist(args.data_dir, "train")
+        Xt, Yt = load_mnist(args.data_dir, "test")
+        X, Xt = X / 255.0, Xt / 255.0
+        n_class = 10
+    else:
+        X, Y = synthetic_mnist(512)
+        Xt, Yt = synthetic_mnist(256, seed=1)
+        n_class = 4
+    X = X[..., None]
+    Xt = Xt[..., None]
+
+    def to_categorical(y, n):
+        out = np.zeros((len(y), n), np.float32)
+        out[np.arange(len(y)), y.astype(int) - 1] = 1.0
+        return out
+
+    Y = to_categorical(Y, n_class)
+    Yt = to_categorical(Yt, n_class)
+
+    model = K.Sequential()
+    model.add(K.Convolution2D(16, 3, 3, activation="relu",
+                              input_shape=(28, 28, 1)))
+    model.add(K.Convolution2D(16, 3, 3, activation="relu"))
+    model.add(K.MaxPooling2D(pool_size=(2, 2)))
+    model.add(K.Dropout(0.25))
+    model.add(K.Flatten())
+    model.add(K.Dense(64, activation="relu"))
+    model.add(K.Dropout(0.5))
+    model.add(K.Dense(n_class, activation="softmax"))
+
+    model.compile(optimizer="adadelta", loss="categorical_crossentropy",
+                  metrics=["accuracy"])
+    model.fit(X, Y, batch_size=args.batch_size, nb_epoch=args.nb_epoch)
+    score = model.evaluate(Xt, Yt, batch_size=256)
+    print(f"Test accuracy: {score}")
+    return score
+
+
+if __name__ == "__main__":
+    main()

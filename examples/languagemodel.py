@@ -1,0 +1,84 @@
+"""PTB-style LSTM language model example.
+
+Parity: DL/example/languagemodel (PTB LSTM, SURVEY.md C37; baseline config
+4 in BASELINE.json) — next-word prediction with TimeDistributed cross
+entropy. Default corpus is a synthetic Markov-chain text (zero downloads);
+--data-file takes a real ptb.train.txt.
+"""
+
+from __future__ import annotations
+
+import argparse
+
+import numpy as np
+
+
+def synthetic_ptb(n_tokens: int = 20000, vocab: int = 200, seed: int = 0):
+    """Markov chain with strong bigram structure so the LM has signal."""
+    rng = np.random.RandomState(seed)
+    # sparse transition matrix: each word strongly predicts ~3 successors
+    succ = rng.randint(0, vocab, (vocab, 3))
+    toks = [0]
+    for _ in range(n_tokens - 1):
+        cur = toks[-1]
+        if rng.rand() < 0.8:
+            toks.append(int(succ[cur, rng.randint(3)]))
+        else:
+            toks.append(int(rng.randint(vocab)))
+    return np.asarray(toks, np.int32), vocab
+
+
+def batchify(tokens: np.ndarray, seq_len: int):
+    n = (len(tokens) - 1) // seq_len
+    X = tokens[:n * seq_len].reshape(n, seq_len)
+    Y = tokens[1:n * seq_len + 1].reshape(n, seq_len)
+    return X.astype(np.float32) + 1, Y.astype(np.float32) + 1  # 1-based
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--data-file", default=None)
+    p.add_argument("--seq-len", type=int, default=20)
+    p.add_argument("--hidden", type=int, default=128)
+    p.add_argument("--embed", type=int, default=64)
+    p.add_argument("--batch-size", type=int, default=32)
+    p.add_argument("--max-epoch", type=int, default=2)
+    args = p.parse_args(argv)
+
+    import bigdl_tpu.nn as nn
+    import bigdl_tpu.optim as optim
+    from bigdl_tpu.models.rnn import PTBModel
+
+    if args.data_file:
+        with open(args.data_file) as f:
+            words = f.read().split()
+        vocab_words = sorted(set(words))
+        idx = {w: i for i, w in enumerate(vocab_words)}
+        tokens = np.asarray([idx[w] for w in words], np.int32)
+        vocab = len(vocab_words)
+    else:
+        tokens, vocab = synthetic_ptb()
+
+    X, Y = batchify(tokens, args.seq_len)
+    model = PTBModel(input_size=vocab + 1, hidden_size=args.hidden,
+                     output_size=vocab + 1, num_layers=2)
+    crit = nn.TimeDistributedCriterion(nn.ClassNLLCriterion())
+    o = optim.Optimizer(model, (X, Y), crit, batch_size=args.batch_size,
+                        local=True)
+    o.set_optim_method(optim.Adam(learning_rate=2e-3))
+    o.set_end_when(optim.max_epoch(args.max_epoch))
+    trained = o.optimize()
+
+    # report perplexity on the training tail (example-scale metric)
+    import jax.numpy as jnp
+    logits = trained.forward(jnp.asarray(X[:64]), training=False)
+    ll = np.asarray(logits)
+    nll = -np.take_along_axis(
+        ll, (Y[:64].astype(np.int64) - 1)[..., None], axis=-1).mean()
+    ppl = float(np.exp(nll))
+    print(f"Perplexity is {ppl}")
+    return ppl
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,92 @@
+"""LeNet-5 local training example.
+
+Parity: DL/example/lenetLocal + DL/models/lenet/Train.scala (SURVEY.md
+C35/C37) — train LeNet-5, checkpoint, evaluate Top1. Uses synthetic
+MNIST-like data so the example runs with zero downloads; pass --data-dir
+with idx files to use real MNIST.
+"""
+
+from __future__ import annotations
+
+import argparse
+import gzip
+import os
+import struct
+
+import numpy as np
+
+
+def load_mnist(data_dir: str, split: str = "train"):
+    """Read idx-format MNIST (reference PY/dataset/mnist.py)."""
+    prefix = "train" if split == "train" else "t10k"
+    with gzip.open(os.path.join(
+            data_dir, f"{prefix}-images-idx3-ubyte.gz"), "rb") as f:
+        _, n, rows, cols = struct.unpack(">IIII", f.read(16))
+        images = np.frombuffer(f.read(), np.uint8).reshape(n, rows, cols)
+    with gzip.open(os.path.join(
+            data_dir, f"{prefix}-labels-idx1-ubyte.gz"), "rb") as f:
+        _, n = struct.unpack(">II", f.read(8))
+        labels = np.frombuffer(f.read(), np.uint8)
+    return images.astype(np.float32), labels.astype(np.int32) + 1
+
+
+def synthetic_mnist(n: int = 512, seed: int = 0):
+    """Separable 4-class 28x28 problem (quadrant energy)."""
+    rng = np.random.RandomState(seed)
+    X = rng.rand(n, 28, 28).astype(np.float32) * 0.1
+    masks = np.zeros((4, 28, 28), np.float32)
+    masks[0, :14, :14] = 1
+    masks[1, :14, 14:] = 1
+    masks[2, 14:, :14] = 1
+    masks[3, 14:, 14:] = 1
+    which = rng.randint(0, 4, n)
+    for i, k in enumerate(which):
+        X[i] += masks[k] * rng.rand()
+    return X, (which + 1).astype(np.int32)
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--data-dir", default=None,
+                   help="dir with MNIST idx .gz files (default: synthetic)")
+    p.add_argument("--batch-size", type=int, default=128)
+    p.add_argument("--max-epoch", type=int, default=2)
+    p.add_argument("--checkpoint", default=None)
+    p.add_argument("--class-num", type=int, default=None)
+    args = p.parse_args(argv)
+
+    import bigdl_tpu.nn as nn
+    import bigdl_tpu.optim as optim
+    from bigdl_tpu.dataset.dataset import DataSet
+    from bigdl_tpu.models.lenet import LeNet5
+
+    if args.data_dir:
+        X, Y = load_mnist(args.data_dir, "train")
+        Xt, Yt = load_mnist(args.data_dir, "test")
+        mean, std = X.mean(), X.std()
+        X, Xt = (X - mean) / std, (Xt - mean) / std
+        n_class = 10
+    else:
+        X, Y = synthetic_mnist(512)
+        Xt, Yt = synthetic_mnist(256, seed=1)
+        n_class = 4
+    n_class = args.class_num or n_class
+
+    model = LeNet5(n_class)
+    o = optim.Optimizer(model, (X, Y), nn.ClassNLLCriterion(),
+                        batch_size=args.batch_size, local=True)
+    o.set_optim_method(optim.Adam(learning_rate=2e-3))
+    o.set_end_when(optim.max_epoch(args.max_epoch))
+    if args.checkpoint:
+        o.set_checkpoint(args.checkpoint, optim.every_epoch())
+    trained = o.optimize()
+
+    res = trained.evaluate_on(DataSet.from_arrays(Xt, Yt),
+                              [optim.Top1Accuracy()], batch_size=256)
+    acc = res[0].result()[0]
+    print(f"Top1Accuracy is {acc}")
+    return acc
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,116 @@
+"""Text classification example: temporal-conv net over word embeddings.
+
+Parity: DL/example/textclassification + example/utils/TextClassifier.scala:45
+(SURVEY.md C37) — the reference trains a CNN on news20 with GloVe vectors.
+This example builds the same architecture (embedding -> TemporalConvolution
+-> pooling -> dense) over the text pipeline (tokenize -> Dictionary ->
+LabeledSentence -> Sample); the default corpus is synthetic topic-keyword
+text so it runs with zero downloads. Point --data-dir at a
+class-per-subdirectory tree of .txt files for real data.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+from typing import List, Tuple
+
+import numpy as np
+
+
+def synthetic_corpus(n_per_class: int = 120, seed: int = 0
+                     ) -> List[Tuple[str, int]]:
+    rng = np.random.RandomState(seed)
+    topics = {
+        1: "game team score win play season match goal league cup".split(),
+        2: "market stock price trade rate bank profit share fund tax".split(),
+        3: "cpu chip code linux kernel driver memory compile byte gpu".split(),
+    }
+    filler = "the a of to and in on for with is was it this that".split()
+    out = []
+    for label, words in topics.items():
+        for _ in range(n_per_class):
+            n = rng.randint(20, 40)
+            toks = [words[rng.randint(len(words))] if rng.rand() < 0.5
+                    else filler[rng.randint(len(filler))] for _ in range(n)]
+            out.append((" ".join(toks), label))
+    rng.shuffle(out)
+    return out
+
+
+def read_corpus(data_dir: str) -> List[Tuple[str, int]]:
+    out = []
+    classes = sorted(os.listdir(data_dir))
+    for label, cls in enumerate(classes, start=1):
+        d = os.path.join(data_dir, cls)
+        for fname in os.listdir(d):
+            with open(os.path.join(d, fname), errors="replace") as f:
+                out.append((f.read(), label))
+    return out
+
+
+def build_model(vocab_size: int, embed_dim: int, seq_len: int,
+                class_num: int):
+    import bigdl_tpu.nn as nn
+    model = nn.Sequential()
+    model.add(nn.LookupTable(vocab_size, embed_dim))
+    model.add(nn.TemporalConvolution(embed_dim, 128, 5))
+    model.add(nn.ReLU())
+    model.add(nn.TemporalMaxPooling(seq_len - 5 + 1))
+    model.add(nn.Reshape([128]))
+    model.add(nn.Linear(128, 100))
+    model.add(nn.ReLU())
+    model.add(nn.Linear(100, class_num))
+    model.add(nn.LogSoftMax())
+    return model
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--data-dir", default=None)
+    p.add_argument("--seq-len", type=int, default=50)
+    p.add_argument("--embed-dim", type=int, default=50)
+    p.add_argument("--vocab-size", type=int, default=5000)
+    p.add_argument("--batch-size", type=int, default=32)
+    p.add_argument("--max-epoch", type=int, default=4)
+    args = p.parse_args(argv)
+
+    import bigdl_tpu.nn as nn
+    import bigdl_tpu.optim as optim
+    from bigdl_tpu.dataset.dataset import DataSet
+    from bigdl_tpu.dataset.text import Dictionary, SentenceTokenizer
+
+    corpus = read_corpus(args.data_dir) if args.data_dir else \
+        synthetic_corpus()
+    tok = SentenceTokenizer()
+    tokenized = list(tok.apply(iter(t for t, _ in corpus)))
+    labels = np.asarray([l for _, l in corpus], np.int32)
+    d = Dictionary(tokenized, vocab_size=args.vocab_size - 1)
+
+    n = args.seq_len
+    ids = np.zeros((len(tokenized), n), np.float32)
+    for i, toks in enumerate(tokenized):
+        seq = [min(d.get_index(t), args.vocab_size - 1) for t in toks[:n]]
+        ids[i, :len(seq)] = np.asarray(seq, np.float32)
+    ids += 1  # LookupTable is 1-based
+
+    split = int(len(ids) * 0.8)
+    model = build_model(args.vocab_size + 1, args.embed_dim, n,
+                        int(labels.max()))
+    o = optim.Optimizer(model, (ids[:split], labels[:split]),
+                        nn.ClassNLLCriterion(), batch_size=args.batch_size,
+                        local=True)
+    o.set_optim_method(optim.Adagrad(learning_rate=0.01))
+    o.set_end_when(optim.max_epoch(args.max_epoch))
+    trained = o.optimize()
+
+    res = trained.evaluate_on(
+        DataSet.from_arrays(ids[split:], labels[split:]),
+        [optim.Top1Accuracy()], batch_size=128)
+    acc = res[0].result()[0]
+    print(f"Top1Accuracy is {acc}")
+    return acc
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,73 @@
+"""UDF-style serving example.
+
+Parity: DL/example/udfpredictor (SURVEY.md C37) — the reference registers a
+SparkSQL UDF that classifies text rows via a broadcast model. Here the same
+shape: train a small text classifier, wrap `PredictionService` into a
+`classify(text) -> label` function, and map it over a "table" of rows
+(pandas apply when available).
+"""
+
+from __future__ import annotations
+
+import argparse
+
+import numpy as np
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--rows", type=int, default=8)
+    args = p.parse_args(argv)
+
+    import jax.numpy as jnp
+    import bigdl_tpu.nn as nn
+    import bigdl_tpu.optim as optim
+    from bigdl_tpu.dataset.sample import Sample
+    from bigdl_tpu.dataset.text import Dictionary, SentenceTokenizer
+    from bigdl_tpu.optim.predictor import PredictionService
+    from examples.textclassification import build_model, synthetic_corpus
+
+    seq_len, vocab_size = 30, 500
+    corpus = synthetic_corpus(n_per_class=60)
+    tok = SentenceTokenizer()
+    tokenized = list(tok.apply(iter(t for t, _ in corpus)))
+    labels = np.asarray([l for _, l in corpus], np.int32)
+    d = Dictionary(tokenized, vocab_size=vocab_size - 1)
+
+    def encode(toks):
+        ids = np.zeros((seq_len,), np.float32)
+        seq = [min(d.get_index(t), vocab_size - 1) for t in toks[:seq_len]]
+        ids[:len(seq)] = seq
+        return ids + 1
+
+    X = np.stack([encode(t) for t in tokenized])
+    model = build_model(vocab_size + 1, 32, seq_len, int(labels.max()))
+    o = optim.Optimizer(model, (X, labels), nn.ClassNLLCriterion(),
+                        batch_size=32, local=True)
+    o.set_optim_method(optim.Adagrad(learning_rate=0.02))
+    o.set_end_when(optim.max_epoch(3))
+    trained = o.optimize()
+
+    service = PredictionService(trained)
+
+    def classify_udf(text: str) -> int:
+        toks = next(iter(tok.apply(iter([text]))))
+        out = service.predict(Sample(encode(toks)))
+        return int(np.argmax(out)) + 1
+
+    rows = [t for t, _ in synthetic_corpus(n_per_class=args.rows, seed=7)]
+    truth = [l for _, l in synthetic_corpus(n_per_class=args.rows, seed=7)]
+    try:
+        import pandas as pd
+        df = pd.DataFrame({"text": rows})
+        df["prediction"] = df["text"].apply(classify_udf)
+        preds = df["prediction"].tolist()
+    except ImportError:
+        preds = [classify_udf(t) for t in rows]
+    acc = float(np.mean(np.asarray(preds) == np.asarray(truth)))
+    print(f"UDF accuracy over {len(rows)} rows: {acc}")
+    return acc
+
+
+if __name__ == "__main__":
+    main()
