@@ -86,6 +86,7 @@ def main(argv=None):
             loss_fn, has_aux=True)(params)
         if args.distributed:
             grads = jax.lax.pmean(grads, "data")
+            loss = jax.lax.pmean(loss, "data")
         new_params, new_opt = method.update(grads, opt_state, params, 0.01)
         return new_params, new_opt, new_s, loss
 
@@ -105,8 +106,7 @@ def main(argv=None):
         run = jax.jit(shard_map(
             step, mesh=mesh,
             in_specs=(P(), P(), P(), P("data"), P("data")),
-            out_specs=(P(), P(), P(), P()),
-            check_rep=False))
+            out_specs=(P(), P(), P(), P())))
     else:
         records = args.batch_size
         x, y = jnp.asarray(x_np), jnp.asarray(y_np)
