@@ -23,7 +23,8 @@ from bigdl_tpu.optim.validation import (AccuracyResult, ContiguousResult,
                                         ValidationResult)
 from bigdl_tpu.optim.metrics import Metrics, Timer
 from bigdl_tpu.optim.local_optimizer import LocalOptimizer
-from bigdl_tpu.optim.distri_optimizer import DistriOptimizer
+from bigdl_tpu.optim.distri_optimizer import (DistriOptimizer,
+                                              ParallelOptimizer)
 from bigdl_tpu.optim.optimizer import Optimizer
 from bigdl_tpu.optim.predictor import (LocalPredictor, PredictionService,
                                        Predictor)

@@ -80,3 +80,26 @@ class TestLogger:
         assert lg.level == logging.INFO
         noisy = logging.getLogger("jax._src")
         assert not noisy.propagate
+
+
+class TestProfiling:
+    def test_get_times_orders_layers(self):
+        import jax.numpy as jnp
+        import bigdl_tpu.nn as nn
+        from bigdl_tpu.utils.profiling import get_times
+        m = nn.Sequential().add(nn.Linear(8, 32)).add(nn.ReLU()) \
+            .add(nn.Linear(32, 4))
+        times = get_times(m, jnp.ones((4, 8)))
+        assert len(times) == 3
+        assert "0_Linear" in times[0][0] and "2_Linear" in times[2][0]
+        assert all(t >= 0 for _, t in times)
+
+    def test_timed_phases(self):
+        from bigdl_tpu.utils.profiling import TimedPhases
+        tp = TimedPhases()
+        with tp.phase("computing time"):
+            sum(range(1000))
+        with tp.phase("computing time"):
+            pass
+        assert tp.counts["computing time"] == 2
+        assert "computing time" in tp.summary()
