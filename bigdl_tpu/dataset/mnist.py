@@ -1,0 +1,59 @@
+"""MNIST idx-format loader.
+
+Parity: PY/dataset/mnist.py (SURVEY.md A.9). The reference downloads from
+Yann LeCun's site; in this zero-egress build `read_data_sets(dir)` parses
+already-downloaded idx .gz (or raw) files. Labels return 1-based like every
+classification path in this framework.
+"""
+
+from __future__ import annotations
+
+import gzip
+import os
+import struct
+from typing import Tuple
+
+import numpy as np
+
+TRAIN_IMAGES = "train-images-idx3-ubyte.gz"
+TRAIN_LABELS = "train-labels-idx1-ubyte.gz"
+TEST_IMAGES = "t10k-images-idx3-ubyte.gz"
+TEST_LABELS = "t10k-labels-idx1-ubyte.gz"
+
+TRAIN_MEAN = 0.13066047740239506 * 255
+TRAIN_STD = 0.3081078 * 255  # reference lenet normalization constants
+
+
+def _open(path: str):
+    if os.path.exists(path):
+        return gzip.open(path, "rb")
+    raw = path[:-3]
+    if path.endswith(".gz") and os.path.exists(raw):
+        return open(raw, "rb")
+    raise FileNotFoundError(path)
+
+
+def extract_images(path: str) -> np.ndarray:
+    with _open(path) as f:
+        magic, n, rows, cols = struct.unpack(">IIII", f.read(16))
+        if magic != 2051:
+            raise ValueError(f"bad idx3 magic {magic} in {path}")
+        return np.frombuffer(f.read(), np.uint8).reshape(n, rows, cols)
+
+
+def extract_labels(path: str) -> np.ndarray:
+    with _open(path) as f:
+        magic, n = struct.unpack(">II", f.read(8))
+        if magic != 2049:
+            raise ValueError(f"bad idx1 magic {magic} in {path}")
+        return np.frombuffer(f.read(), np.uint8)
+
+
+def read_data_sets(data_dir: str, split: str = "train"
+                   ) -> Tuple[np.ndarray, np.ndarray]:
+    """(images [N,28,28] float32 raw 0-255, labels [N] 1-based int32)."""
+    img, lab = (TRAIN_IMAGES, TRAIN_LABELS) if split == "train" else \
+        (TEST_IMAGES, TEST_LABELS)
+    images = extract_images(os.path.join(data_dir, img)).astype(np.float32)
+    labels = extract_labels(os.path.join(data_dir, lab)).astype(np.int32) + 1
+    return images, labels

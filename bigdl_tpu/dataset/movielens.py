@@ -1,0 +1,47 @@
+"""MovieLens ratings loader.
+
+Parity: PY/dataset/movielens.py (SURVEY.md A.9) — parses ml-1m
+`ratings.dat` (`user::movie::rating::ts`) or ml-latest `ratings.csv` into
+the (user, item, rating) triples the Wide&Deep / NCF examples consume.
+Zero-egress: point at an already-downloaded dataset directory.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Tuple
+
+import numpy as np
+
+
+def read_data_sets(data_dir: str) -> np.ndarray:
+    """[N, 3] int32 array of (user_id, movie_id, rating)."""
+    dat = os.path.join(data_dir, "ratings.dat")
+    csv = os.path.join(data_dir, "ratings.csv")
+    rows = []
+    if os.path.exists(dat):
+        with open(dat) as f:
+            for line in f:
+                parts = line.strip().split("::")
+                if len(parts) >= 3:
+                    rows.append((int(parts[0]), int(parts[1]),
+                                 int(float(parts[2]))))
+    elif os.path.exists(csv):
+        with open(csv) as f:
+            next(f)  # header
+            for line in f:
+                parts = line.strip().split(",")
+                if len(parts) >= 3:
+                    rows.append((int(parts[0]), int(parts[1]),
+                                 int(float(parts[2]))))
+    else:
+        raise FileNotFoundError(f"no ratings.dat/ratings.csv in {data_dir}")
+    return np.asarray(rows, np.int32)
+
+
+def get_id_pairs(data_dir: str) -> np.ndarray:
+    return read_data_sets(data_dir)[:, :2]
+
+
+def get_id_ratings(data_dir: str) -> np.ndarray:
+    return read_data_sets(data_dir)

@@ -1,0 +1,40 @@
+"""news20 corpus + GloVe vectors loader.
+
+Parity: PY/dataset/news20.py (SURVEY.md A.9) — the text-classification
+example's data: a class-per-subdirectory tree of documents plus GloVe
+`glove.6B.<dim>d.txt` embeddings. Zero-egress: parses local copies.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Tuple
+
+import numpy as np
+
+
+def get_news20(data_dir: str) -> List[Tuple[str, int]]:
+    """[(text, 1-based label)] from a class-per-subdirectory tree."""
+    out: List[Tuple[str, int]] = []
+    classes = sorted(d for d in os.listdir(data_dir)
+                     if os.path.isdir(os.path.join(data_dir, d)))
+    for label, cls in enumerate(classes, start=1):
+        d = os.path.join(data_dir, cls)
+        for fname in sorted(os.listdir(d)):
+            path = os.path.join(d, fname)
+            if os.path.isfile(path):
+                with open(path, errors="replace") as f:
+                    out.append((f.read(), label))
+    return out
+
+
+def get_glove_w2v(glove_path: str, dim: int = 50
+                  ) -> Dict[str, np.ndarray]:
+    """{word: vector[dim]} from a glove.6B.<dim>d.txt file."""
+    table: Dict[str, np.ndarray] = {}
+    with open(glove_path, errors="replace") as f:
+        for line in f:
+            parts = line.rstrip().split(" ")
+            if len(parts) == dim + 1:
+                table[parts[0]] = np.asarray(parts[1:], np.float32)
+    return table

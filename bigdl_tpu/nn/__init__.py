@@ -103,3 +103,9 @@ from bigdl_tpu.nn.quantized import (QuantizedLinear,
                                     QuantizedSpatialConvolution,
                                     QuantizedSpatialDilatedConvolution,
                                     Quantizer)
+
+# name-parity aliases (reference DL/nn/RnnCell.scala is listed as "RNN" in
+# user docs; ClassSimplexCriterion export)
+from bigdl_tpu.nn.recurrent import RnnCell
+from bigdl_tpu.nn.criterion import ClassSimplexCriterion
+RNN = RnnCell

@@ -9,25 +9,14 @@ with idx files to use real MNIST.
 from __future__ import annotations
 
 import argparse
-import gzip
-import os
-import struct
 
 import numpy as np
 
 
 def load_mnist(data_dir: str, split: str = "train"):
-    """Read idx-format MNIST (reference PY/dataset/mnist.py)."""
-    prefix = "train" if split == "train" else "t10k"
-    with gzip.open(os.path.join(
-            data_dir, f"{prefix}-images-idx3-ubyte.gz"), "rb") as f:
-        _, n, rows, cols = struct.unpack(">IIII", f.read(16))
-        images = np.frombuffer(f.read(), np.uint8).reshape(n, rows, cols)
-    with gzip.open(os.path.join(
-            data_dir, f"{prefix}-labels-idx1-ubyte.gz"), "rb") as f:
-        _, n = struct.unpack(">II", f.read(8))
-        labels = np.frombuffer(f.read(), np.uint8)
-    return images.astype(np.float32), labels.astype(np.int32) + 1
+    """Read idx-format MNIST via the dataset loader (PY/dataset/mnist.py)."""
+    from bigdl_tpu.dataset import mnist
+    return mnist.read_data_sets(data_dir, split)
 
 
 def synthetic_mnist(n: int = 512, seed: int = 0):

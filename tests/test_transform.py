@@ -247,3 +247,42 @@ class TestTextPipeline:
         # labels are shifted-by-one inputs, 1-based
         assert s.label.shape == (6,)
         assert (s.label >= 1).all()
+
+
+class TestDatasetLoaders:
+    def test_mnist_idx_round_trip(self, tmp_path):
+        import gzip, struct
+        from bigdl_tpu.dataset import mnist
+        rng = np.random.RandomState(0)
+        imgs = rng.randint(0, 255, (5, 28, 28)).astype(np.uint8)
+        labels = np.arange(5, dtype=np.uint8)
+        with gzip.open(str(tmp_path / mnist.TRAIN_IMAGES), "wb") as f:
+            f.write(struct.pack(">IIII", 2051, 5, 28, 28))
+            f.write(imgs.tobytes())
+        with gzip.open(str(tmp_path / mnist.TRAIN_LABELS), "wb") as f:
+            f.write(struct.pack(">II", 2049, 5))
+            f.write(labels.tobytes())
+        X, Y = mnist.read_data_sets(str(tmp_path), "train")
+        np.testing.assert_array_equal(X.astype(np.uint8), imgs)
+        np.testing.assert_array_equal(Y, labels + 1)  # 1-based
+
+    def test_movielens_dat(self, tmp_path):
+        from bigdl_tpu.dataset import movielens
+        (tmp_path / "ratings.dat").write_text(
+            "1::10::5::978300760\n2::20::3::978302109\n")
+        arr = movielens.read_data_sets(str(tmp_path))
+        np.testing.assert_array_equal(arr, [[1, 10, 5], [2, 20, 3]])
+
+    def test_news20_tree_and_glove(self, tmp_path):
+        from bigdl_tpu.dataset import news20
+        for cls in ("alt.atheism", "sci.space"):
+            d = tmp_path / cls
+            d.mkdir()
+            (d / "001.txt").write_text(f"doc about {cls}")
+        corpus = news20.get_news20(str(tmp_path))
+        assert len(corpus) == 2
+        assert corpus[0][1] == 1 and corpus[1][1] == 2
+        glove = tmp_path / "glove.6B.3d.txt"
+        glove.write_text("the 0.1 0.2 0.3\ncat 1.0 2.0 3.0\n")
+        w2v = news20.get_glove_w2v(str(glove), dim=3)
+        np.testing.assert_allclose(w2v["cat"], [1.0, 2.0, 3.0])
