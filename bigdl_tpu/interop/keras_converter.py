@@ -55,10 +55,31 @@ class DefinitionLoader:
                 if layer is not None:
                     model.add(layer)
             return model
-        raise ValueError(
-            f"unsupported Keras model class {cls}; Sequential json is "
-            "supported (functional-API graphs: build with bigdl_tpu.keras "
-            "directly)")
+        if cls == "Model":
+            return DefinitionLoader._functional(config["config"])
+        raise ValueError(f"unsupported Keras model class {cls}")
+
+    @staticmethod
+    def _functional(cfg: Dict[str, Any]):
+        """Functional-API graph json: layers + inbound_nodes wiring
+        (reference DefinitionLoader handles Model the same way)."""
+        tensors: Dict[str, Any] = {}  # layer name -> output KTensor
+        for lc in cfg["layers"]:
+            name = lc.get("name") or lc["config"].get("name")
+            if lc["class_name"] == "InputLayer":
+                shape = tuple(lc["config"]["batch_input_shape"][1:])
+                tensors[name] = K.input_tensor(shape, name=name)
+                continue
+            layer = DefinitionLoader._layer(lc)
+            inbound = lc.get("inbound_nodes") or []
+            refs = inbound[0] if inbound else []
+            ins = [tensors[r[0]] for r in refs]
+            out = layer(ins[0] if len(ins) == 1 else ins)
+            tensors[name] = out
+        inputs = [tensors[r[0]] for r in cfg["input_layers"]]
+        outputs = [tensors[r[0]] for r in cfg["output_layers"]]
+        return K.Model(input=inputs if len(inputs) > 1 else inputs[0],
+                       output=outputs if len(outputs) > 1 else outputs[0])
 
     @staticmethod
     def _layer(lc: Dict[str, Any]):
@@ -124,6 +145,9 @@ class DefinitionLoader:
             return K.BatchNormalization(epsilon=cfg.get("epsilon", 1e-3),
                                         momentum=cfg.get("momentum", 0.99),
                                         input_shape=input_shape, name=name)
+        if cls == "Merge":
+            return K.Merge(mode=cfg.get("mode", "sum"),
+                           concat_axis=cfg.get("concat_axis", -1), name=name)
         raise ValueError(f"unsupported Keras layer {cls} "
                          "(PY/keras/converter.py parity subset)")
 
@@ -157,10 +181,17 @@ class WeightLoader:
     @staticmethod
     def _apply(model, weights: Dict[str, List[np.ndarray]]):
         params = model.ensure_params()
-        # keras Sequential wraps an inner nn.Sequential (`_seq`) whose
-        # children are the KerasLayer wrappers themselves
-        inner = getattr(model, "_seq", model)
-        for key, layer in zip(inner._child_keys, inner.children):
+        # keras Sequential wraps an inner nn.Sequential (`_seq`); functional
+        # Models wrap an nn.Graph — both expose (key, KerasLayer) pairs
+        from bigdl_tpu.nn.containers import Graph
+        if hasattr(model, "_seq"):
+            inner = model._seq
+            pairs = list(zip(inner._child_keys, inner.children))
+        elif isinstance(getattr(model, "labor", None), Graph):
+            pairs = [(n.key, n.module) for n in model.labor.exec_order]
+        else:
+            pairs = list(zip(model._child_keys, model.children))
+        for key, layer in pairs:
             w = weights.get(layer.name)
             if not w:
                 continue

@@ -1,0 +1,117 @@
+"""Wide & Deep recommender example (baseline config 5).
+
+Parity: the reference exposes Wide&Deep through its pyspark API composed
+from the sparse building blocks (BASELINE.md note; SURVEY.md C35 remark) on
+Census/MovieLens-style data. This example trains the zoo `WideAndDeep` on a
+synthetic recommendation task; pass --data-dir with a MovieLens download to
+use real ratings (bigdl_tpu.dataset.movielens).
+"""
+
+from __future__ import annotations
+
+import argparse
+
+import numpy as np
+
+
+def synthetic_recs(n: int = 2048, wide_dim: int = 200, vocabs=(50, 30, 20),
+                   cont_dim: int = 4, seed: int = 0):
+    """Clicks driven by a sparse wide signal + a categorical interaction."""
+    rng = np.random.RandomState(seed)
+    Lw = 6
+    wide_idx = rng.randint(1, wide_dim + 1, (n, Lw)).astype(np.float32)
+    wide_val = np.ones((n, Lw), np.float32)
+    cat = np.stack([rng.randint(1, v + 1, n) for v in vocabs], 1).astype(
+        np.float32)
+    cont = rng.randn(n, cont_dim).astype(np.float32)
+    # ground truth: a few "hot" wide features + one categorical pattern
+    hot = set(rng.randint(1, wide_dim + 1, 12).tolist())
+    score = np.asarray([sum(int(i) in hot for i in row)
+                        for row in wide_idx], np.float32)
+    score = score + (cat[:, 0] % 2) + 0.5 * cont[:, 0]
+    labels = (score > np.median(score)).astype(np.int32) + 1  # 1-based
+    return (wide_idx, wide_val, cat, cont), labels
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--model-type", default="wide_n_deep",
+                   choices=["wide", "deep", "wide_n_deep"])
+    p.add_argument("--batch-size", type=int, default=128)
+    p.add_argument("--max-epoch", type=int, default=6)
+    p.add_argument("--data-dir", default=None,
+                   help="MovieLens dir (ratings.dat/csv) for real data")
+    args = p.parse_args(argv)
+
+    import jax
+    import jax.numpy as jnp
+    import bigdl_tpu.nn as nn
+    import bigdl_tpu.optim as optim
+    from bigdl_tpu.models.widedeep import WideAndDeep
+    from bigdl_tpu.nn.module import functional_apply
+    from bigdl_tpu.utils.table import T
+
+    wide_dim, vocabs, cont_dim = 200, (50, 30, 20), 4
+    if args.data_dir:
+        from bigdl_tpu.dataset import movielens
+        triples = movielens.read_data_sets(args.data_dir)
+        n = len(triples)
+        users = triples[:, 0].astype(np.float32)
+        items = triples[:, 1].astype(np.float32)
+        wide_dim = int(items.max()) + 1
+        vocabs = (int(users.max()) + 1, int(items.max()) + 1, 7)
+        wide_idx = items[:, None]
+        wide_val = np.ones_like(wide_idx)
+        cat = np.stack([users, items,
+                        (triples[:, 2] % 7 + 1).astype(np.float32)], 1)
+        cont = np.zeros((n, cont_dim), np.float32)
+        labels = (triples[:, 2] >= 4).astype(np.int32) + 1
+        data = (wide_idx.astype(np.float32), wide_val.astype(np.float32),
+                cat.astype(np.float32), cont)
+    else:
+        data, labels = synthetic_recs(wide_dim=wide_dim, vocabs=vocabs,
+                                      cont_dim=cont_dim)
+
+    model = WideAndDeep(class_num=2, wide_dim=wide_dim, embed_vocabs=vocabs,
+                        cont_dim=cont_dim, model_type=args.model_type)
+    crit = nn.ClassNLLCriterion()
+    method = optim.Adam(learning_rate=5e-3)
+    params = model.ensure_params()
+    opt_state = method.init_state(params)
+    n = len(labels)
+
+    def step(params, opt_state, batch, y):
+        def loss_fn(p):
+            out, _ = functional_apply(model, p, T(*batch), training=True)
+            return crit(out, y)
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        p2, s2 = method.update(grads, opt_state, params,
+                               method.current_lr())
+        return p2, s2, loss
+
+    jstep = jax.jit(step)
+    bs = args.batch_size
+    for epoch in range(args.max_epoch):
+        perm = np.random.RandomState(epoch).permutation(n)
+        losses = []
+        for i in range(0, n - bs + 1, bs):
+            sel = perm[i:i + bs]
+            batch = tuple(jnp.asarray(d[sel]) for d in data)
+            y = jnp.asarray(labels[sel])
+            params, opt_state, loss = jstep(params, opt_state, batch, y)
+            losses.append(float(loss))
+        print(f"[Epoch {epoch + 1}] loss {np.mean(losses):.4f}")
+
+    model.set_params(params)
+    out = functional_apply(model, params,
+                           T(*[jnp.asarray(d) for d in data]),
+                           training=False)[0]
+    pred = np.argmax(np.asarray(out), 1) + 1
+    acc = float((pred == labels).mean())
+    print(f"Train accuracy ({args.model_type}): {acc}")
+    return acc
+
+
+if __name__ == "__main__":
+    main()

@@ -52,3 +52,13 @@ class TestExamples:
                     "--iterations", "2", "--warmup", "1", "--distributed"])
         out = capsys.readouterr().out
         assert thr > 0
+
+    def test_wide_n_deep(self):
+        from examples.wide_n_deep import main
+        acc = main(["--max-epoch", "4", "--batch-size", "128"])
+        assert acc > 0.8
+
+    def test_wide_only_variant(self):
+        from examples.wide_n_deep import main
+        acc = main(["--max-epoch", "2", "--model-type", "wide"])
+        assert acc > 0.55

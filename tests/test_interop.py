@@ -327,3 +327,80 @@ layer { name: "f" type: "Flatten" bottom: "data" top: "out" }
         np.testing.assert_allclose(np.asarray(g.forward(x)),
                                    np.asarray(m.forward(x)), rtol=1e-5,
                                    atol=1e-6)
+
+
+class TestKerasFunctional:
+    def test_model_json_with_merge(self, tmp_path):
+        cfg = {
+            "class_name": "Model",
+            "config": {
+                "layers": [
+                    {"class_name": "InputLayer", "name": "in1",
+                     "config": {"batch_input_shape": [None, 6],
+                                "name": "in1"}},
+                    {"class_name": "Dense", "name": "a",
+                     "config": {"name": "a", "output_dim": 4,
+                                "activation": "relu", "bias": True},
+                     "inbound_nodes": [[["in1", 0, 0]]]},
+                    {"class_name": "Dense", "name": "b",
+                     "config": {"name": "b", "output_dim": 4,
+                                "activation": "tanh", "bias": True},
+                     "inbound_nodes": [[["in1", 0, 0]]]},
+                    {"class_name": "Merge", "name": "m",
+                     "config": {"name": "m", "mode": "concat",
+                                "concat_axis": -1},
+                     "inbound_nodes": [[["a", 0, 0], ["b", 0, 0]]]},
+                    {"class_name": "Dense", "name": "out",
+                     "config": {"name": "out", "output_dim": 2,
+                                "activation": "softmax", "bias": True},
+                     "inbound_nodes": [[["m", 0, 0]]]},
+                ],
+                "input_layers": [["in1", 0, 0]],
+                "output_layers": [["out", 0, 0]],
+            },
+        }
+        jpath = tmp_path / "func.json"
+        jpath.write_text(json.dumps(cfg))
+        model = load_keras(str(jpath))
+        x = jnp.asarray(np.random.RandomState(0).randn(3, 6), jnp.float32)
+        out = np.asarray(model.forward(x, training=False))
+        assert out.shape == (3, 2)
+        np.testing.assert_allclose(out.sum(1), 1.0, rtol=1e-5)
+
+    def test_functional_weight_load(self, tmp_path):
+        h5py = pytest.importorskip("h5py")
+        cfg = {
+            "class_name": "Model",
+            "config": {
+                "layers": [
+                    {"class_name": "InputLayer", "name": "in1",
+                     "config": {"batch_input_shape": [None, 5],
+                                "name": "in1"}},
+                    {"class_name": "Dense", "name": "d",
+                     "config": {"name": "d", "output_dim": 3,
+                                "activation": "linear", "bias": True},
+                     "inbound_nodes": [[["in1", 0, 0]]]},
+                ],
+                "input_layers": [["in1", 0, 0]],
+                "output_layers": [["d", 0, 0]],
+            },
+        }
+        jpath = tmp_path / "f.json"
+        jpath.write_text(json.dumps(cfg))
+        rng = np.random.RandomState(2)
+        W, b = rng.randn(5, 3).astype(np.float32), rng.randn(3).astype(
+            np.float32)
+        hpath = str(tmp_path / "w.h5")
+        with h5py.File(hpath, "w") as f:
+            g = f.create_group("model_weights")
+            g.attrs["layer_names"] = [b"in1", b"d"]
+            for lname, ws in [("in1", []), ("d", [("d_W", W), ("d_b", b)])]:
+                lg = g.create_group(lname)
+                lg.attrs["weight_names"] = [w[0].encode() for w in ws]
+                for wn, arr in ws:
+                    lg.create_dataset(wn, data=arr)
+        model = load_keras(str(jpath), hpath)
+        x = rng.randn(4, 5).astype(np.float32)
+        np.testing.assert_allclose(
+            np.asarray(model.forward(jnp.asarray(x), training=False)),
+            x @ W + b, rtol=1e-5, atol=1e-6)
