@@ -7,3 +7,6 @@ from bigdl_tpu.serialization.module_serializer import (ModuleSerializer,
 
 __all__ = ["load_checkpoint", "save_checkpoint", "latest_checkpoint",
            "ModuleSerializer", "register_module", "registered_modules"]
+from bigdl_tpu.serialization.sharded_checkpoint import (restore_sharded,
+                                                        save_sharded)
+__all__ += ["save_sharded", "restore_sharded"]

@@ -1,0 +1,54 @@
+"""Sharded (multi-host) checkpointing via orbax.
+
+Beyond-parity scale path: the reference gathers weight partitions to the
+driver for every checkpoint (AbstractOptimizer.getModel override,
+DistriOptimizer.scala:646-685) — fine for Xeon-cluster model sizes, a
+non-starter for pod-scale sharded params. Here each host writes its own
+shards through orbax/tensorstore and restore places arrays directly onto
+the requested `NamedSharding`s, so params never funnel through one host.
+
+The host-side pickle checkpoints (`checkpoint.py`) remain the default for
+single-chip runs and interop; this module is the `DistriOptimizer`-scale
+variant.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Optional
+
+import jax
+
+
+def save_sharded(ckpt_dir: str, params) -> str:
+    """Write a sharded pytree checkpoint (distributed-safe, atomic)."""
+    import orbax.checkpoint as ocp
+    ckpt_dir = os.path.abspath(ckpt_dir)
+    with ocp.StandardCheckpointer() as ckptr:
+        ckptr.save(ckpt_dir, params)
+    return ckpt_dir
+
+
+def restore_sharded(ckpt_dir: str, like, mesh=None, specs=None):
+    """Restore onto shardings: `like` supplies structure/shapes/dtypes —
+    either a pytree of arrays or of jax.ShapeDtypeStruct. With `mesh` +
+    `specs` (a PartitionSpec pytree, e.g. from
+    parallel.sharding.infer_param_specs) every leaf lands sharded on the
+    mesh without a host round-trip."""
+    import orbax.checkpoint as ocp
+    from jax.sharding import NamedSharding
+
+    ckpt_dir = os.path.abspath(ckpt_dir)
+
+    def abstract(leaf, spec):
+        sharding = NamedSharding(mesh, spec) if mesh is not None else \
+            getattr(leaf, "sharding", None)
+        return jax.ShapeDtypeStruct(leaf.shape, leaf.dtype,
+                                    sharding=sharding)
+
+    if specs is not None:
+        target = jax.tree_util.tree_map(abstract, like, specs)
+    else:
+        target = jax.tree_util.tree_map(lambda l: abstract(l, None), like)
+    with ocp.StandardCheckpointer() as ckptr:
+        return ckptr.restore(ckpt_dir, target)

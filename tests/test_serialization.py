@@ -140,3 +140,34 @@ class TestErrors:
                      "Graph", "BatchNormalization", "LookupTable"):
             assert name in reg, name
         assert len(reg) > 150
+
+
+class TestShardedCheckpoint:
+    def test_save_restore_with_shardings(self, tmp_path):
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        from bigdl_tpu.parallel.mesh import build_mesh
+        from bigdl_tpu.parallel.sharding import infer_param_specs
+        from bigdl_tpu.serialization.sharded_checkpoint import (
+            restore_sharded, save_sharded)
+
+        mesh = build_mesh(data=4, model=2)
+        m = nn.Sequential().add(nn.Linear(512, 512)).add(nn.ReLU()) \
+            .add(nn.Linear(512, 8))
+        params = m.ensure_params()
+        specs = infer_param_specs(params, mesh)
+        sharded = jax.tree_util.tree_map(
+            lambda leaf, spec: jax.device_put(leaf, NamedSharding(mesh, spec)),
+            params, specs)
+        path = str(tmp_path / "ckpt")
+        save_sharded(path, sharded)
+        restored = restore_sharded(path, params, mesh=mesh, specs=specs)
+        # values identical
+        jax.tree_util.tree_map(
+            lambda a, b: np.testing.assert_array_equal(np.asarray(a),
+                                                       np.asarray(b)),
+            sharded, restored)
+        # big weight leaf restored SHARDED over the model axis
+        w = restored["0_Linear"]["weight"]
+        assert not w.sharding.is_fully_replicated
