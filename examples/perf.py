@@ -12,6 +12,10 @@ Models: lenet | inception_v1 | vgg16 | vgg19 | resnet50 | ptb.
 
 from __future__ import annotations
 
+import os as _os
+import sys as _sys
+_sys.path.insert(0, _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__))))
+
 import argparse
 import time
 
@@ -78,8 +82,11 @@ def main(argv=None):
 
     def step(params, opt_state, state, x, y):
         def loss_fn(p):
-            out, new_s = functional_apply(model, p, x, state=state,
-                                          training=True)
+            # bf16 matmuls = MXU native mode; f32 master params
+            with jax.default_matmul_precision("bfloat16"):
+                out, new_s = functional_apply(model, p, x, state=state,
+                                              training=True,
+                                              rng=jax.random.PRNGKey(0))
             return crit.apply(out, y), new_s
 
         (loss, new_s), grads = jax.value_and_grad(
@@ -110,7 +117,8 @@ def main(argv=None):
     else:
         records = args.batch_size
         x, y = jnp.asarray(x_np), jnp.asarray(y_np)
-        run = jax.jit(step)
+        # donate param/opt/state buffers: saves an HBM copy per step
+        run = jax.jit(step, donate_argnums=(0, 1, 2))
 
     for _ in range(args.warmup):
         params, opt_state, state, loss = run(params, opt_state, state, x, y)

@@ -11,6 +11,10 @@ class-per-subdirectory tree of .txt files for real data.
 
 from __future__ import annotations
 
+import os as _os
+import sys as _sys
+_sys.path.insert(0, _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__))))
+
 import argparse
 import os
 from typing import List, Tuple
