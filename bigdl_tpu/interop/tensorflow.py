@@ -99,9 +99,11 @@ class TensorflowLoader:
 
         import sys
         # build() recurses once per chained op; deep frozen graphs
-        # (ResNet-152-scale) exceed the default limit
-        limit = max(sys.getrecursionlimit(), 3 * len(nodes) + 1000)
-        sys.setrecursionlimit(limit)
+        # (ResNet-152-scale) exceed the default limit. Raise it only for
+        # the duration of the build — a library call must not leave a
+        # process-wide side effect.
+        prev_limit = sys.getrecursionlimit()
+        limit = max(prev_limit, 3 * len(nodes) + 1000)
 
         def build(name: str) -> Node:
             if name in built:
@@ -119,7 +121,11 @@ class TensorflowLoader:
             built[name] = node
             return node
 
-        out_nodes = [build(_clean(o)) for o in outputs]
+        sys.setrecursionlimit(limit)
+        try:
+            out_nodes = [build(_clean(o)) for o in outputs]
+        finally:
+            sys.setrecursionlimit(prev_limit)
         # inputs may include names never reached (pruned); keep request order
         ordered_inputs = [built[_clean(i)] for i in inputs
                           if _clean(i) in built]
@@ -301,6 +307,15 @@ class _TFPermute(Module):
 
     def apply(self, params, input, ctx):
         return jnp.transpose(input, self.perm)
+
+
+# loader-internal modules land inside imported Graphs — register them so
+# ModuleSerializer can round-trip imported models (their ctor args are
+# ndarray/list values the AttrValue encoder supports)
+from bigdl_tpu.serialization.module_serializer import register_module as _reg
+for _cls in (_TFConst, _TFPad, _TFPermute):
+    _reg(_cls)
+del _reg, _cls
 
 
 class TensorflowSaver:

@@ -169,6 +169,7 @@ def _fold_batchnorm(ir: IRGraph):
                 if is_prev_ok and is_bn and bn_state is not None:
                     if not prev.with_bias:
                         prev.with_bias = True  # folded bias appears
+                        _patch_ctor_kwargs(prev, with_bias=True)
                     fold_pair(prev, p[prev_key], cur, p.get(cur_key, {}),
                               bn_state)
                     repl = nn.Identity(name=cur.name)
@@ -188,6 +189,29 @@ def _fold_batchnorm(ir: IRGraph):
     # drop folded BN state entries from the root state
     ir.root._state = {k: v for k, v in (ir.root._state or {}).items()
                       if not _is_orphan_state(ir.root, k)}
+
+
+def _patch_ctor_kwargs(mod: Module, **updates):
+    """Rewrite a module's captured ctor spec so the serializer rebuilds it
+    with the given kwarg overrides (e.g. BN folding turns a bias-less layer
+    into one WITH bias — the reconstruction must match or the folded bias
+    tensor would be dropped on load)."""
+    spec = getattr(mod, "_ctor_spec", None)
+    if spec is None:
+        return
+    import inspect
+    name, args, kwargs = spec
+    try:
+        sig = inspect.signature(type(mod).__init__)
+        bound = sig.bind_partial(mod, *args, **kwargs)
+        merged = {k: v for k, v in list(bound.arguments.items())[1:]}
+        merged.pop("self", None)
+        merged.update(updates)
+        mod._ctor_spec = (name, (), merged)
+    except TypeError:
+        kwargs = dict(kwargs)
+        kwargs.update(updates)
+        mod._ctor_spec = (name, args, kwargs)
 
 
 def _is_orphan_state(root, path: Tuple[str, ...]) -> bool:

@@ -28,8 +28,10 @@ class Evaluator:
 
     def test(self, dataset, methods: Sequence[ValidationMethod]
              ) -> List[ValidationResult]:
-        params = self.model.ensure_params()
-        state = self.model._state
+        # the predictor holds the CONVERTED copy (BN folded, noise elided);
+        # its params/state, not the caller's, must feed its jitted forward
+        params = self._pred.model.ensure_params()
+        state = self._pred.model._state
         results: List[ValidationResult] = [None] * len(methods)
         for batch in self._pred._batches(dataset):
             x = batch.get_input()

@@ -27,8 +27,19 @@ class LocalPredictor:
                  convert: bool = True):
         if convert:
             # inference-graph rewrites (BN fold, noise elision) — the
-            # reference converts via IR here too (DistriOptimizer.scala:552)
+            # reference converts via IR here too (DistriOptimizer.scala:552).
+            # Like the reference's ConversionUtils, conversion builds a NEW
+            # module and leaves the caller's model untouched.
+            import copy
             from bigdl_tpu.ir import ConversionUtils
+            # structural copy: module objects are duplicated but jax array
+            # leaves (immutable) are shared, so no parameter memory is copied
+            params = model.ensure_params()
+            memo = {id(leaf): leaf
+                    for leaf in jax.tree_util.tree_leaves(params)}
+            for leaf in jax.tree_util.tree_leaves(model._state):
+                memo[id(leaf)] = leaf
+            model = copy.deepcopy(model, memo)
             # set the flag directly: KerasModel overloads .evaluate(x, y)
             model.training_mode = False
             model = ConversionUtils.convert(model, inference=True)
@@ -68,7 +79,14 @@ class LocalPredictor:
         return [int(np.argmax(o)) + 1 for o in self.predict(dataset)]
 
     def _batches(self, dataset) -> Iterable[MiniBatch]:
-        if hasattr(dataset, "data"):
+        if isinstance(dataset, (np.ndarray, jnp.ndarray)) or (
+                hasattr(dataset, "shape") and hasattr(dataset, "dtype")):
+            # raw feature array: chunk along the leading (sample) axis
+            arr = np.asarray(dataset)
+            for i in range(0, len(arr), self.batch_size):
+                yield MiniBatch(arr[i:i + self.batch_size], None)
+            return
+        if hasattr(dataset, "data") and callable(getattr(dataset, "data")):
             it = dataset.data(train=False)
         else:
             it = iter(dataset)
@@ -98,7 +116,8 @@ class PredictionService:
 
     def __init__(self, model: Module, batch_size: int = 32):
         self.predictor = LocalPredictor(model, batch_size)
-        self.model = model
+        # serve from the predictor's CONVERTED copy, never the caller's model
+        self.model = self.predictor.model
         self._compile_lock = threading.Lock()
 
     def predict(self, sample: Sample) -> np.ndarray:

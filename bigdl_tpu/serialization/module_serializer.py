@@ -32,18 +32,29 @@ FRAMEWORK_VERSION = "bigdl_tpu-0.1"
 _REGISTRY: Dict[str, type] = {}
 
 
+_SCANNED = False
+
+
 def register_module(cls: type, name: Optional[str] = None):
     _REGISTRY[name or cls.__name__] = cls
     return cls
 
 
 def _ensure_registry():
-    if _REGISTRY:
+    global _SCANNED
+    if _SCANNED:
         return
+    _SCANNED = True
     import bigdl_tpu.nn as nn
     import bigdl_tpu.ops as ops
     import bigdl_tpu.keras as keras
     from bigdl_tpu.nn.module import Module
+    try:
+        # loader-internal modules register themselves on import; needed so
+        # a fresh process can load models saved from TF imports
+        import bigdl_tpu.interop.tensorflow  # noqa: F401
+    except Exception:
+        pass
     for pkg in (nn, ops, keras):
         for attr in dir(pkg):
             obj = getattr(pkg, attr)
@@ -251,13 +262,22 @@ def _flatten_with_paths(tree) -> List[Tuple[str, Any]]:
     return out
 
 
-def _merge_leaves(base, saved):
-    """Overlay `saved` leaves onto the structure of `base`."""
+def _merge_leaves(base, saved, _path: str = "", _dropped=None):
+    """Overlay `saved` leaves onto the structure of `base`.
+
+    Saved leaves with no home in the fresh init (structure drift between
+    save and load, e.g. a ctor spec that no longer matches the saved
+    params) are collected into `_dropped` and warned about by the caller —
+    silently discarding them yields silently wrong outputs."""
     if isinstance(base, dict):
         out = {}
         for k, v in base.items():
-            out[k] = _merge_leaves(v, saved.get(k)) if isinstance(saved, dict) \
-                else v
+            sub = saved.get(k) if isinstance(saved, dict) else None
+            out[k] = _merge_leaves(v, sub, f"{_path}/{k}", _dropped)
+        if isinstance(saved, dict) and _dropped is not None:
+            for k in saved:
+                if k not in base:
+                    _dropped.append(f"{_path}/{k}")
         return out
     return saved if saved is not None else base
 
@@ -316,7 +336,17 @@ class ModuleSerializer:
         # merge saved leaves over a fresh init: param-less modules produce
         # empty dicts that have no flattened paths but must exist in the tree
         fresh = module.ensure_params()
-        module.set_params(_merge_leaves(fresh, _unflatten_paths(params_pairs)))
+        dropped: List[str] = []
+        module.set_params(_merge_leaves(fresh, _unflatten_paths(params_pairs),
+                                        _dropped=dropped))
+        if dropped:
+            import warnings
+            warnings.warn(
+                f"ModuleSerializer.load: {len(dropped)} saved parameter "
+                f"leaves have no slot in the reconstructed module and were "
+                f"dropped: {dropped[:5]}{'...' if len(dropped) > 5 else ''}. "
+                f"The loaded model will NOT match the saved one.",
+                stacklevel=2)
         state: Dict = {}
         for nt in mp.state:
             prefix, sub = nt.path.split("::", 1)
