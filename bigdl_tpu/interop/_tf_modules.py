@@ -1,0 +1,50 @@
+"""Loader-internal modules emitted into TF-imported Graphs.
+
+A deliberately dependency-light leaf module: the serializer registry imports
+it to register these classes (so a fresh process can load models saved from
+TF imports) without pulling in the whole interop package.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from bigdl_tpu.nn.module import Module
+
+
+class _TFConst(Module):
+    """Constant operand of a binary op (loader-internal)."""
+
+    def __init__(self, value, name=None):
+        super().__init__(name)
+        self.value = jnp.asarray(np.asarray(value))
+
+    def apply(self, params, input, ctx):
+        return self.value
+
+
+class _TFPad(Module):
+    """Zero padding with a TF paddings table (loader-internal)."""
+
+    def __init__(self, paddings, name=None):
+        super().__init__(name)
+        self.paddings = [tuple(int(x) for x in p) for p in paddings]
+
+    def apply(self, params, input, ctx):
+        return jnp.pad(input, self.paddings)
+
+
+class _TFPermute(Module):
+    def __init__(self, perm, name=None):
+        super().__init__(name)
+        self.perm = tuple(perm)
+
+    def apply(self, params, input, ctx):
+        return jnp.transpose(input, self.perm)
+
+
+from bigdl_tpu.serialization.module_serializer import register_module as _reg
+for _cls in (_TFConst, _TFPad, _TFPermute):
+    _reg(_cls)
+del _reg, _cls

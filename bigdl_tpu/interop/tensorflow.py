@@ -278,44 +278,10 @@ class TensorflowLoader:
             "DL/utils/tf/loaders/)")
 
 
-class _TFConst(Module):
-    """Constant operand of a binary op (loader-internal)."""
-
-    def __init__(self, value, name=None):
-        super().__init__(name)
-        self.value = jnp.asarray(np.asarray(value))
-
-    def apply(self, params, input, ctx):
-        return self.value
-
-
-class _TFPad(Module):
-    """Zero padding with a TF paddings table (loader-internal)."""
-
-    def __init__(self, paddings, name=None):
-        super().__init__(name)
-        self.paddings = [tuple(int(x) for x in p) for p in paddings]
-
-    def apply(self, params, input, ctx):
-        return jnp.pad(input, self.paddings)
-
-
-class _TFPermute(Module):
-    def __init__(self, perm, name=None):
-        super().__init__(name)
-        self.perm = tuple(perm)
-
-    def apply(self, params, input, ctx):
-        return jnp.transpose(input, self.perm)
-
-
-# loader-internal modules land inside imported Graphs — register them so
-# ModuleSerializer can round-trip imported models (their ctor args are
-# ndarray/list values the AttrValue encoder supports)
-from bigdl_tpu.serialization.module_serializer import register_module as _reg
-for _cls in (_TFConst, _TFPad, _TFPermute):
-    _reg(_cls)
-del _reg, _cls
+# loader-internal modules live in a dependency-light leaf module so the
+# serializer registry can import them without the whole interop package
+from bigdl_tpu.interop._tf_modules import (_TFConst, _TFPad,  # noqa: E402
+                                           _TFPermute)
 
 
 class TensorflowSaver:

@@ -28,6 +28,15 @@ class Container(Module):
         key = f"{len(self.children)}_{module.name}"
         self.children.append(module)
         self._child_keys.append(key)
+        self._predictor_cache = None  # structure changed
+        if self._params is not None:
+            # params already materialized (e.g. after a predict): extend
+            # them for the new child so the facade keeps working
+            self._params[key] = module._params if module._params is not None \
+                else module.init(jax.random.PRNGKey(len(self.children)))
+            self._state = {**self._state,
+                           **{(key,) + k: v
+                              for k, v in (module.state_init() or {}).items()}}
         return self
 
     def init(self, rng: jax.Array) -> Dict:

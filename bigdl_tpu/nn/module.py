@@ -158,6 +158,7 @@ class Module:
 
     def set_params(self, params: Dict):
         self._params = params
+        self._predictor_cache = None  # new weights: drop converted predictor
 
     def parameters(self) -> Dict:
         """Reference `AbstractModule.parameters` (AbstractModule.scala:347)."""
@@ -215,17 +216,34 @@ class Module:
         return f"{self.__class__.__name__}({self.name})"
 
     # sugar mirrored from reference AbstractModule.predict/evaluate
-    def predict(self, dataset, batch_size: int = 32):
+    def _predictor(self, batch_size: int):
+        """Cached converted LocalPredictor; rebuilt when the params or state
+        object changes (conversion + jit are per-call overhead otherwise).
+        Both are replaced — never mutated — on update (set_params, forward),
+        so identity checks are sound. batch_size is host-side batching only
+        and is updated on the cached predictor instead of keying it."""
         from bigdl_tpu.optim.predictor import LocalPredictor
-        return LocalPredictor(self, batch_size=batch_size).predict(dataset)
+        cached = getattr(self, "_predictor_cache", None)
+        if (cached is None or cached[0] is not self._params
+                or cached[1] is not self._state):
+            pred = LocalPredictor(self, batch_size=batch_size)
+            # ensure_params() inside may have just materialized them
+            cached = (self._params, self._state, pred)
+            self._predictor_cache = cached
+        cached[2].batch_size = batch_size
+        return cached[2]
+
+    def predict(self, dataset, batch_size: int = 32):
+        return self._predictor(batch_size).predict(dataset)
 
     def predict_class(self, dataset, batch_size: int = 32):
-        from bigdl_tpu.optim.predictor import LocalPredictor
-        return LocalPredictor(self, batch_size=batch_size).predict_class(dataset)
+        return self._predictor(batch_size).predict_class(dataset)
 
     def evaluate_on(self, dataset, methods, batch_size: int = 32):
         from bigdl_tpu.optim.evaluator import Evaluator
-        return Evaluator(self, batch_size=batch_size).test(dataset, methods)
+        return Evaluator(self, batch_size=batch_size,
+                         predictor=self._predictor(batch_size)
+                         ).test(dataset, methods)
 
 
 

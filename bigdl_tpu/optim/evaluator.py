@@ -21,10 +21,13 @@ from bigdl_tpu.utils.table import Table
 
 
 class Evaluator:
-    def __init__(self, model: Module, batch_size: int = 32):
+    def __init__(self, model: Module, batch_size: int = 32,
+                 predictor: LocalPredictor = None):
         self.model = model
         self.batch_size = batch_size
-        self._pred = LocalPredictor(model, batch_size)
+        # callers with a cached converted predictor (Module.evaluate_on)
+        # pass it in to avoid re-converting/re-jitting the model
+        self._pred = predictor or LocalPredictor(model, batch_size)
 
     def test(self, dataset, methods: Sequence[ValidationMethod]
              ) -> List[ValidationResult]:

@@ -22,6 +22,18 @@ from bigdl_tpu.nn.module import Module, functional_apply
 from bigdl_tpu.utils.table import Table
 
 
+def _iter_modules(root: Module):
+    """Iterative walk over every module in a tree (no recursion).
+    Graph containers keep their exec_order modules in .children too."""
+    from bigdl_tpu.nn.containers import Container
+    stack = [root]
+    while stack:
+        m = stack.pop()
+        yield m
+        if isinstance(m, Container):
+            stack.extend(m.children)
+
+
 class LocalPredictor:
     def __init__(self, model: Module, batch_size: int = 32,
                  convert: bool = True):
@@ -31,6 +43,7 @@ class LocalPredictor:
             # Like the reference's ConversionUtils, conversion builds a NEW
             # module and leaves the caller's model untouched.
             import copy
+            import sys
             from bigdl_tpu.ir import ConversionUtils
             # structural copy: module objects are duplicated but jax array
             # leaves (immutable) are shared, so no parameter memory is copied
@@ -39,7 +52,21 @@ class LocalPredictor:
                     for leaf in jax.tree_util.tree_leaves(params)}
             for leaf in jax.tree_util.tree_leaves(model._state):
                 memo[id(leaf)] = leaf
-            model = copy.deepcopy(model, memo)
+            n_modules = 0
+            for m in _iter_modules(model):
+                n_modules += 1
+                # predictor caches hold jitted executables — don't copy them
+                cache = getattr(m, "_predictor_cache", None)
+                if cache is not None:
+                    memo[id(cache)] = None
+            # deepcopy recurses the Node.prev chain of Graph models (~6
+            # frames per node); deep imported graphs exceed the default limit
+            prev_limit = sys.getrecursionlimit()
+            sys.setrecursionlimit(max(prev_limit, 10 * n_modules + 1000))
+            try:
+                model = copy.deepcopy(model, memo)
+            finally:
+                sys.setrecursionlimit(prev_limit)
             # set the flag directly: KerasModel overloads .evaluate(x, y)
             model.training_mode = False
             model = ConversionUtils.convert(model, inference=True)

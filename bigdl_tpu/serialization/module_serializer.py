@@ -49,12 +49,10 @@ def _ensure_registry():
     import bigdl_tpu.ops as ops
     import bigdl_tpu.keras as keras
     from bigdl_tpu.nn.module import Module
-    try:
-        # loader-internal modules register themselves on import; needed so
-        # a fresh process can load models saved from TF imports
-        import bigdl_tpu.interop.tensorflow  # noqa: F401
-    except Exception:
-        pass
+    # loader-internal modules register themselves on import; needed so a
+    # fresh process can load models saved from TF imports (leaf module —
+    # does not pull in the rest of the interop package)
+    import bigdl_tpu.interop._tf_modules  # noqa: F401
     for pkg in (nn, ops, keras):
         for attr in dir(pkg):
             obj = getattr(pkg, attr)
@@ -274,11 +272,19 @@ def _merge_leaves(base, saved, _path: str = "", _dropped=None):
         for k, v in base.items():
             sub = saved.get(k) if isinstance(saved, dict) else None
             out[k] = _merge_leaves(v, sub, f"{_path}/{k}", _dropped)
-        if isinstance(saved, dict) and _dropped is not None:
-            for k in saved:
-                if k not in base:
-                    _dropped.append(f"{_path}/{k}")
+        if _dropped is not None:
+            if isinstance(saved, dict):
+                for k in saved:
+                    if k not in base:
+                        _dropped.append(f"{_path}/{k}")
+            elif saved is not None:
+                _dropped.append(_path)  # saved leaf where base is a subtree
         return out
+    if isinstance(saved, dict):
+        # saved subtree where base is a leaf: cannot be placed — keep base
+        if _dropped is not None:
+            _dropped.append(_path)
+        return base
     return saved if saved is not None else base
 
 
