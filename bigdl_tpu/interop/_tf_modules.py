@@ -44,7 +44,102 @@ class _TFPermute(Module):
         return jnp.transpose(input, self.perm)
 
 
+class _TFFill(Module):
+    """TF Fill with a static dims operand; the fill value stays dynamic."""
+
+    def __init__(self, shape, name=None):
+        super().__init__(name)
+        self.shape = tuple(int(s) for s in shape)
+
+    def apply(self, params, input, ctx):
+        return jnp.full(self.shape, input)
+
+
+class _TFStridedSlice(Module):
+    """TF StridedSlice with static begin/end/strides + mask attrs, lowered
+    to one numpy-style basic-indexing expression (static shapes for XLA)."""
+
+    def __init__(self, begin, end, strides, begin_mask=0, end_mask=0,
+                 ellipsis_mask=0, new_axis_mask=0, shrink_axis_mask=0,
+                 name=None):
+        super().__init__(name)
+        self.begin = [int(v) for v in begin]
+        self.end = [int(v) for v in end]
+        self.strides = [int(v) for v in strides]
+        self.masks = (int(begin_mask), int(end_mask), int(ellipsis_mask),
+                      int(new_axis_mask), int(shrink_axis_mask))
+
+    def apply(self, params, input, ctx):
+        bm, em, elm, nam, sam = self.masks
+        idx = []
+        for p in range(len(self.begin)):
+            bit = 1 << p
+            if elm & bit:
+                idx.append(Ellipsis)
+            elif nam & bit:
+                idx.append(None)
+            elif sam & bit:
+                idx.append(self.begin[p])
+            else:
+                b = None if bm & bit else self.begin[p]
+                e = None if em & bit else self.end[p]
+                idx.append(slice(b, e, self.strides[p]))
+        return input[tuple(idx)]
+
+
+class _TFUnstack(Module):
+    """One output of TF Unpack: drop `axis` at position `index`."""
+
+    def __init__(self, axis, index, name=None):
+        super().__init__(name)
+        self.axis, self.index = int(axis), int(index)
+
+    def apply(self, params, input, ctx):
+        return jnp.take(input, self.index, axis=self.axis)
+
+
+class _TFAxisSlice(Module):
+    """Static slice along one axis (TF SplitV output)."""
+
+    def __init__(self, axis, start, length, name=None):
+        super().__init__(name)
+        self.axis, self.start, self.length = int(axis), int(start), int(length)
+
+    def apply(self, params, input, ctx):
+        import jax.lax as lax
+        return lax.slice_in_dim(input, self.start, self.start + self.length,
+                                axis=self.axis)
+
+
+class _TFMatMul(Module):
+    """(Batch)MatMul honoring TF's transpose_a/transpose_b (adj_x/adj_y)."""
+
+    def __init__(self, transpose_a=False, transpose_b=False, name=None):
+        super().__init__(name)
+        self.ta, self.tb = bool(transpose_a), bool(transpose_b)
+
+    def apply(self, params, input, ctx):
+        a, b = input[1], input[2]
+        if self.ta:
+            a = jnp.swapaxes(a, -1, -2)
+        if self.tb:
+            b = jnp.swapaxes(b, -1, -2)
+        return jnp.matmul(a, b)
+
+
+class _TFTableSelect(Module):
+    """Select output #index (0-based) of a multi-output producer."""
+
+    def __init__(self, index, name=None):
+        super().__init__(name)
+        self.index = int(index)
+
+    def apply(self, params, input, ctx):
+        return input[self.index + 1]  # Table is 1-based
+
+
 from bigdl_tpu.serialization.module_serializer import register_module as _reg
-for _cls in (_TFConst, _TFPad, _TFPermute):
+for _cls in (_TFConst, _TFPad, _TFPermute, _TFFill, _TFStridedSlice,
+             _TFUnstack, _TFAxisSlice, _TFMatMul, _TFTableSelect):
     _reg(_cls)
 del _reg, _cls

@@ -91,11 +91,20 @@ class TensorflowLoader:
                     consts[n.name] = consts[_clean(n.input[0])]
                     changed = True
 
-        built: Dict[str, Node] = {}
+        built: Dict[Tuple[str, int], Node] = {}
         input_nodes: List[Node] = []
+        requested_inputs = {_clean(i) for i in inputs}
+
+        def parse_ref(ref: str) -> Tuple[str, int]:
+            """'name:k' -> (name, k); output index 0 when unqualified."""
+            ref = ref.lstrip("^")
+            if ":" in ref:
+                base, k = ref.split(":", 1)
+                return base, int(k)
+            return ref, 0
 
         def data_inputs(nd: pb.NodeDef) -> List[str]:
-            return [_clean(i) for i in nd.input if not i.startswith("^")]
+            return [i for i in nd.input if not i.startswith("^")]
 
         import sys
         # build() recurses once per chained op; deep frozen graphs
@@ -105,54 +114,123 @@ class TensorflowLoader:
         prev_limit = sys.getrecursionlimit()
         limit = max(prev_limit, 3 * len(nodes) + 1000)
 
-        def build(name: str) -> Node:
-            if name in built:
-                return built[name]
-            nd = nodes[name]
-            if name in [_clean(i) for i in inputs] or nd.op == "Placeholder":
-                node = nn.InputNode(name=name)
+        def build(ref: str) -> Node:
+            base, idx = parse_ref(ref)
+            key = (base, idx)
+            if key in built:
+                return built[key]
+            nd = nodes[base]
+            if base in requested_inputs or nd.op == "Placeholder":
+                node = nn.InputNode(name=base)
                 input_nodes.append(node)
-                built[name] = node
+                built[(base, 0)] = node
+                return node
+            raw_args = data_inputs(nd)
+            if nd.op in ("Split", "SplitV", "Unpack"):
+                # per-consumer specialization: each requested output index
+                # becomes its own slice module (no Table fan-out to carry)
+                module, src = TensorflowLoader._convert_multi(
+                    nd, consts, raw_args, idx)
+                node = module.inputs(build(src))
+                built[key] = node
+                return node
+            if nd.op in ("TopKV2", "TopK"):
+                # Table-producing op: every output (incl. :0) selects its
+                # element so 'name' means 'name:0' like TF
+                from bigdl_tpu.interop._tf_modules import _TFTableSelect
+                raw = built.get((base, -1))
+                if raw is None:
+                    module, arg_names = TensorflowLoader._convert(
+                        nd, consts, raw_args)
+                    prev = [build(x) for x in arg_names]
+                    raw = module.inputs(*prev)
+                    built[(base, -1)] = raw
+                node = _TFTableSelect(idx, name=f"{base}.{idx}").inputs(raw)
+                built[key] = node
+                return node
+            if idx > 0:
+                from bigdl_tpu.interop._tf_modules import _TFTableSelect
+                node = _TFTableSelect(idx, name=f"{base}:{idx}").inputs(
+                    build(base))
+                built[key] = node
                 return node
             module, arg_names = TensorflowLoader._convert(nd, consts,
-                                                          data_inputs(nd))
+                                                          raw_args)
             prev = [build(a) for a in arg_names]
             node = module.inputs(*prev) if prev else module.inputs()
-            built[name] = node
+            built[key] = node
             return node
 
         sys.setrecursionlimit(limit)
         try:
-            out_nodes = [build(_clean(o)) for o in outputs]
+            out_nodes = [build(o) for o in outputs]
         finally:
             sys.setrecursionlimit(prev_limit)
         # inputs may include names never reached (pruned); keep request order
-        ordered_inputs = [built[_clean(i)] for i in inputs
-                          if _clean(i) in built]
+        ordered_inputs = [built[(_clean(i), 0)] for i in inputs
+                          if (_clean(i), 0) in built]
         graph = nn.Graph(ordered_inputs or input_nodes, out_nodes)
         graph.evaluate()
         return graph
 
     # ---------------------------------------------------------- op loaders
     @staticmethod
+    def _convert_multi(nd: pb.NodeDef, consts: Dict[str, np.ndarray],
+                       args: List[str], idx: int) -> Tuple[Module, str]:
+        """Multi-output ops (Split/SplitV/Unpack): return the module that
+        produces output #idx plus the name of its single dynamic input."""
+        from bigdl_tpu.interop._tf_modules import _TFAxisSlice, _TFUnstack
+        import bigdl_tpu.ops as ops
+        op = nd.op
+        name = f"{nd.name}:{idx}" if idx else nd.name
+        if op == "Split":           # (split_dim, value)
+            axis = int(consts[_clean(args[0])])
+            num = int(nd.attr["num_split"].i)
+            return ops.SplitAndSelect(axis, idx, num, name=name), args[1]
+        if op == "SplitV":          # (value, size_splits, split_dim)
+            sizes = consts[_clean(args[1])].reshape(-1).astype(np.int64)
+            axis = int(consts[_clean(args[2])])
+            start = int(sizes[:idx].sum())
+            return _TFAxisSlice(axis, start, int(sizes[idx]),
+                                name=name), args[0]
+        if op == "Unpack":
+            axis = int(nd.attr["axis"].i)
+            return _TFUnstack(axis, idx, name=name), args[0]
+        raise ValueError(f"not a multi-output op: {op}")
+
+    @staticmethod
     def _convert(nd: pb.NodeDef, consts: Dict[str, np.ndarray],
                  args: List[str]) -> Tuple[Module, List[str]]:
-        """Return (module, dynamic-input names); const args fold into the
-        module (161-loader registry parity: DL/utils/tf/loaders/)."""
+        """Return (module, dynamic-input refs); const args fold into the
+        module (op-loader registry parity: DL/utils/tf/loaders/, 161 files;
+        this table covers the inference surface — grad/queue/decode ops are
+        handled by Session/input-pipeline code paths, not the graph).
+
+        `args` are raw input refs (may carry ':k' output qualifiers); const
+        lookups use the cleaned base name."""
+        from bigdl_tpu.interop._tf_modules import (_TFConst, _TFFill, _TFPad,
+                                                   _TFPermute,
+                                                   _TFStridedSlice)
+        import bigdl_tpu.ops as ops
         op = nd.op
         a = nd.attr
+        cn = [_clean(x) for x in args]
 
         def const_arg(i):
-            if args[i] not in consts:
+            if cn[i] not in consts:
                 raise ValueError(
                     f"op {op} ({nd.name}) needs a Const input #{i}")
-            return consts[args[i]]
+            return consts[cn[i]]
+
+        def has_const(i):
+            return i < len(cn) and cn[i] in consts
 
         if op == "Const":
             # reached as a *dynamic* operand of a binary op
             # (e.g. Sub(const, x)); emit a constant-producing node
             return _TFConst(consts[nd.name], name=nd.name), []
-        if op in ("Identity", "CheckNumerics", "StopGradient"):
+        if op in ("Identity", "CheckNumerics", "StopGradient", "NoOp",
+                  "PlaceholderWithDefault"):
             return nn.Identity(name=nd.name), args[:1]
         if op == "Conv2D":
             w = const_arg(1)  # HWIO
@@ -179,27 +257,36 @@ class TensorflowLoader:
                 w.reshape(w.shape[0], w.shape[1], 1, cin * mult))})
             return m, args[:1]
         if op == "MatMul":
-            w = const_arg(1)
-            if a["transpose_b"].b:
-                w = w.T
-            m = nn.Linear(int(w.shape[0]), int(w.shape[1]), with_bias=False,
-                          name=nd.name)
-            m.set_params({"weight": jnp.asarray(w)})
-            return m, args[:1]
-        if op == "BiasAdd" or (op in ("Add", "AddV2")
-                               and args[1] in consts
-                               and consts[args[1]].ndim <= 1):
+            if has_const(1):
+                w = const_arg(1)
+                if a["transpose_b"].b:
+                    w = w.T
+                m = nn.Linear(int(w.shape[0]), int(w.shape[1]),
+                              with_bias=False, name=nd.name)
+                m.set_params({"weight": jnp.asarray(w)})
+                return m, args[:1]
+            from bigdl_tpu.interop._tf_modules import _TFMatMul
+            return _TFMatMul(a["transpose_a"].b, a["transpose_b"].b,
+                             name=nd.name), args
+        if op == "BatchMatMul" or op == "BatchMatMulV2":
+            from bigdl_tpu.interop._tf_modules import _TFMatMul
+            return _TFMatMul(a["adj_x"].b, a["adj_y"].b, name=nd.name), args
+        if op in ("BiasAdd", "BiasAddV1") or (
+                op in ("Add", "AddV2") and has_const(1)
+                and consts[cn[1]].ndim <= 1):
             b = const_arg(1).reshape(-1)
             m = nn.CAdd(size=(len(b),), name=nd.name)
             m.set_params({"bias": jnp.asarray(b)})
             return m, args[:1]
         if op in ("Add", "AddV2"):
             return nn.CAddTable(name=nd.name), args
+        if op == "AddN":
+            return nn.CAddTable(name=nd.name), args
         if op == "Sub":
             return nn.CSubTable(name=nd.name), args
         if op == "Mul":
-            if args[1] in consts and consts[args[1]].size == 1:
-                return nn.MulConstant(float(consts[args[1]]),
+            if has_const(1) and consts[cn[1]].size == 1:
+                return nn.MulConstant(float(consts[cn[1]]),
                                       name=nd.name), args[:1]
             return nn.CMulTable(name=nd.name), args
         if op in ("RealDiv", "Div"):
@@ -208,24 +295,60 @@ class TensorflowLoader:
             return nn.CMaxTable(name=nd.name), args
         if op == "Minimum":
             return nn.CMinTable(name=nd.name), args
-        if op == "Relu":
-            return nn.ReLU(name=nd.name), args
-        if op == "Relu6":
-            return nn.ReLU6(name=nd.name), args
-        if op == "Sigmoid":
-            return nn.Sigmoid(name=nd.name), args
-        if op == "Tanh":
-            return nn.Tanh(name=nd.name), args
-        if op == "Softplus":
-            return nn.SoftPlus(name=nd.name), args
-        if op == "Softsign":
-            return nn.SoftSign(name=nd.name), args
-        if op == "Elu":
-            return nn.ELU(name=nd.name), args
-        if op == "Softmax":
-            return nn.SoftMax(name=nd.name), args
-        if op == "LogSoftmax":
-            return nn.LogSoftMax(name=nd.name), args
+
+        # --- activations (1:1 layer modules) ---
+        _ACT = {"Relu": nn.ReLU, "Relu6": nn.ReLU6, "Sigmoid": nn.Sigmoid,
+                "Tanh": nn.Tanh, "Softplus": nn.SoftPlus,
+                "Softsign": nn.SoftSign, "Elu": nn.ELU,
+                "Softmax": nn.SoftMax, "LogSoftmax": nn.LogSoftMax}
+        if op in _ACT:
+            return _ACT[op](name=nd.name), args
+
+        # --- unary elementwise (TF-style op modules) ---
+        _UNARY = {"Abs": ops.Abs, "Ceil": ops.Ceil, "Digamma": ops.Digamma,
+                  "Erf": ops.Erf, "Erfc": ops.Erfc, "Exp": ops.Exp,
+                  "Expm1": ops.Expm1, "Floor": ops.Floor, "Inv": ops.Inv,
+                  "Reciprocal": ops.Inv, "IsFinite": ops.IsFinite,
+                  "IsInf": ops.IsInf, "IsNan": ops.IsNan,
+                  "Lgamma": ops.Lgamma, "Log": nn.Log, "Log1p": ops.Log1p,
+                  "Neg": nn.Negative, "Rint": ops.Rint, "Round": ops.Round,
+                  "Rsqrt": ops.Rsqrt, "Sign": ops.Sign, "Sqrt": ops.Sqrt,
+                  "Square": ops.Square, "LogicalNot": ops.LogicalNot,
+                  "Rank": ops.Rank, "Shape": ops.Shape, "L2Loss": ops.L2Loss}
+        if op in _UNARY:
+            return _UNARY[op](name=nd.name), args
+
+        # --- binary elementwise / comparison ---
+        _BINARY = {"FloorDiv": ops.FloorDiv, "FloorMod": ops.FloorMod,
+                   "Mod": ops.Mod, "TruncateMod": ops.Mod,
+                   "TruncateDiv": ops.TruncateDiv, "Pow": ops.Pow,
+                   "SquaredDifference": ops.SquaredDifference,
+                   "Equal": ops.Equal, "NotEqual": ops.NotEqual,
+                   "Greater": ops.Greater, "GreaterEqual": ops.GreaterEqual,
+                   "Less": ops.Less, "LessEqual": ops.LessEqual,
+                   "LogicalAnd": ops.LogicalAnd, "LogicalOr": ops.LogicalOr}
+        if op in _BINARY:
+            return _BINARY[op](name=nd.name), args
+        if op == "ApproximateEqual":
+            tol = float(a["tolerance"].f) if "tolerance" in a else 1e-5
+            return ops.ApproximateEqual(tol, name=nd.name), args
+
+        # --- reductions (axis operand is const in frozen graphs) ---
+        _REDUCE = {"Sum": ops.Sum, "Prod": ops.Prod, "Max": ops.Max,
+                   "All": ops.All, "Any": ops.Any}
+        if op in _REDUCE:
+            axes = const_arg(1).reshape(-1).tolist()
+            axis = int(axes[0]) if len(axes) == 1 else tuple(
+                int(x) for x in axes)
+            return _REDUCE[op](axis=axis, keep_dims=bool(a["keep_dims"].b),
+                               name=nd.name), args[:1]
+        if op == "Mean":
+            axes = const_arg(1).reshape(-1).tolist()
+            keep = a["keep_dims"].b
+            return nn.Mean(dimension=tuple(int(x) for x in axes),
+                           squeeze=not keep, name=nd.name), args[:1]
+
+        # --- pooling / normalization ---
         if op in ("MaxPool", "AvgPool"):
             ksize = list(a["ksize"].list.i)
             strides = list(a["strides"].list.i)
@@ -235,10 +358,10 @@ class TensorflowLoader:
                 nn.SpatialAveragePooling
             return cls(int(ksize[2]), int(ksize[1]), int(strides[2]),
                        int(strides[1]), pad, pad, name=nd.name), args
-        if op == "FusedBatchNorm" or op == "FusedBatchNormV2":
+        if op in ("FusedBatchNorm", "FusedBatchNormV2", "FusedBatchNormV3"):
             scale, offset = const_arg(1), const_arg(2)
             mean, var = const_arg(3), const_arg(4)
-            eps = a["epsilon"].f or 1e-3
+            eps = a["epsilon"].f if "epsilon" in a else 1e-3
             m = nn.SpatialBatchNormalization(len(scale), eps=float(eps),
                                              name=nd.name)
             m.set_params({"weight": jnp.asarray(scale),
@@ -247,6 +370,19 @@ class TensorflowLoader:
                              "var": jnp.asarray(var)}}
             m.evaluate()
             return m, args[:1]
+        if op == "LRN":
+            # TF: out = in / (bias + alpha*sqsum)^beta over 2r+1 channels;
+            # our layer divides alpha by size (torch convention), so scale
+            # alpha up by size (reference: utils/tf/loaders/LRN.scala)
+            r = int(a["depth_radius"].i) if "depth_radius" in a else 5
+            size = 2 * r + 1
+            alpha = float(a["alpha"].f) if "alpha" in a else 1.0
+            beta = float(a["beta"].f) if "beta" in a else 0.5
+            bias = float(a["bias"].f) if "bias" in a else 1.0
+            return nn.SpatialCrossMapLRN(size, alpha * size, beta, bias,
+                                         name=nd.name), args
+
+        # --- shape / array ops ---
         if op == "Reshape":
             shape = const_arg(1).reshape(-1).tolist()
             return nn.InferReshape([int(s) for s in shape],
@@ -258,20 +394,95 @@ class TensorflowLoader:
         if op == "ExpandDims":
             dim = int(const_arg(1))
             return nn.Unsqueeze(dim, name=nd.name), args[:1]
-        if op == "Mean":
-            axes = const_arg(1).reshape(-1).tolist()
-            keep = a["keep_dims"].b
-            return nn.Mean(dimension=tuple(int(x) for x in axes),
-                           squeeze=not keep, name=nd.name), args[:1]
         if op == "ConcatV2":
             axis = int(const_arg(len(args) - 1))
             return nn.JoinTable(axis, name=nd.name), args[:-1]
-        if op == "Pad":
+        if op == "Concat":        # v1: axis first
+            axis = int(const_arg(0))
+            return nn.JoinTable(axis, name=nd.name), args[1:]
+        if op == "Pack":
+            axis = int(a["axis"].i)
+            from bigdl_tpu.nn import Pack
+            return Pack(axis, name=nd.name), args
+        if op in ("Pad", "PadV2"):
             paddings = const_arg(1)
             return _TFPad(paddings.tolist(), name=nd.name), args[:1]
         if op == "Transpose":
             perm = const_arg(1).reshape(-1).tolist()
             return _TFPermute([int(p) for p in perm], name=nd.name), args[:1]
+        if op == "Cast":
+            dst = a["DstT"].type
+            dt = _DTYPES.get(dst)
+            if dt is None:
+                raise ValueError(f"Cast ({nd.name}): unsupported dtype {dst}")
+            return ops.Cast(dt, name=nd.name), args
+        if op == "Fill":
+            dims = const_arg(0).reshape(-1).tolist()
+            return _TFFill(dims, name=nd.name), args[1:2]
+        if op == "Range":
+            if all(has_const(i) for i in range(3)):
+                start, limit, delta = (const_arg(0).item(),
+                                       const_arg(1).item(),
+                                       const_arg(2).item())
+                arr = np.arange(start, limit, delta,
+                                dtype=const_arg(0).dtype)
+                return _TFConst(arr, name=nd.name), []
+            return ops.RangeOps(name=nd.name), args
+        if op in ("Gather", "GatherV2"):
+            axis = int(const_arg(2)) if op == "GatherV2" and len(args) > 2 \
+                else 0
+            return ops.Gather(axis=axis, name=nd.name), args[:2]
+        if op == "OneHot":
+            depth = int(const_arg(1))
+            on = float(const_arg(2)) if len(args) > 2 else 1.0
+            off = float(const_arg(3)) if len(args) > 3 else 0.0
+            axis = int(a["axis"].i) if "axis" in a else -1
+            return ops.OneHot(depth, on, off, axis, name=nd.name), args[:1]
+        if op == "Select":
+            return ops.Select(name=nd.name), args
+        if op == "Slice":
+            begin = const_arg(1).reshape(-1).tolist()
+            size = const_arg(2).reshape(-1).tolist()
+            return ops.Slice([int(b) for b in begin],
+                             [int(s) for s in size], name=nd.name), args[:1]
+        if op == "StridedSlice":
+            begin = const_arg(1).reshape(-1).tolist()
+            end = const_arg(2).reshape(-1).tolist()
+            strides = const_arg(3).reshape(-1).tolist() if len(args) > 3 \
+                else [1] * len(begin)
+            return _TFStridedSlice(
+                begin, end, strides, a["begin_mask"].i, a["end_mask"].i,
+                a["ellipsis_mask"].i, a["new_axis_mask"].i,
+                a["shrink_axis_mask"].i, name=nd.name), args[:1]
+        if op == "Tile":
+            return ops.Tile(name=nd.name), args
+        if op == "ArgMax":
+            if has_const(1):
+                return ops.ArgMax(axis=int(const_arg(1)),
+                                  name=nd.name), args[:1]
+            # ops.ArgMax accepts a dynamic Table(x, axis) input
+            return ops.ArgMax(name=nd.name), args[:2]
+        if op in ("TopKV2", "TopK"):
+            k = int(const_arg(1)) if op == "TopKV2" else int(a["k"].i)
+            return ops.TopK(k, name=nd.name), args[:1]
+        if op == "InTopK":
+            return ops.InTopK(int(a["k"].i), name=nd.name), args
+        if op == "SegmentSum":
+            return ops.SegmentSum(name=nd.name), args
+        if op == "ResizeBilinear":
+            return ops.ResizeBilinearOps(bool(a["align_corners"].b),
+                                         name=nd.name), args
+        if op == "SoftmaxCrossEntropyWithLogits":
+            return ops.CrossEntropy(name=nd.name), args
+        if op == "RandomUniform":
+            return ops.RandomUniform(name=nd.name), args
+        if op == "Assert":
+            return ops.Assert(name=nd.name), args[:1]
+        if op == "VariableV2" or op == "Variable":
+            raise ValueError(
+                f"graph contains an unfrozen variable '{nd.name}'; freeze "
+                "the graph (convert variables to consts) before import, or "
+                "use interop.tf_session.Session for training graphs")
         raise ValueError(
             f"unsupported TF op '{op}' (node {nd.name}); extend "
             "TensorflowLoader._convert (op-loader registry parity: "

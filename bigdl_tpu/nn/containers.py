@@ -19,6 +19,11 @@ from bigdl_tpu.utils.table import T, Table
 
 
 class Container(Module):
+    # bumped on every structural mutation anywhere; predictor caches store
+    # the value they were built at, so a nested add() invalidates ancestors
+    # whose _params dict was extended in place (identity check can't see it)
+    _structure_epoch = 0
+
     def __init__(self, name: Optional[str] = None):
         super().__init__(name)
         self.children: List[Module] = []
@@ -29,6 +34,7 @@ class Container(Module):
         self.children.append(module)
         self._child_keys.append(key)
         self._predictor_cache = None  # structure changed
+        Container._structure_epoch += 1
         if self._params is not None:
             # params already materialized (e.g. after a predict): extend
             # them for the new child so the facade keeps working

@@ -222,13 +222,16 @@ class Module:
         Both are replaced — never mutated — on update (set_params, forward),
         so identity checks are sound. batch_size is host-side batching only
         and is updated on the cached predictor instead of keying it."""
+        from bigdl_tpu.nn.containers import Container
         from bigdl_tpu.optim.predictor import LocalPredictor
         cached = getattr(self, "_predictor_cache", None)
+        epoch = Container._structure_epoch
         if (cached is None or cached[0] is not self._params
-                or cached[1] is not self._state):
+                or cached[1] is not self._state or cached[3] != epoch):
             pred = LocalPredictor(self, batch_size=batch_size)
             # ensure_params() inside may have just materialized them
-            cached = (self._params, self._state, pred)
+            cached = (self._params, self._state, pred,
+                      Container._structure_epoch)
             self._predictor_cache = cached
         cached[2].batch_size = batch_size
         return cached[2]

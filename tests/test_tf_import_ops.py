@@ -1,0 +1,448 @@
+"""TF GraphDef import: expanded op-loader coverage.
+
+Parity target: the reference's 161-file loader registry
+(`spark/dl/src/main/scala/com/intel/analytics/bigdl/utils/tf/loaders/`).
+Each test builds a small GraphDef by hand (as the reference's loader specs
+build graphs with its TFGraph DSL), imports it, and checks numerics against
+numpy/TF-semantics computed by hand. Multi-output ops exercise the ':k'
+output-qualifier path.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from bigdl_tpu.interop.tensorflow import TensorflowLoader, ndarray_to_tensor
+from bigdl_tpu.proto import tf_graph_pb2 as tpb
+
+RS = np.random.RandomState(7)
+
+
+def _const(gd, name, arr):
+    n = gd.node.add(name=name, op="Const")
+    n.attr["value"].tensor.CopyFrom(ndarray_to_tensor(np.asarray(arr)))
+    return name
+
+
+def _graph(*, outs, ins=("x",), build=None):
+    gd = tpb.GraphDef()
+    for i in ins:
+        gd.node.add(name=i, op="Placeholder")
+    build(gd)
+    return TensorflowLoader.from_graph_def(gd, list(ins), list(outs))
+
+
+def _run(g, *xs):
+    out = g.forward(jnp.asarray(xs[0]) if len(xs) == 1
+                    else [jnp.asarray(v) for v in xs])
+    return np.asarray(out)
+
+
+X = RS.randn(3, 4).astype(np.float32)
+
+
+class TestUnaryOps:
+    @pytest.mark.parametrize("op,fn", [
+        ("Abs", np.abs), ("Ceil", np.ceil), ("Exp", np.exp),
+        ("Expm1", np.expm1), ("Floor", np.floor),
+        ("Neg", np.negative),
+        ("Rint", np.rint), ("Round", np.round),
+        ("Sign", np.sign), ("Square", np.square),
+    ])
+    def test_unary(self, op, fn):
+        def b(gd):
+            gd.node.add(name="y", op=op, input=["x"])
+        g = _graph(outs=["y"], build=b)
+        np.testing.assert_allclose(_run(g, X), fn(X), rtol=1e-5, atol=1e-5)
+
+    @pytest.mark.parametrize("op,fn", [
+        ("Log", np.log), ("Log1p", np.log1p),
+        ("Rsqrt", lambda v: 1 / np.sqrt(v)), ("Sqrt", np.sqrt),
+    ])
+    def test_unary_positive_domain(self, op, fn):
+        x = np.abs(X) + 0.5
+        def b(gd):
+            gd.node.add(name="y", op=op, input=["x"])
+        g = _graph(outs=["y"], build=b)
+        np.testing.assert_allclose(_run(g, x), fn(x), rtol=1e-5, atol=1e-5)
+
+    def test_shape_rank(self):
+        def b(gd):
+            gd.node.add(name="s", op="Shape", input=["x"])
+            gd.node.add(name="r", op="Rank", input=["x"])
+        g = _graph(outs=["s", "r"], build=b)
+        out = g.forward(jnp.asarray(X))
+        np.testing.assert_array_equal(np.asarray(out[1]), [3, 4])
+        assert int(out[2]) == 2
+
+    def test_l2loss(self):
+        def b(gd):
+            gd.node.add(name="y", op="L2Loss", input=["x"])
+        np.testing.assert_allclose(_run(_graph(outs=["y"], build=b), X),
+                                   (X * X).sum() / 2, rtol=1e-5)
+
+
+class TestBinaryOps:
+    @pytest.mark.parametrize("op,fn", [
+        ("Pow", np.power),
+        ("SquaredDifference", lambda a, b: np.square(a - b)),
+        ("FloorDiv", lambda a, b: np.floor_divide(a, b)),
+        ("Equal", lambda a, b: a == b),
+        ("Greater", lambda a, b: a > b),
+        ("LessEqual", lambda a, b: a <= b),
+    ])
+    def test_binary(self, op, fn):
+        a = np.abs(X) + 1 if op == "Pow" else X
+        b_arr = RS.rand(3, 4).astype(np.float32) + 1.0
+
+        def b(gd):
+            _const(gd, "c", b_arr)
+            gd.node.add(name="y", op=op, input=["x", "c"])
+        g = _graph(outs=["y"], build=b)
+        np.testing.assert_allclose(_run(g, a), fn(a, b_arr),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_addn(self):
+        def b(gd):
+            _const(gd, "c1", np.full((3, 4), 2.0, np.float32))
+            _const(gd, "c2", np.full((3, 4), 3.0, np.float32))
+            gd.node.add(name="y", op="AddN", input=["x", "c1", "c2"])
+        np.testing.assert_allclose(_run(_graph(outs=["y"], build=b), X),
+                                   X + 5.0, rtol=1e-5)
+
+    def test_biasadd_v1(self):
+        bias = RS.randn(4).astype(np.float32)
+
+        def b(gd):
+            _const(gd, "b", bias)
+            gd.node.add(name="y", op="BiasAddV1", input=["x", "b"])
+        np.testing.assert_allclose(_run(_graph(outs=["y"], build=b), X),
+                                   X + bias, rtol=1e-5)
+
+
+class TestReductions:
+    @pytest.mark.parametrize("op,fn", [
+        ("Sum", np.sum), ("Prod", np.prod), ("Max", np.max),
+    ])
+    def test_reduce_axis(self, op, fn):
+        def b(gd):
+            _const(gd, "ax", np.asarray([1], np.int32))
+            n = gd.node.add(name="y", op=op, input=["x", "ax"])
+            n.attr["keep_dims"].b = False
+        np.testing.assert_allclose(_run(_graph(outs=["y"], build=b), X),
+                                   fn(X, axis=1), rtol=1e-5)
+
+    def test_reduce_multi_axis_keepdims(self):
+        def b(gd):
+            _const(gd, "ax", np.asarray([0, 1], np.int32))
+            n = gd.node.add(name="y", op="Sum", input=["x", "ax"])
+            n.attr["keep_dims"].b = True
+        out = _run(_graph(outs=["y"], build=b), X)
+        assert out.shape == (1, 1)
+        np.testing.assert_allclose(out, X.sum(keepdims=True).reshape(1, 1),
+                                   rtol=1e-5)
+
+    def test_all_any(self):
+        xb = (X > 0)
+
+        def b(gd):
+            _const(gd, "ax", np.asarray([1], np.int32))
+            gd.node.add(name="a", op="All", input=["x", "ax"])
+            gd.node.add(name="o", op="Any", input=["x", "ax"])
+        g = _graph(outs=["a", "o"], build=b)
+        out = g.forward(jnp.asarray(xb))
+        np.testing.assert_array_equal(np.asarray(out[1]), xb.all(axis=1))
+        np.testing.assert_array_equal(np.asarray(out[2]), xb.any(axis=1))
+
+
+class TestArrayOps:
+    def test_cast(self):
+        def b(gd):
+            n = gd.node.add(name="y", op="Cast", input=["x"])
+            n.attr["DstT"].type = tpb.DT_INT32
+        out = _run(_graph(outs=["y"], build=b), X)
+        assert out.dtype == np.int32
+
+    def test_fill_dynamic_value(self):
+        def b(gd):
+            _const(gd, "dims", np.asarray([2, 3], np.int32))
+            gd.node.add(name="y", op="Fill", input=["dims", "x"])
+        g = _graph(outs=["y"], build=b)
+        out = np.asarray(g.forward(jnp.asarray(np.float32(7.5))))
+        np.testing.assert_allclose(out, np.full((2, 3), 7.5))
+
+    def test_range_const(self):
+        def b(gd):
+            _const(gd, "s", np.asarray(2, np.int32))
+            _const(gd, "l", np.asarray(14, np.int32))
+            _const(gd, "d", np.asarray(3, np.int32))
+            gd.node.add(name="r", op="Range", input=["s", "l", "d"])
+            gd.node.add(name="y", op="Add", input=["x", "r"])
+        g = _graph(outs=["y"], build=b)
+        x = np.zeros(4, np.float32)
+        np.testing.assert_allclose(_run(g, x), np.arange(2, 14, 3))
+
+    def test_gather(self):
+        table = RS.randn(10, 4).astype(np.float32)
+
+        def b(gd):
+            _const(gd, "t", table)
+            gd.node.add(name="y", op="Gather", input=["t", "x"])
+        g = _graph(outs=["y"], build=b)
+        idx = np.asarray([0, 3, 7], np.int32)
+        np.testing.assert_allclose(_run(g, idx), table[idx], rtol=1e-6)
+
+    def test_onehot(self):
+        def b(gd):
+            _const(gd, "d", np.asarray(5, np.int32))
+            _const(gd, "on", np.asarray(1.0, np.float32))
+            _const(gd, "off", np.asarray(0.0, np.float32))
+            n = gd.node.add(name="y", op="OneHot",
+                            input=["x", "d", "on", "off"])
+            n.attr["axis"].i = -1
+        g = _graph(outs=["y"], build=b)
+        idx = np.asarray([0, 2, 4], np.int32)
+        np.testing.assert_allclose(_run(g, idx), np.eye(5)[idx])
+
+    def test_select(self):
+        a = np.full((3, 4), 1.0, np.float32)
+        c = np.full((3, 4), -1.0, np.float32)
+
+        def b(gd):
+            _const(gd, "a", a)
+            _const(gd, "c", c)
+            gd.node.add(name="cond", op="Greater", input=["x", "a"])
+            gd.node.add(name="y", op="Select", input=["cond", "x", "c"])
+        g = _graph(outs=["y"], build=b)
+        want = np.where(X > 1.0, X, -1.0)
+        np.testing.assert_allclose(_run(g, X), want, rtol=1e-6)
+
+    def test_slice(self):
+        def b(gd):
+            _const(gd, "b", np.asarray([1, 0], np.int32))
+            _const(gd, "s", np.asarray([2, -1], np.int32))
+            gd.node.add(name="y", op="Slice", input=["x", "b", "s"])
+        np.testing.assert_allclose(_run(_graph(outs=["y"], build=b), X),
+                                   X[1:3, :], rtol=1e-6)
+
+    def test_strided_slice_masks(self):
+        def b(gd):
+            _const(gd, "b", np.asarray([0, 1], np.int32))
+            _const(gd, "e", np.asarray([0, 3], np.int32))
+            _const(gd, "s", np.asarray([1, 1], np.int32))
+            n = gd.node.add(name="y", op="StridedSlice",
+                            input=["x", "b", "e", "s"])
+            n.attr["begin_mask"].i = 1   # dim0 begin ignored
+            n.attr["end_mask"].i = 1     # dim0 end ignored
+        np.testing.assert_allclose(_run(_graph(outs=["y"], build=b), X),
+                                   X[:, 1:3], rtol=1e-6)
+
+    def test_strided_slice_shrink(self):
+        def b(gd):
+            _const(gd, "b", np.asarray([1, 0], np.int32))
+            _const(gd, "e", np.asarray([2, 4], np.int32))
+            _const(gd, "s", np.asarray([1, 1], np.int32))
+            n = gd.node.add(name="y", op="StridedSlice",
+                            input=["x", "b", "e", "s"])
+            n.attr["shrink_axis_mask"].i = 1  # dim0 becomes a scalar index
+        out = _run(_graph(outs=["y"], build=b), X)
+        np.testing.assert_allclose(out, X[1], rtol=1e-6)
+
+    def test_tile(self):
+        def b(gd):
+            _const(gd, "m", np.asarray([2, 1], np.int32))
+            gd.node.add(name="y", op="Tile", input=["x", "m"])
+        np.testing.assert_allclose(_run(_graph(outs=["y"], build=b), X),
+                                   np.tile(X, (2, 1)), rtol=1e-6)
+
+    def test_pack(self):
+        def b(gd):
+            _const(gd, "c", X + 1.0)
+            n = gd.node.add(name="y", op="Pack", input=["x", "c"])
+            n.attr["axis"].i = 0
+        out = _run(_graph(outs=["y"], build=b), X)
+        np.testing.assert_allclose(out, np.stack([X, X + 1.0]), rtol=1e-6)
+
+    def test_argmax(self):
+        def b(gd):
+            _const(gd, "ax", np.asarray(1, np.int32))
+            gd.node.add(name="y", op="ArgMax", input=["x", "ax"])
+        np.testing.assert_array_equal(_run(_graph(outs=["y"], build=b), X),
+                                      X.argmax(axis=1))
+
+    def test_concat_v1(self):
+        def b(gd):
+            _const(gd, "ax", np.asarray(1, np.int32))
+            _const(gd, "c", X)
+            gd.node.add(name="y", op="Concat", input=["ax", "x", "c"])
+        np.testing.assert_allclose(_run(_graph(outs=["y"], build=b), X),
+                                   np.concatenate([X, X], axis=1), rtol=1e-6)
+
+
+class TestMultiOutput:
+    def test_split_outputs(self):
+        def b(gd):
+            _const(gd, "dim", np.asarray(1, np.int32))
+            n = gd.node.add(name="sp", op="Split", input=["dim", "x"])
+            n.attr["num_split"].i = 2
+            gd.node.add(name="y", op="Sub", input=["sp:1", "sp"])
+        g = _graph(outs=["y"], build=b)
+        want = X[:, 2:] - X[:, :2]
+        np.testing.assert_allclose(_run(g, X), want, rtol=1e-6)
+
+    def test_splitv_outputs(self):
+        def b(gd):
+            _const(gd, "sizes", np.asarray([1, 3], np.int32))
+            _const(gd, "dim", np.asarray(1, np.int32))
+            n = gd.node.add(name="sp", op="SplitV",
+                            input=["x", "sizes", "dim"])
+            n.attr["num_split"].i = 2
+        g = _graph(outs=["sp:1"], build=b)
+        np.testing.assert_allclose(_run(g, X), X[:, 1:], rtol=1e-6)
+
+    def test_unpack_outputs(self):
+        def b(gd):
+            n = gd.node.add(name="u", op="Unpack", input=["x"])
+            n.attr["num"].i = 3
+            n.attr["axis"].i = 0
+            gd.node.add(name="y", op="Add", input=["u:0", "u:2"])
+        g = _graph(outs=["y"], build=b)
+        np.testing.assert_allclose(_run(g, X), X[0] + X[2], rtol=1e-6)
+
+    def test_topk_v2_indices(self):
+        def b(gd):
+            _const(gd, "k", np.asarray(2, np.int32))
+            gd.node.add(name="t", op="TopKV2", input=["x", "k"])
+        g_vals = _graph(outs=["t"], build=b)
+        g_idx = _graph(outs=["t:1"], build=b)
+        out_v = _run(g_vals, X)
+        out_i = _run(g_idx, X)
+        want_i = np.argsort(-X, axis=1)[:, :2]
+        np.testing.assert_array_equal(out_i, want_i)
+        np.testing.assert_allclose(
+            out_v, np.take_along_axis(X, want_i, axis=1), rtol=1e-6)
+
+
+class TestImportedGraphJit:
+    def test_imported_graph_is_jittable(self):
+        """Const spec operands become concrete closures, so the whole
+        imported graph traces into one XLA computation."""
+        def b(gd):
+            _const(gd, "b", np.asarray([0, 1], np.int32))
+            _const(gd, "e", np.asarray([0, 3], np.int32))
+            _const(gd, "s", np.asarray([1, 1], np.int32))
+            n = gd.node.add(name="sl", op="StridedSlice",
+                            input=["x", "b", "e", "s"])
+            n.attr["begin_mask"].i = 1
+            n.attr["end_mask"].i = 1
+            _const(gd, "m", np.asarray([1, 2], np.int32))
+            gd.node.add(name="t", op="Tile", input=["sl", "m"])
+            gd.node.add(name="y", op="Exp", input=["t"])
+        g = _graph(outs=["y"], build=b)
+        from bigdl_tpu.nn.module import functional_apply
+        params = g.ensure_params()
+
+        @jax.jit
+        def f(p, x):
+            out, _ = functional_apply(g, p, x, training=False)
+            return out
+
+        out = np.asarray(f(params, jnp.asarray(X)))
+        np.testing.assert_allclose(out, np.exp(np.tile(X[:, 1:3], (1, 2))),
+                                   rtol=1e-5)
+
+    def test_frozen_inception_style_graph(self):
+        """Structural test at Inception-v1 scale: stem conv + LRN + a full
+        4-branch inception block (1x1 / 1x1-3x3 / 1x1-5x5 / pool-1x1) +
+        ConcatV2 + global Mean + MatMul/BiasAdd/Softmax head, all frozen.
+        Mirrors the reference's Inception import fixture intent
+        (TensorflowLoaderSpec 'inception')."""
+        gd = tpb.GraphDef()
+        gd.node.add(name="input", op="Placeholder")
+
+        def conv(gd, name, src, cin, cout, k, stride=1):
+            w = (RS.randn(k, k, cin, cout).astype(np.float32)
+                 / np.sqrt(k * k * cin))
+            _const(gd, name + "_w", w)
+            n = gd.node.add(name=name, op="Conv2D", input=[src, name + "_w"])
+            n.attr["strides"].list.i.extend([1, stride, stride, 1])
+            n.attr["padding"].s = b"SAME"
+            b = RS.randn(cout).astype(np.float32) * 0.1
+            _const(gd, name + "_b", b)
+            gd.node.add(name=name + "_bias", op="BiasAdd",
+                        input=[name, name + "_b"])
+            gd.node.add(name=name + "_relu", op="Relu",
+                        input=[name + "_bias"])
+            return name + "_relu"
+
+        stem = conv(gd, "stem", "input", 3, 16, 7, 2)
+        pool = gd.node.add(name="pool1", op="MaxPool", input=[stem])
+        pool.attr["ksize"].list.i.extend([1, 3, 3, 1])
+        pool.attr["strides"].list.i.extend([1, 2, 2, 1])
+        pool.attr["padding"].s = b"SAME"
+        lrn = gd.node.add(name="lrn", op="LRN", input=["pool1"])
+        lrn.attr["depth_radius"].i = 2
+        lrn.attr["alpha"].f = 2e-5
+        lrn.attr["beta"].f = 0.75
+        lrn.attr["bias"].f = 1.0
+
+        b1 = conv(gd, "b1", "lrn", 16, 8, 1)
+        b2a = conv(gd, "b2a", "lrn", 16, 8, 1)
+        b2 = conv(gd, "b2", b2a, 8, 12, 3)
+        b3a = conv(gd, "b3a", "lrn", 16, 4, 1)
+        b3 = conv(gd, "b3", b3a, 4, 8, 5)
+        bp = gd.node.add(name="bpool", op="MaxPool", input=["lrn"])
+        bp.attr["ksize"].list.i.extend([1, 3, 3, 1])
+        bp.attr["strides"].list.i.extend([1, 1, 1, 1])
+        bp.attr["padding"].s = b"SAME"
+        b4 = conv(gd, "b4", "bpool", 16, 8, 1)
+        _const(gd, "cdim", np.asarray(3, np.int32))
+        gd.node.add(name="mixed", op="ConcatV2",
+                    input=[b1, b2, b3, b4, "cdim"])
+
+        _const(gd, "gap_ax", np.asarray([1, 2], np.int32))
+        gap = gd.node.add(name="gap", op="Mean", input=["mixed", "gap_ax"])
+        gap.attr["keep_dims"].b = False
+        wfc = RS.randn(36, 10).astype(np.float32) / 6.0
+        _const(gd, "fc_w", wfc)
+        gd.node.add(name="fc", op="MatMul", input=["gap", "fc_w"])
+        _const(gd, "fc_b", RS.randn(10).astype(np.float32) * 0.1)
+        gd.node.add(name="logits", op="BiasAdd", input=["fc", "fc_b"])
+        gd.node.add(name="prob", op="Softmax", input=["logits"])
+
+        g = TensorflowLoader.from_graph_def(gd, ["input"], ["prob"])
+        x = RS.rand(2, 64, 64, 3).astype(np.float32)
+
+        from bigdl_tpu.nn.module import functional_apply
+        params = g.ensure_params()
+
+        @jax.jit
+        def f(p, xx):
+            out, _ = functional_apply(g, p, xx, training=False)
+            return out
+
+        out = np.asarray(f(params, jnp.asarray(x)))
+        assert out.shape == (2, 10)
+        np.testing.assert_allclose(out.sum(axis=1), np.ones(2), rtol=1e-5)
+        assert np.isfinite(out).all()
+        # deterministic: second call identical
+        np.testing.assert_array_equal(
+            out, np.asarray(f(params, jnp.asarray(x))))
+
+    def test_lrn_matches_formula(self):
+        x = RS.rand(2, 4, 4, 8).astype(np.float32)
+
+        def b(gd):
+            n = gd.node.add(name="y", op="LRN", input=["x"])
+            n.attr["depth_radius"].i = 2
+            n.attr["alpha"].f = 1e-3
+            n.attr["beta"].f = 0.75
+            n.attr["bias"].f = 1.0
+        g = _graph(outs=["y"], build=b)
+        # reference formula: x / (bias + alpha * sum_window(x^2))^beta
+        sq = x * x
+        pad = np.pad(sq, [(0, 0), (0, 0), (0, 0), (2, 2)])
+        win = sum(pad[..., i:i + 8] for i in range(5))
+        want = x / np.power(1.0 + 1e-3 * win, 0.75)
+        np.testing.assert_allclose(_run(g, x), want, rtol=1e-4, atol=1e-5)
