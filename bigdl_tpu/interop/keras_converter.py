@@ -129,18 +129,31 @@ class DefinitionLoader:
                                input_length=cfg.get("input_length"),
                                input_shape=input_shape, name=name)
         if cls == "LSTM":
-            return K.LSTM(cfg["output_dim"],
+            return K.LSTM(_units(cfg),
+                          activation=cfg.get("activation", "tanh"),
+                          inner_activation=_inner_act(cfg),
                           return_sequences=cfg.get("return_sequences", False),
+                          go_backwards=cfg.get("go_backwards", False),
                           input_shape=input_shape, name=name)
         if cls == "GRU":
-            return K.GRU(cfg["output_dim"],
+            return K.GRU(_units(cfg),
+                         activation=cfg.get("activation", "tanh"),
+                         inner_activation=_inner_act(cfg),
                          return_sequences=cfg.get("return_sequences", False),
+                         go_backwards=cfg.get("go_backwards", False),
                          input_shape=input_shape, name=name)
         if cls == "SimpleRNN":
             return K.SimpleRNN(
-                cfg["output_dim"],
+                _units(cfg),
+                activation=cfg.get("activation", "tanh"),
                 return_sequences=cfg.get("return_sequences", False),
+                go_backwards=cfg.get("go_backwards", False),
                 input_shape=input_shape, name=name)
+        if cls == "Bidirectional":
+            inner = DefinitionLoader._layer(cfg["layer"])
+            return K.Bidirectional(inner,
+                                   merge_mode=cfg.get("merge_mode", "concat"),
+                                   input_shape=input_shape, name=name)
         if cls == "BatchNormalization":
             return K.BatchNormalization(epsilon=cfg.get("epsilon", 1e-3),
                                         momentum=cfg.get("momentum", 0.99),
@@ -156,6 +169,20 @@ def _act(name: Optional[str]):
     if name in (None, "linear"):
         return None
     return name
+
+
+def _units(cfg: Dict[str, Any]) -> int:
+    """keras1 'output_dim' / keras2 'units'."""
+    if "output_dim" in cfg:
+        return cfg["output_dim"]
+    return cfg["units"]
+
+
+def _inner_act(cfg: Dict[str, Any]) -> str:
+    """keras1 'inner_activation' / keras2 'recurrent_activation'; the
+    keras-1 default is hard_sigmoid."""
+    return cfg.get("inner_activation",
+                   cfg.get("recurrent_activation", "hard_sigmoid"))
 
 
 class WeightLoader:
@@ -224,9 +251,99 @@ class WeightLoader:
             p = _set_named(p, "weight", w[0].reshape(-1))
             p = _set_named(p, "bias", w[1].reshape(-1))
             return p
+        if cls in ("SimpleRNN", "LSTM", "GRU"):
+            return _replace_cells(p, [_convert_cell(cls, w)])
+        if cls == "Bidirectional":
+            # keras stores forward weights then backward weights
+            # (PY/keras/converter.py:537-551 gate-order parity)
+            inner = type(layer.inner).__name__
+            half = len(w) // 2
+            return _replace_cells(p, [_convert_cell(inner, w[:half]),
+                                      _convert_cell(inner, w[half:])])
         raise ValueError(
             f"Keras weight import not implemented for {cls} "
             f"(shapes {[a.shape for a in w]})")
+
+
+def _convert_cell(cls: str, w: List[np.ndarray]) -> Dict[str, np.ndarray]:
+    """Keras recurrent weight arrays -> this framework's cell param leaves.
+
+    Keras 1.2.2 stores per-gate (W, U, b) triples: LSTM gate group order is
+    i, c, f, o; GRU is z, r, h (reference WeightsConverter.convert_lstm /
+    convert_gru, PY/keras/converter.py:222/:236 index the same way). The
+    keras-2 fused 3-array layout (kernel, recurrent_kernel, bias) is also
+    accepted: LSTM columns are already i, f, c, o — this framework's
+    LSTMCell order — and GRU columns z, r, h are re-ordered to r, z | n."""
+    if cls == "SimpleRNN":
+        if len(w) != 3:
+            raise ValueError(f"SimpleRNN expects 3 weight arrays, got {len(w)}")
+        return {"wi": w[0], "wh": w[1], "bias": w[2]}
+    if cls == "LSTM":
+        if len(w) == 12:  # keras1 groups (W,U,b) x (i,c,f,o) -> i,f,c,o
+            return {"wi": np.concatenate([w[0], w[6], w[3], w[9]], axis=1),
+                    "wh": np.concatenate([w[1], w[7], w[4], w[10]], axis=1),
+                    "bias": np.concatenate([w[2], w[8], w[5], w[11]])}
+        if len(w) == 3:  # keras2 fused, columns i,f,c,o match LSTMCell
+            return {"wi": w[0], "wh": w[1], "bias": w[2].reshape(-1)}
+        raise ValueError(f"LSTM expects 12 or 3 weight arrays, got {len(w)}")
+    if cls == "GRU":
+        if len(w) == 9:  # keras1 groups (W,U,b) x (z,r,h) -> r,z | n
+            return {"wi_rz": np.concatenate([w[3], w[0]], axis=1),
+                    "wh_rz": np.concatenate([w[4], w[1]], axis=1),
+                    "b_rz": np.concatenate([w[5], w[2]]),
+                    "wi_n": w[6], "wh_n": w[7], "b_n": w[8]}
+        if len(w) == 3:  # keras2 fused, columns z,r,h
+            if w[2].ndim != 1:
+                raise ValueError(
+                    "GRU reset_after=True (2-D bias) is unsupported; "
+                    "re-save with reset_after=False")
+            h = w[1].shape[0]
+            return {"wi_rz": np.concatenate([w[0][:, h:2 * h], w[0][:, :h]],
+                                            axis=1),
+                    "wh_rz": np.concatenate([w[1][:, h:2 * h], w[1][:, :h]],
+                                            axis=1),
+                    "b_rz": np.concatenate([w[2][h:2 * h], w[2][:h]]),
+                    "wi_n": w[0][:, 2 * h:], "wh_n": w[1][:, 2 * h:],
+                    "b_n": w[2][2 * h:]}
+        raise ValueError(f"GRU expects 9 or 3 weight arrays, got {len(w)}")
+    raise ValueError(f"no recurrent cell conversion for {cls}")
+
+
+_CELL_MARKERS = ("wi", "wi_rz")
+
+
+def _replace_cells(tree, cell_dicts: List[Dict[str, np.ndarray]]):
+    """Replace each recurrent-cell param dict in `tree` (depth-first,
+    insertion order — forward before backward for Bidirectional labors)
+    with the next converted keras cell."""
+    remaining = list(cell_dicts)
+
+    def rec(node):
+        if not isinstance(node, dict):
+            return node
+        if any(m in node and not isinstance(node[m], dict)
+               for m in _CELL_MARKERS):
+            if not remaining:
+                raise ValueError("more cells in model than keras weights")
+            cell = remaining.pop(0)
+            out = dict(node)
+            for k, v in cell.items():
+                if k not in out:
+                    raise ValueError(f"model cell has no param '{k}'")
+                if tuple(out[k].shape) != tuple(np.asarray(v).shape):
+                    raise ValueError(
+                        f"shape mismatch for cell param {k}: model "
+                        f"{out[k].shape} vs keras {np.asarray(v).shape}")
+                out[k] = jnp.asarray(v)
+            return out
+        return {k: rec(v) for k, v in node.items()}
+
+    new = rec(tree)
+    if remaining:
+        raise ValueError(
+            f"{len(remaining)} keras cell weight groups had no matching "
+            "cell params in the model")
+    return new
 
 
 def _set_named(tree, leaf_name: str, value):

@@ -13,11 +13,13 @@ from bigdl_tpu.keras.topology import KerasLayer
 
 class _KerasRecurrent(KerasLayer):
     def __init__(self, output_dim: int, activation="tanh",
+                 inner_activation="hard_sigmoid",
                  return_sequences: bool = False, go_backwards: bool = False,
                  input_shape=None, name=None):
         super().__init__(input_shape, name)
         self.output_dim = output_dim
         self.activation = activation
+        self.inner_activation = inner_activation
         self.return_sequences = return_sequences
         self.go_backwards = go_backwards
 
@@ -46,12 +48,20 @@ class SimpleRNN(_KerasRecurrent):
 
 class LSTM(_KerasRecurrent):
     def _make_cell(self, input_dim):
-        return nn.LSTMCell(input_dim, self.output_dim)
+        from bigdl_tpu.keras.layers import _activation_fn
+        return nn.LSTMCell(input_dim, self.output_dim,
+                           activation=_activation_fn(self.activation),
+                           inner_activation=_activation_fn(
+                               self.inner_activation))
 
 
 class GRU(_KerasRecurrent):
     def _make_cell(self, input_dim):
-        return nn.GRUCell(input_dim, self.output_dim)
+        from bigdl_tpu.keras.layers import _activation_fn
+        return nn.GRUCell(input_dim, self.output_dim,
+                          activation=_activation_fn(self.activation),
+                          inner_activation=_activation_fn(
+                              self.inner_activation))
 
 
 class ConvLSTM2D(KerasLayer):

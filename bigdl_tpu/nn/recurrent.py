@@ -79,10 +79,13 @@ class LSTMCell(Cell):
     (default 0.0, matching the reference's uniform init)."""
 
     def __init__(self, input_size: int, hidden_size: int, p: float = 0.0,
-                 forget_bias: float = 0.0, name=None):
+                 forget_bias: float = 0.0, activation=jnp.tanh,
+                 inner_activation=None, name=None):
         super().__init__(name)
         self.input_size, self.hidden_size = input_size, hidden_size
         self.forget_bias = forget_bias
+        self.activation = activation
+        self.inner_activation = inner_activation or jax.nn.sigmoid
 
     def init(self, rng):
         k1, k2, k3 = jax.random.split(rng, 3)
@@ -100,12 +103,12 @@ class LSTMCell(Cell):
         h_prev, c_prev = state
         z = x @ params["wi"] + h_prev @ params["wh"] + params["bias"]
         i, f, g, o = jnp.split(z, 4, axis=-1)
-        i = jax.nn.sigmoid(i)
-        f = jax.nn.sigmoid(f + self.forget_bias)
-        g = jnp.tanh(g)
-        o = jax.nn.sigmoid(o)
+        i = self.inner_activation(i)
+        f = self.inner_activation(f + self.forget_bias)
+        g = self.activation(g)
+        o = self.inner_activation(o)
         c = f * c_prev + i * g
-        h = o * jnp.tanh(c)
+        h = o * self.activation(c)
         return h, (h, c)
 
 
@@ -154,9 +157,12 @@ LSTMPeephole = LSTMPeepholeCell
 class GRUCell(Cell):
     """GRU (DL/nn/GRU.scala); fused [r,z] GEMM + candidate GEMM."""
 
-    def __init__(self, input_size: int, hidden_size: int, p: float = 0.0, name=None):
+    def __init__(self, input_size: int, hidden_size: int, p: float = 0.0,
+                 activation=jnp.tanh, inner_activation=None, name=None):
         super().__init__(name)
         self.input_size, self.hidden_size = input_size, hidden_size
+        self.activation = activation
+        self.inner_activation = inner_activation or jax.nn.sigmoid
 
     def init(self, rng):
         ks = jax.random.split(rng, 6)
@@ -173,9 +179,11 @@ class GRUCell(Cell):
         return jnp.zeros((batch, self.hidden_size), dtype)
 
     def step(self, params, x, h_prev, ctx):
-        rz = jax.nn.sigmoid(x @ params["wi_rz"] + h_prev @ params["wh_rz"] + params["b_rz"])
+        rz = self.inner_activation(
+            x @ params["wi_rz"] + h_prev @ params["wh_rz"] + params["b_rz"])
         r, z = jnp.split(rz, 2, axis=-1)
-        n = jnp.tanh(x @ params["wi_n"] + (r * h_prev) @ params["wh_n"] + params["b_n"])
+        n = self.activation(
+            x @ params["wi_n"] + (r * h_prev) @ params["wh_n"] + params["b_n"])
         h = (1.0 - z) * n + z * h_prev
         return h, h
 

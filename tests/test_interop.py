@@ -329,6 +329,170 @@ layer { name: "f" type: "Flatten" bottom: "data" top: "out" }
                                    atol=1e-6)
 
 
+def _keras1_hard_sigmoid(x):
+    return np.clip(0.2 * x + 0.5, 0.0, 1.0)
+
+
+def _keras1_lstm_ref(x, w, h_dim):
+    """Numpy keras-1.2.2 LSTM (inner_activation=hard_sigmoid), returns the
+    last hidden state. Weight list order: (W,U,b) x (i,c,f,o)."""
+    Wi, Ui, bi, Wc, Uc, bc, Wf, Uf, bf, Wo, Uo, bo = w
+    B = x.shape[0]
+    h = np.zeros((B, h_dim), np.float32)
+    c = np.zeros((B, h_dim), np.float32)
+    for t in range(x.shape[1]):
+        xt = x[:, t]
+        i = _keras1_hard_sigmoid(xt @ Wi + h @ Ui + bi)
+        f = _keras1_hard_sigmoid(xt @ Wf + h @ Uf + bf)
+        g = np.tanh(xt @ Wc + h @ Uc + bc)
+        o = _keras1_hard_sigmoid(xt @ Wo + h @ Uo + bo)
+        c = f * c + i * g
+        h = o * np.tanh(c)
+    return h
+
+
+def _keras1_gru_ref(x, w, h_dim):
+    """Numpy keras-1.2.2 GRU. Weight list order: (W,U,b) x (z,r,h)."""
+    Wz, Uz, bz, Wr, Ur, br, Wh, Uh, bh = w
+    B = x.shape[0]
+    h = np.zeros((B, h_dim), np.float32)
+    for t in range(x.shape[1]):
+        xt = x[:, t]
+        z = _keras1_hard_sigmoid(xt @ Wz + h @ Uz + bz)
+        r = _keras1_hard_sigmoid(xt @ Wr + h @ Ur + br)
+        hh = np.tanh(xt @ Wh + (r * h) @ Uh + bh)
+        h = z * h + (1.0 - z) * hh
+    return h
+
+
+def _write_keras_h5(h5py, path, layers):
+    """layers: list of (layer_name, [(weight_name, array), ...])."""
+    with h5py.File(path, "w") as f:
+        g = f.create_group("model_weights")
+        g.attrs["layer_names"] = [n.encode() for n, _ in layers]
+        for lname, ws in layers:
+            lg = g.create_group(lname)
+            lg.attrs["weight_names"] = [wn.encode() for wn, _ in ws]
+            for wn, arr in ws:
+                lg.create_dataset(wn, data=arr)
+
+
+class TestKerasRecurrentImport:
+    """Recurrent weight import parity (reference WeightsConverter
+    convert_lstm/convert_gru/convert_simplernn, PY/keras/converter.py:218)."""
+
+    IN, HID, T, B = 5, 4, 6, 3
+
+    def _x(self):
+        return np.random.RandomState(0).randn(
+            self.B, self.T, self.IN).astype(np.float32)
+
+    def _lstm_weights(self, seed=7):
+        rng = np.random.RandomState(seed)
+        w = []
+        for _ in range(4):  # gate groups i, c, f, o
+            w += [rng.randn(self.IN, self.HID).astype(np.float32) * 0.4,
+                  rng.randn(self.HID, self.HID).astype(np.float32) * 0.4,
+                  rng.randn(self.HID).astype(np.float32) * 0.1]
+        # reorder to keras list layout (W,U,b) per gate group
+        return w
+
+    def _gru_weights(self, seed=9):
+        rng = np.random.RandomState(seed)
+        w = []
+        for _ in range(3):  # gate groups z, r, h
+            w += [rng.randn(self.IN, self.HID).astype(np.float32) * 0.4,
+                  rng.randn(self.HID, self.HID).astype(np.float32) * 0.4,
+                  rng.randn(self.HID).astype(np.float32) * 0.1]
+        return w
+
+    def test_lstm_import(self, tmp_path):
+        h5py = pytest.importorskip("h5py")
+        jpath = tmp_path / "m.json"
+        jpath.write_text(json.dumps({
+            "class_name": "Sequential",
+            "config": [{"class_name": "LSTM", "config": {
+                "name": "l", "output_dim": self.HID,
+                "batch_input_shape": [None, self.T, self.IN],
+                "return_sequences": False}}]}))
+        w = self._lstm_weights()
+        names = [f"l_{k}_{gate}" for gate in "icfo" for k in ("W", "U", "b")]
+        _write_keras_h5(h5py, str(tmp_path / "w.h5"),
+                        [("l", list(zip(names, w)))])
+        model = load_keras(str(jpath), str(tmp_path / "w.h5"))
+        x = self._x()
+        got = np.asarray(model.forward(jnp.asarray(x), training=False))
+        want = _keras1_lstm_ref(x, w, self.HID)
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+    def test_gru_import(self, tmp_path):
+        h5py = pytest.importorskip("h5py")
+        jpath = tmp_path / "m.json"
+        jpath.write_text(json.dumps({
+            "class_name": "Sequential",
+            "config": [{"class_name": "GRU", "config": {
+                "name": "g", "output_dim": self.HID,
+                "batch_input_shape": [None, self.T, self.IN],
+                "return_sequences": False}}]}))
+        w = self._gru_weights()
+        names = [f"g_{k}_{gate}" for gate in "zrh" for k in ("W", "U", "b")]
+        _write_keras_h5(h5py, str(tmp_path / "w.h5"),
+                        [("g", list(zip(names, w)))])
+        model = load_keras(str(jpath), str(tmp_path / "w.h5"))
+        x = self._x()
+        got = np.asarray(model.forward(jnp.asarray(x), training=False))
+        want = _keras1_gru_ref(x, w, self.HID)
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+    def test_simplernn_import(self, tmp_path):
+        h5py = pytest.importorskip("h5py")
+        jpath = tmp_path / "m.json"
+        jpath.write_text(json.dumps({
+            "class_name": "Sequential",
+            "config": [{"class_name": "SimpleRNN", "config": {
+                "name": "r", "output_dim": self.HID,
+                "batch_input_shape": [None, self.T, self.IN],
+                "return_sequences": False}}]}))
+        rng = np.random.RandomState(3)
+        W = rng.randn(self.IN, self.HID).astype(np.float32) * 0.4
+        U = rng.randn(self.HID, self.HID).astype(np.float32) * 0.4
+        b = rng.randn(self.HID).astype(np.float32) * 0.1
+        _write_keras_h5(h5py, str(tmp_path / "w.h5"),
+                        [("r", [("r_W", W), ("r_U", U), ("r_b", b)])])
+        model = load_keras(str(jpath), str(tmp_path / "w.h5"))
+        x = self._x()
+        got = np.asarray(model.forward(jnp.asarray(x), training=False))
+        h = np.zeros((self.B, self.HID), np.float32)
+        for t in range(self.T):
+            h = np.tanh(x[:, t] @ W + h @ U + b)
+        np.testing.assert_allclose(got, h, rtol=1e-4, atol=1e-5)
+
+    def test_bidirectional_lstm_import(self, tmp_path):
+        h5py = pytest.importorskip("h5py")
+        jpath = tmp_path / "m.json"
+        jpath.write_text(json.dumps({
+            "class_name": "Sequential",
+            "config": [{"class_name": "Bidirectional", "config": {
+                "name": "bi", "merge_mode": "concat",
+                "batch_input_shape": [None, self.T, self.IN],
+                "layer": {"class_name": "LSTM", "config": {
+                    "name": "inner", "output_dim": self.HID,
+                    "return_sequences": False}}}}]}))
+        wf = self._lstm_weights(seed=11)
+        wb = self._lstm_weights(seed=13)
+        names_f = [f"bi_f_{i}" for i in range(12)]
+        names_b = [f"bi_b_{i}" for i in range(12)]
+        _write_keras_h5(h5py, str(tmp_path / "w.h5"),
+                        [("bi", list(zip(names_f + names_b, wf + wb)))])
+        model = load_keras(str(jpath), str(tmp_path / "w.h5"))
+        x = self._x()
+        got = np.asarray(model.forward(jnp.asarray(x), training=False))
+        want_f = _keras1_lstm_ref(x, wf, self.HID)
+        want_b = _keras1_lstm_ref(x[:, ::-1], wb, self.HID)
+        want = np.concatenate([want_f, want_b], axis=1)
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
 class TestKerasFunctional:
     def test_model_json_with_merge(self, tmp_path):
         cfg = {
