@@ -291,7 +291,10 @@ class Recurrent(Module):
     def apply(self, params, input, ctx):
         x = input  # [B, T, ...]
         batch = x.shape[0]
-        if isinstance(self.cell, ConvLSTMPeephole):
+        if isinstance(self.cell, ConvLSTMPeephole3D):
+            init_state = self.cell.zero_state_dhw(
+                batch, x.shape[2], x.shape[3], x.shape[4])
+        elif isinstance(self.cell, ConvLSTMPeephole):
             init_state = self.cell.zero_state_hw(batch, x.shape[2], x.shape[3])
         else:
             init_state = self.cell.zero_state(batch, x.dtype)
