@@ -15,8 +15,10 @@ from bigdl_tpu.interop.caffe import CaffeLoader, CaffePersister
 from bigdl_tpu.interop.tensorflow import TensorflowLoader, TensorflowSaver
 from bigdl_tpu.interop.torch_file import TorchFile
 from bigdl_tpu.interop.keras_converter import load_keras
+from bigdl_tpu.interop.tf_session import Session, load_session
 
 __all__ = ["TFRecordDataset", "make_example", "parse_example",
            "bytes_feature", "float_feature", "int64_feature",
            "write_tfrecord", "CaffeLoader", "CaffePersister",
-           "TensorflowLoader", "TensorflowSaver", "TorchFile", "load_keras"]
+           "TensorflowLoader", "TensorflowSaver", "TorchFile", "load_keras",
+           "Session", "load_session"]

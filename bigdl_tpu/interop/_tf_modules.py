@@ -138,8 +138,28 @@ class _TFTableSelect(Module):
         return input[self.index + 1]  # Table is 1-based
 
 
+class _TFDilation2D(Module):
+    """TF Dilation2D with a static filter const (morphological dilation);
+    delegates the math to ops.Dilation2D (DL/nn/ops/Dilation2D.scala)."""
+
+    def __init__(self, filt, strides=(1, 1), rates=(1, 1), padding="SAME",
+                 name=None):
+        super().__init__(name)
+        self.filt = jnp.asarray(np.asarray(filt))
+        self.strides = tuple(int(s) for s in strides)
+        self.rates = tuple(int(r) for r in rates)
+        self.padding = padding
+
+    def apply(self, params, input, ctx):
+        from bigdl_tpu.ops import Dilation2D
+        from bigdl_tpu.utils.table import Table
+        inner = Dilation2D(self.strides, self.rates, self.padding)
+        return inner.apply({}, Table(input, self.filt), ctx)
+
+
 from bigdl_tpu.serialization.module_serializer import register_module as _reg
 for _cls in (_TFConst, _TFPad, _TFPermute, _TFFill, _TFStridedSlice,
-             _TFUnstack, _TFAxisSlice, _TFMatMul, _TFTableSelect):
+             _TFUnstack, _TFAxisSlice, _TFMatMul, _TFTableSelect,
+             _TFDilation2D):
     _reg(_cls)
 del _reg, _cls

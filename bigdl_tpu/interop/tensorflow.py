@@ -33,10 +33,15 @@ _DTYPES = {
 
 
 def tensor_to_ndarray(tp: pb.TensorProto) -> np.ndarray:
+    shape = tuple(d.size for d in tp.tensor_shape.dim)
+    if tp.dtype == pb.DT_STRING:
+        vals = list(tp.string_val)
+        if len(vals) == 1 and int(np.prod(shape)) > 1:  # splat encoding
+            vals = vals * int(np.prod(shape))
+        return np.asarray(vals, object).reshape(shape)
     dtype = _DTYPES.get(tp.dtype)
     if dtype is None:
         raise ValueError(f"unsupported TF dtype {tp.dtype}")
-    shape = tuple(d.size for d in tp.tensor_shape.dim)
     if tp.tensor_content:
         return np.frombuffer(tp.tensor_content, dtype).reshape(shape).copy()
     for field in ("float_val", "double_val", "int_val", "int64_val",
@@ -52,6 +57,14 @@ def tensor_to_ndarray(tp: pb.TensorProto) -> np.ndarray:
 
 def ndarray_to_tensor(arr: np.ndarray) -> pb.TensorProto:
     tp = pb.TensorProto()
+    if arr.dtype.kind in ("U", "S", "O"):
+        tp.dtype = pb.DT_STRING
+        for s in arr.shape:
+            tp.tensor_shape.dim.add(size=int(s))
+        for v in arr.reshape(-1).tolist():
+            tp.string_val.append(v if isinstance(v, bytes)
+                                 else str(v).encode())
+        return tp
     rev = {v: k for k, v in _DTYPES.items()}
     tp.dtype = rev[arr.dtype.type]
     for s in arr.shape:
@@ -243,6 +256,35 @@ class TensorflowLoader:
                 pad, pad, with_bias=False, name=nd.name)
             m.set_params({"weight": jnp.asarray(w)})
             return m, args[:1]
+        if op == "Conv3D":
+            w = const_arg(1)  # DHWIO
+            strides = list(a["strides"].list.i) or [1, 1, 1, 1, 1]
+            padding = a["padding"].s.decode()
+            pad = -1 if padding == "SAME" else 0
+            m = nn.VolumetricConvolution(
+                int(w.shape[3]), int(w.shape[4]), int(w.shape[0]),
+                int(w.shape[2]), int(w.shape[1]), int(strides[1]),
+                int(strides[3]), int(strides[2]), pad, pad, pad,
+                with_bias=False, name=nd.name)
+            m.set_params({"weight": jnp.asarray(w)})
+            return m, args[:1]
+        if op == "Dilation2D":
+            from bigdl_tpu.interop._tf_modules import _TFDilation2D
+            filt = const_arg(1)  # [kh, kw, C]
+            strides = list(a["strides"].list.i) or [1, 1, 1, 1]
+            rates = list(a["rates"].list.i) or [1, 1, 1, 1]
+            padding = a["padding"].s.decode()
+            return _TFDilation2D(filt, (int(strides[1]), int(strides[2])),
+                                 (int(rates[1]), int(rates[2])), padding,
+                                 name=nd.name), args[:1]
+        if op == "Substr":
+            pos = int(const_arg(1))
+            length = int(const_arg(2))
+            return ops.Substr(pos, length, name=nd.name), args[:1]
+        if op == "RandomShuffle":
+            # inference-surface parity: the reference lowers RandomShuffle
+            # to Identity (utils/tf/loaders/RandomShuffle.scala:35)
+            return nn.Identity(name=nd.name), args[:1]
         if op == "DepthwiseConv2dNative":
             w = const_arg(1)  # [H, W, in, mult]
             strides = list(a["strides"].list.i) or [1, 1, 1, 1]

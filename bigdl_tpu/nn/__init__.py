@@ -73,6 +73,7 @@ from bigdl_tpu.nn.criterion import (AbsCriterion, BCECriterion,
                                     CrossEntropyCriterion,
                                     DiceCoefficientCriterion,
                                     DistKLDivCriterion, DotProductCriterion,
+                                    FakeCriterion,
                                     GaussianCriterion, HingeEmbeddingCriterion,
                                     KLDCriterion,
                                     KullbackLeiblerDivergenceCriterion, L1Cost,

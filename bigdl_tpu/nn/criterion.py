@@ -571,3 +571,20 @@ class CategoricalCrossEntropy(Criterion):
         p = jax.nn.softmax(input, axis=-1)
         ll = jnp.sum(target * jnp.log(p + self.eps), axis=-1)
         return -jnp.mean(ll)
+
+
+class FakeCriterion(Criterion):
+    """Pass the model's own scalar loss output through as the training loss
+    (reference Session.scala:694 FakeCriterion — used when the imported TF
+    graph already computes its loss). Target is ignored."""
+
+    def __init__(self, enable: bool = False):
+        super().__init__()
+        self.enable = enable
+
+    def loss(self, output, target):
+        if self.enable:
+            return jnp.asarray(0.0)
+        if isinstance(output, Table):
+            output = output[1]
+        return jnp.mean(output)

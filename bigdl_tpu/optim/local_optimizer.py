@@ -33,6 +33,8 @@ logger = logging.getLogger("bigdl_tpu.optim")
 
 
 def _to_device(x):
+    if x is None:  # FakeCriterion graphs carry no target
+        return None
     if isinstance(x, (list, tuple)):
         return Table(*[jnp.asarray(v) for v in x])
     return jnp.asarray(x)
@@ -59,6 +61,8 @@ class BaseOptimizer:
         self.grad_clip_const: Optional[tuple] = None
         self.metrics = Metrics()
         self.rng = jax.random.PRNGKey(0)
+        self.matmul_precision: Optional[str] = None
+        self.iteration_hook: Optional[Callable[[Dict], None]] = None
 
     # fluent setters (Optimizer.scala:93-452)
     def set_optim_method(self, method: OptimMethod):
@@ -118,6 +122,26 @@ class BaseOptimizer:
         self.grad_clip_norm = None
         self.grad_clip_const = None
         return self
+
+    def set_compute_precision(self, precision: Optional[str]):
+        """Matmul precision for the train step ("bfloat16" = MXU-native one
+        pass; "float32"/"highest" = three-pass). The reference's analogue is
+        fp32 master weights with fp16 wire compression
+        (FP16CompressedTensor.scala:143); here the knob is per-matmul."""
+        self.matmul_precision = precision
+        return self
+
+    def set_iteration_hook(self, fn: Optional[Callable[[Dict], None]]):
+        """Call `fn(driver_state)` after every completed iteration (used by
+        perf drivers and external monitors)."""
+        self.iteration_hook = fn
+        return self
+
+    def _precision_scope(self):
+        import contextlib
+        if self.matmul_precision is None:
+            return contextlib.nullcontext()
+        return jax.default_matmul_precision(self.matmul_precision)
 
     # -- helpers --
     def _clip_grads_expr(self, grads):
@@ -199,12 +223,15 @@ class LocalOptimizer(BaseOptimizer):
         model, criterion = self.model, self.criterion
         optim = self.optim_method
         clip = self._clip_grads_expr
+        precision_scope = self._precision_scope
 
         def step(params, opt_state, model_state, x, y, lr, rng):
             def loss_fn(p):
-                out, new_ms = functional_apply(model, p, x, state=model_state,
-                                               training=True, rng=rng)
-                return criterion.apply(out, y), new_ms
+                with precision_scope():
+                    out, new_ms = functional_apply(model, p, x,
+                                                   state=model_state,
+                                                   training=True, rng=rng)
+                    return criterion.apply(out, y), new_ms
 
             (loss, new_ms), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
             grads = clip(grads)
@@ -275,6 +302,8 @@ class LocalOptimizer(BaseOptimizer):
                 self._save_checkpoint(params, model_state,
                                       tag=f"iter{driver_state['neval']}",
                                       opt_slots=opt_state)
+            if self.iteration_hook is not None:
+                self.iteration_hook(driver_state)
 
         self.model.set_params(params)
         self.model._state = model_state

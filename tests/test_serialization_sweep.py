@@ -415,7 +415,8 @@ SPECS.update({
 })
 
 # TF loader-internal modules (ctor args are plain ndarrays/ints)
-from bigdl_tpu.interop._tf_modules import (_TFAxisSlice, _TFConst, _TFFill,
+from bigdl_tpu.interop._tf_modules import (_TFAxisSlice, _TFConst,
+                                           _TFDilation2D, _TFFill,
                                            _TFMatMul, _TFPad, _TFPermute,
                                            _TFStridedSlice, _TFTableSelect,
                                            _TFUnstack)
@@ -429,6 +430,8 @@ SPECS.update({
     "_TFAxisSlice": (lambda: _TFAxisSlice(1, 0, 2), SEQ),
     "_TFMatMul": (lambda: _TFMatMul(), Table(MAT.copy(), MAT.T.copy())),
     "_TFTableSelect": (lambda: _TFTableSelect(1), PAIR),
+    "_TFDilation2D": (lambda: _TFDilation2D(np.ones((2, 2, 3), np.float32)),
+                      IMG),
 })
 
 # quantized modules: forward after round trip must match exactly (the

@@ -446,3 +446,49 @@ class TestImportedGraphJit:
         win = sum(pad[..., i:i + 8] for i in range(5))
         want = x / np.power(1.0 + 1e-3 * win, 0.75)
         np.testing.assert_allclose(_run(g, x), want, rtol=1e-4, atol=1e-5)
+
+
+class TestConv3DDilationSubstr:
+    def test_conv3d(self):
+        torch = pytest.importorskip("torch")
+        x = RS.rand(1, 4, 5, 5, 2).astype(np.float32)  # NDHWC
+        w = RS.rand(2, 3, 3, 2, 4).astype(np.float32)  # DHWIO
+
+        def b(gd):
+            wn = gd.node.add(name="w", op="Const")
+            wn.attr["value"].tensor.CopyFrom(ndarray_to_tensor(w))
+            n = gd.node.add(name="y", op="Conv3D", input=["x", "w"])
+            n.attr["strides"].list.i.extend([1, 1, 1, 1, 1])
+            n.attr["padding"].s = b"VALID"
+        g = _graph(outs=["y"], build=b)
+        # torch conv3d NCDHW / OIDHW
+        tw = torch.tensor(w.transpose(4, 3, 0, 1, 2))
+        ref = torch.nn.functional.conv3d(
+            torch.tensor(x.transpose(0, 4, 1, 2, 3)), tw).numpy()
+        np.testing.assert_allclose(_run(g, x),
+                                   ref.transpose(0, 2, 3, 4, 1),
+                                   rtol=1e-4, atol=1e-4)
+
+    def test_dilation2d(self):
+        x = np.zeros((1, 5, 5, 1), np.float32)
+        x[0, 2, 2, 0] = 1.0
+        filt = np.zeros((3, 3, 1), np.float32)
+
+        def b(gd):
+            fn = gd.node.add(name="f", op="Const")
+            fn.attr["value"].tensor.CopyFrom(ndarray_to_tensor(filt))
+            n = gd.node.add(name="y", op="Dilation2D", input=["x", "f"])
+            n.attr["strides"].list.i.extend([1, 1, 1, 1])
+            n.attr["rates"].list.i.extend([1, 1, 1, 1])
+            n.attr["padding"].s = b"SAME"
+        g = _graph(outs=["y"], build=b)
+        out = _run(g, x)
+        # zero filter -> grayscale dilation = 3x3 max filter
+        assert out[0, 2, 2, 0] == 1.0 and out[0, 1, 1, 0] == 1.0
+        assert out[0, 0, 0, 0] == 0.0
+
+    def test_random_shuffle_is_identity(self):
+        def b(gd):
+            gd.node.add(name="y", op="RandomShuffle", input=["x"])
+        g = _graph(outs=["y"], build=b)
+        np.testing.assert_array_equal(_run(g, X), X)
