@@ -46,6 +46,39 @@ def _blob_array(blob: pb.BlobProto) -> np.ndarray:
     return data.reshape(tuple(dims)) if dims else data
 
 
+class _CaffeFlatten(Module):
+    """Caffe's implicit InnerProduct flatten: NCHW channel-major order.
+    Our activations are NHWC, so spatial inputs move C before H,W first —
+    this keeps real caffemodels' fc weights (written against NCHW flatten)
+    numerically correct."""
+
+    def apply(self, params, input, ctx):
+        x = input
+        if x.ndim > 2:
+            x = jnp.moveaxis(x, -1, 1)
+        return x.reshape(x.shape[0], -1)
+
+
+class _CaffeSlice(Module):
+    """One output segment of a caffe Slice layer (axis may be negative;
+    end == -1 means 'to the end')."""
+
+    def __init__(self, axis: int, start: int, end: int = -1, name=None):
+        super().__init__(name)
+        self.axis, self.start, self.end = int(axis), int(start), int(end)
+
+    def apply(self, params, input, ctx):
+        sl = [slice(None)] * input.ndim
+        sl[self.axis] = slice(self.start,
+                              None if self.end < 0 else self.end)
+        return input[tuple(sl)]
+
+
+from bigdl_tpu.serialization.module_serializer import register_module
+register_module(_CaffeSlice)
+register_module(_CaffeFlatten)
+
+
 class CaffeLoader:
     """load(prototxt, caffemodel) -> (Graph, criterion=None).
 
@@ -53,25 +86,98 @@ class CaffeLoader:
     (DL/nn/Module.scala -> CaffeLoader.load:544).
     """
 
-    SUPPORTED = ("Input", "Data", "Convolution", "InnerProduct", "Pooling",
+    SUPPORTED = ("Input", "Data", "Convolution", "Deconvolution",
+                 "InnerProduct", "Pooling",
                  "ReLU", "Sigmoid", "TanH", "LRN", "BatchNorm", "Scale",
                  "Softmax", "SoftmaxWithLoss", "Concat", "Eltwise", "Dropout",
-                 "Reshape", "Flatten")
+                 "Reshape", "Flatten", "AbsVal", "Power", "BNLL", "Threshold",
+                 "Exp", "Split", "Slice")
 
     @staticmethod
     def load(prototxt_path: str, caffemodel_path: Optional[str] = None):
         net = pb.NetParameter()
         with open(prototxt_path) as f:
             text_format.Parse(f.read(), net)
+        if net.layers and not net.layer:  # V1 era definition
+            net = CaffeLoader._v1_to_v2(net)
         weights: Dict[str, List[np.ndarray]] = {}
         if caffemodel_path is not None:
             wnet = pb.NetParameter.FromString(
                 open(caffemodel_path, "rb").read())
-            for layer in wnet.layer:
+            for layer in list(wnet.layer) + list(wnet.layers):
                 if layer.blobs:
                     weights[layer.name] = [_blob_array(b)
                                            for b in layer.blobs]
         return CaffeLoader._build(net, weights)
+
+    # V1LayerParameter.LayerType -> modern type string
+    # (reference V1LayerConverter.scala:38 converts the same set)
+    _V1_TYPES = {
+        "CONVOLUTION": "Convolution", "DECONVOLUTION": "Deconvolution",
+        "INNER_PRODUCT": "InnerProduct", "POOLING": "Pooling",
+        "RELU": "ReLU", "SIGMOID": "Sigmoid", "TANH": "TanH", "LRN": "LRN",
+        "SOFTMAX": "Softmax", "SOFTMAX_LOSS": "SoftmaxWithLoss",
+        "CONCAT": "Concat", "ELTWISE": "Eltwise", "DROPOUT": "Dropout",
+        "FLATTEN": "Flatten", "SPLIT": "Split", "ABSVAL": "AbsVal",
+        "POWER": "Power", "BNLL": "BNLL", "THRESHOLD": "Threshold",
+        "EXP": "Exp", "SLICE": "Slice",
+        "DATA": "Data", "IMAGE_DATA": "Data", "WINDOW_DATA": "Data",
+        "MEMORY_DATA": "Data", "DUMMY_DATA": "Data", "HDF5_DATA": "Data",
+        # train/eval-only heads: dropped like SoftmaxWithLoss
+        "ACCURACY": "_drop", "SILENCE": "_drop",
+        "EUCLIDEAN_LOSS": "_drop", "HINGE_LOSS": "_drop",
+        "INFOGAIN_LOSS": "_drop", "MULTINOMIAL_LOGISTIC_LOSS": "_drop",
+        "SIGMOID_CROSS_ENTROPY_LOSS": "_drop", "CONTRASTIVE_LOSS": "_drop",
+        "HDF5_OUTPUT": "_drop",
+    }
+
+    @staticmethod
+    def _v1_to_v2(net: pb.NetParameter) -> pb.NetParameter:
+        """Normalize a V1 (layers=2, enum-typed) net into the modern
+        LayerParameter form the builder consumes
+        (V1LayerConverter.scala:38 plays the same role in reverse)."""
+        out = pb.NetParameter()
+        out.name = net.name
+        out.input.extend(net.input)
+        for s in net.input_shape:
+            out.input_shape.add().CopyFrom(s)
+        out.input_dim.extend(net.input_dim)
+        for v1 in net.layers:
+            tname = pb.V1LayerParameter.LayerType.Name(v1.type)
+            mapped = CaffeLoader._V1_TYPES.get(tname)
+            if mapped is None:
+                raise ValueError(
+                    f"unsupported V1 caffe layer type {tname} ({v1.name})")
+            if mapped == "_drop":
+                continue
+            l = out.layer.add()
+            l.name = v1.name
+            l.type = mapped
+            l.bottom.extend(v1.bottom)
+            l.top.extend(v1.top)
+            for b in v1.blobs:
+                l.blobs.add().CopyFrom(b)
+            include = list(v1.include)
+            train_only = bool(include) and not any(
+                not r.HasField("phase") or r.phase == pb.TEST
+                for r in include)
+            excluded = any(r.HasField("phase") and r.phase == pb.TEST
+                           for r in v1.exclude)
+            if train_only or excluded:
+                l.phase = pb.TRAIN
+            for src, dst in (
+                    (v1.convolution_param, l.convolution_param),
+                    (v1.inner_product_param, l.inner_product_param),
+                    (v1.pooling_param, l.pooling_param),
+                    (v1.lrn_param, l.lrn_param),
+                    (v1.concat_param, l.concat_param),
+                    (v1.eltwise_param, l.eltwise_param),
+                    (v1.dropout_param, l.dropout_param),
+                    (v1.power_param, l.power_param),
+                    (v1.threshold_param, l.threshold_param),
+                    (v1.slice_param, l.slice_param)):
+                dst.CopyFrom(src)
+        return out
 
     @staticmethod
     def _build(net: pb.NetParameter, weights: Dict[str, List[np.ndarray]]):
@@ -88,6 +194,13 @@ class CaffeLoader:
 
         layers = [l for l in net.layer
                   if l.phase != pb.TRAIN or not l.HasField("phase")]
+        # blobs produced by explicit Reshape layers (CaffePersister writes
+        # one before each exported Linear): a following InnerProduct keeps
+        # that order instead of the caffe implicit NCHW flatten. Real-net
+        # Flatten layers lower to _CaffeFlatten (NCHW order) instead, so a
+        # following IP's own flatten is a no-op either way.
+        flat_blobs = {top for l in layers if l.type == "Reshape"
+                      for top in l.top}
         out_nodes: List[Node] = []
         consumed = set()
         for layer in layers:
@@ -95,7 +208,29 @@ class CaffeLoader:
                 for top in layer.top:
                     add_input(top)
                 continue
-            module = CaffeLoader._convert(layer, weights.get(layer.name))
+            if layer.type == "Slice":
+                # one node per top segment (caffe slices along NCHW axis)
+                sp = layer.slice_param
+                axis = {0: 0, 1: -1, 2: 1, 3: 2}.get(sp.axis, sp.axis)
+                bottom = producers[layer.bottom[0]]
+                consumed.update(layer.bottom)
+                n = len(layer.top)
+                pts = list(sp.slice_point)
+                for i, top in enumerate(layer.top):
+                    if pts:
+                        start = 0 if i == 0 else pts[i - 1]
+                        end = -1 if i == n - 1 else pts[i]
+                        seg = _CaffeSlice(axis, start, end,
+                                          name=f"{layer.name}_{i}")
+                    else:
+                        import bigdl_tpu.ops as ops
+                        seg = ops.SplitAndSelect(axis, i, n,
+                                                 name=f"{layer.name}_{i}")
+                    producers[top] = seg.inputs(bottom)
+                continue
+            flat_input = bool(layer.bottom) and layer.bottom[0] in flat_blobs
+            module = CaffeLoader._convert(layer, weights.get(layer.name),
+                                          flat_input=flat_input)
             if module is None:       # train-only layers (SoftmaxWithLoss)
                 continue
             bottoms = [producers[b] for b in layer.bottom]
@@ -113,7 +248,8 @@ class CaffeLoader:
 
     @staticmethod
     def _convert(layer: pb.LayerParameter,
-                 blobs: Optional[List[np.ndarray]]) -> Optional[Module]:
+                 blobs: Optional[List[np.ndarray]],
+                 flat_input: bool = False) -> Optional[Module]:
         t = layer.type
         if t == "Convolution":
             cp = layer.convolution_param
@@ -144,9 +280,15 @@ class CaffeLoader:
             ip = layer.inner_product_param
             if blobs is None:
                 raise ValueError(f"InnerProduct {layer.name} has no weights")
-            w = blobs[0]  # [out, in]
+            w = blobs[0]  # [out, in], columns in NCHW-flatten order
             m = nn.Sequential(name=layer.name)
-            m.add(nn.Reshape([int(w.shape[1])]))
+            if flat_input:
+                # explicit Reshape/Flatten upstream (our own exports):
+                # weights are already in the producer's order
+                m.add(nn.Reshape([int(w.shape[1])]))
+            else:
+                # real caffe nets flatten implicitly in NCHW order
+                m.add(_CaffeFlatten())
             lin = nn.Linear(int(w.shape[1]), int(w.shape[0]),
                             with_bias=ip.bias_term)
             p = {"weight": jnp.asarray(w.T)}
@@ -232,11 +374,49 @@ class CaffeLoader:
         if t == "Dropout":
             return nn.Dropout(layer.dropout_param.dropout_ratio,
                               name=layer.name)
-        if t in ("Reshape", "Flatten"):
-            if t == "Flatten":
-                return nn.InferReshape([0, -1], name=layer.name)
+        if t == "Flatten":
+            # real caffe Flatten is an NCHW channel-major flatten; fc
+            # weights downstream are written against that order
+            m = _CaffeFlatten(name=layer.name)
+            return m
+        if t == "Reshape":
             dims = list(layer.reshape_param.shape.dim)
             return nn.InferReshape(dims, name=layer.name)
+        if t == "AbsVal":
+            return nn.Abs(name=layer.name)
+        if t == "Power":
+            pp = layer.power_param
+            return nn.Power(pp.power, pp.scale, pp.shift, name=layer.name)
+        if t == "BNLL":
+            return nn.SoftPlus(name=layer.name)  # log(1 + e^x)
+        if t == "Threshold":
+            return nn.BinaryThreshold(layer.threshold_param.threshold,
+                                      name=layer.name)
+        if t == "Exp":
+            return nn.Exp(name=layer.name)
+        if t == "Split":
+            # caffe Split duplicates the blob to every top; the builder
+            # binds all tops to the same producing node
+            return nn.Identity(name=layer.name)
+        if t == "Deconvolution":
+            cp = layer.convolution_param
+            kh, kw, sh, sw, ph, pw = _conv_geometry(cp)
+            if cp.group > 1:
+                raise ValueError(
+                    f"Deconvolution {layer.name}: group > 1 unsupported")
+            if blobs is None:
+                raise ValueError(f"Deconvolution {layer.name} has no "
+                                 "weights; pass the .caffemodel")
+            w = blobs[0]  # caffe deconv weight: [in, out, kh, kw]
+            m = nn.SpatialFullConvolution(
+                int(w.shape[0]), int(w.shape[1]), kw, kh, sw, sh, pw, ph,
+                with_bias=cp.bias_term, name=layer.name)
+            # module stores (kh, kw, out, in)
+            p = {"weight": jnp.asarray(np.transpose(w, (2, 3, 1, 0)))}
+            if cp.bias_term:
+                p["bias"] = jnp.asarray(blobs[1].reshape(-1))
+            m.set_params(p)
+            return m
         raise ValueError(
             f"unsupported caffe layer type '{t}' ({layer.name}); supported: "
             f"{CaffeLoader.SUPPORTED}")
@@ -353,7 +533,17 @@ class CaffePersister:
             lp.dropout_param.dropout_ratio = m.p
             return lp, []
         if isinstance(m, (nn.Reshape, nn.InferReshape)):
-            return None, []  # shape plumbing; caffe IP flattens implicitly
+            # emit an explicit Reshape layer: the loader then keeps a
+            # following InnerProduct's weights in OUR flatten order rather
+            # than applying the caffe implicit-NCHW flatten
+            lp.type = "Reshape"
+            dims = list(getattr(m, "size", ()) or (-1,))
+            if isinstance(m, nn.Reshape) or getattr(m, "batch_mode", False):
+                dims = [0] + dims  # batch dim preserved
+            lp.reshape_param.shape.dim.extend(int(d) for d in dims)
+            return lp, []
+        if isinstance(m, _CaffeFlatten):
+            return None, []  # re-export: caffe IP flattens implicitly
         if isinstance(m, nn.SpatialCrossMapLRN):
             lp.type = "LRN"
             lrn = lp.lrn_param

@@ -18,8 +18,8 @@ Two data paths, like the reference:
 
 TFRecord reader queues (ReaderReadV2 -> TFRecordReaderV2,
 Session.scala:195) are supported when the filename queue holds constants;
-records are read with the native TFRecord reader and parsed with
-`parse_example` when a dense-feature spec is given.
+records are read with the native TFRecord reader and yielded as raw
+serialized bytes (decode with `bigdl_tpu.interop.parse_example`).
 """
 
 from __future__ import annotations
@@ -66,6 +66,7 @@ class Session:
                 "for queue-fed graphs use train_with_queue")
         model = TensorflowLoader.from_graph_def(self.graph_def,
                                                 placeholders, list(outputs))
+        self._last_model = model
         opt = Optimizer(model, dataset, criterion, batch_size=batch_size)
         opt.set_optim_method(optim_method).set_end_when(end_trigger)
         opt.optimize()
@@ -127,7 +128,7 @@ class Session:
         deq = self._find_dequeue(end_points)
         n_out = self._dequeue_arity(deq)
         input_names = [f"{deq.name}__out{i}" for i in range(n_out)]
-        gd = self._rewrite_dequeue(deq, input_names)
+        gd = self._rewrite_dequeue(deq, input_names, end_points)
         model = TensorflowLoader.from_graph_def(gd, input_names, end_points)
         self._last_model = model
         samples = self._queue_samples(deq)
@@ -175,16 +176,25 @@ class Session:
             "Tcomponents"
         return max(1, len(deq.attr[kind].list.type))
 
-    def _rewrite_dequeue(self, deq: pb.NodeDef,
-                         input_names: List[str]) -> pb.GraphDef:
+    def _rewrite_dequeue(self, deq: pb.NodeDef, input_names: List[str],
+                         end_points: Sequence[str]) -> pb.GraphDef:
         """Replace the dequeue node with Placeholder inputs so the loader
-        builds the pure model subgraph."""
+        builds the pure model subgraph. Only ancestors of the endpoints are
+        kept — unrelated pipelines (e.g. a second eval queue) are dropped
+        rather than tripping dangling-reference checks."""
         removed = {deq.name} | {
             nd.name for nd in self.graph_def.node
             if nd.op in _ENQUEUE_OPS + _QUEUE_OPS + _READER_OPS}
+        keep, stack = set(), [_clean(e) for e in end_points]
+        while stack:
+            name = stack.pop()
+            if name in keep or name not in self.nodes or name in removed:
+                continue
+            keep.add(name)
+            stack.extend(_clean(i) for i in self.nodes[name].input)
         gd = pb.GraphDef()
         for nd in self.graph_def.node:
-            if nd.name in removed:
+            if nd.name in removed or nd.name not in keep:
                 continue
             new = pb.NodeDef()
             new.CopyFrom(nd)

@@ -157,6 +157,12 @@ class Substr(Operation):
 
     def apply(self, params, input, ctx):
         end = None if self.length < 0 else self.pos + self.length
+
+        def cut(s):
+            if isinstance(s, (bytes, np.bytes_)):
+                return bytes(s)[self.pos:end]
+            return str(s)[self.pos:end]
+
         arr = np.asarray(input)
-        return np.asarray([str(s)[self.pos:end] for s in arr.reshape(-1)],
+        return np.asarray([cut(s) for s in arr.reshape(-1)],
                           object).reshape(arr.shape)

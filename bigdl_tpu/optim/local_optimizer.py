@@ -36,7 +36,9 @@ def _to_device(x):
     if x is None:  # FakeCriterion graphs carry no target
         return None
     if isinstance(x, (list, tuple)):
-        return Table(*[jnp.asarray(v) for v in x])
+        return Table(*[_to_device(v) for v in x])
+    if isinstance(x, np.ndarray) and x.dtype.kind in ("U", "S", "O"):
+        return x  # string/bytes columns stay host-side (feature-col ops)
     return jnp.asarray(x)
 
 

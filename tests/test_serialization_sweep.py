@@ -434,6 +434,10 @@ SPECS.update({
                       IMG),
 })
 
+from bigdl_tpu.interop.caffe import _CaffeFlatten, _CaffeSlice
+SPECS["_CaffeSlice"] = (lambda: _CaffeSlice(-1, 1, 3), MAT)
+SPECS["_CaffeFlatten"] = (lambda: _CaffeFlatten(), IMG)
+
 # quantized modules: forward after round trip must match exactly (the
 # quantization tables are part of the params)
 SPECS["QuantizedLinear"] = (lambda: nn.QuantizedLinear(4, 3), MAT)

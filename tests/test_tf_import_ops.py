@@ -492,3 +492,18 @@ class TestConv3DDilationSubstr:
             gd.node.add(name="y", op="RandomShuffle", input=["x"])
         g = _graph(outs=["y"], build=b)
         np.testing.assert_array_equal(_run(g, X), X)
+
+
+class TestSubstr:
+    def test_substr_bytes(self):
+        def b(gd):
+            pn = gd.node.add(name="p", op="Const")
+            pn.attr["value"].tensor.CopyFrom(
+                ndarray_to_tensor(np.asarray(0, np.int32)))
+            ln = gd.node.add(name="l", op="Const")
+            ln.attr["value"].tensor.CopyFrom(
+                ndarray_to_tensor(np.asarray(3, np.int32)))
+            gd.node.add(name="y", op="Substr", input=["x", "p", "l"])
+        g = _graph(outs=["y"], build=b)
+        out = g.forward(np.array([b"hello", b"world!"], object))
+        assert list(np.asarray(out).reshape(-1)) == [b"hel", b"wor"]

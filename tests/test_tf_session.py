@@ -163,8 +163,32 @@ class TestReaderQueue:
         gd.node.add(name="read", op="ReaderReadV2", input=["reader", "fq"])
         gd.node.add(name="value", op="Identity", input=["read:1"])
         sess = Session(gd)
-        samples = sess._queue_samples(sess.nodes["read"])
-        assert len(samples) == 3
+        outs = sess.predict(["value"], batch_size=3)
+        assert len(outs) == 1
+        records = np.asarray(outs[0]).reshape(-1)
+        assert len(records) == 3
         from bigdl_tpu.interop import parse_example
-        parsed = parse_example(samples[0].features[1].item())
+        parsed = parse_example(records[0])
         assert parsed["v"] == [bytes([0])]
+
+    def test_unrelated_second_queue_ignored(self):
+        """A second (eval) queue that does not feed the endpoints must not
+        break the build."""
+        gd = tpb.GraphDef()
+        _const(gd, "r0", np.array([1.0, 2.0], np.float32))
+        q = gd.node.add(name="queue", op="FIFOQueueV2")
+        q.attr["component_types"].list.type.extend([1])
+        gd.node.add(name="e0", op="QueueEnqueueV2", input=["queue", "r0"])
+        deq = gd.node.add(name="deq", op="QueueDequeueManyV2",
+                          input=["queue", "batch"])
+        deq.attr["component_types"].list.type.extend([1])
+        _const(gd, "batch", np.asarray(1, np.int32))
+        gd.node.add(name="y", op="Identity", input=["deq:0"])
+        # unrelated eval pipeline
+        q2 = gd.node.add(name="equeue", op="FIFOQueueV2")
+        q2.attr["component_types"].list.type.extend([1])
+        ed = gd.node.add(name="edeq", op="QueueDequeueV2", input=["equeue"])
+        ed.attr["component_types"].list.type.extend([1])
+        gd.node.add(name="ey", op="Identity", input=["edeq:0"])
+        outs = Session(gd).predict(["y"], batch_size=1)
+        np.testing.assert_allclose(np.asarray(outs[0]), [[1.0, 2.0]])
