@@ -63,11 +63,20 @@ class LocalDataSet(AbstractDataSet):
 
 class DistributedDataSet(LocalDataSet):
     """Host-sharded dataset: this process sees shard `host_index` of
-    `num_hosts`. With one host it degenerates to LocalDataSet — mirroring how
-    reference tests run 'distributed' on local[N] Spark (SURVEY.md §4.4)."""
+    `num_hosts`. Defaults come from the jax.distributed runtime
+    (process_index/process_count — Engine.init(distributed=True) starts
+    it), so the same script runs 1-host or N-host unchanged; with one host
+    it degenerates to LocalDataSet — mirroring how reference tests run
+    'distributed' on local[N] Spark (SURVEY.md §4.4)."""
 
-    def __init__(self, items: Sequence, host_index: int = 0, num_hosts: int = 1,
-                 seed: int = 1):
+    def __init__(self, items: Sequence, host_index: Optional[int] = None,
+                 num_hosts: Optional[int] = None, seed: int = 1):
+        if host_index is None or num_hosts is None:
+            import jax
+            host_index = jax.process_index() if host_index is None \
+                else host_index
+            num_hosts = jax.process_count() if num_hosts is None \
+                else num_hosts
         shard = [x for i, x in enumerate(items) if i % num_hosts == host_index]
         super().__init__(shard, seed)
         self.global_size = len(items)

@@ -89,12 +89,15 @@ class DistriOptimizer(BaseOptimizer):
         model, criterion = self.model, self.criterion
         optim = self.optim_method
         clip = self._clip_grads_expr
+        precision_scope = self._precision_scope
 
         def step(params, opt_state, model_state, x, y, lr, rng):
             def loss_fn(p):
-                out, new_ms = functional_apply(model, p, x, state=model_state,
-                                               training=True, rng=rng)
-                return criterion.apply(out, y), new_ms
+                with precision_scope():
+                    out, new_ms = functional_apply(model, p, x,
+                                                   state=model_state,
+                                                   training=True, rng=rng)
+                    return criterion.apply(out, y), new_ms
 
             (loss, new_ms), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
             grads = clip(grads)
@@ -159,9 +162,26 @@ class DistriOptimizer(BaseOptimizer):
         data_iter = self.dataset.data(train=True)
         n_dev = int(np.prod(mesh.devices.shape))
 
-        while not self.end_trigger(driver_state):
+        def fetch_and_place():
+            """Pull the next host batch and start its async H2D transfer.
+
+            Called right after the train step is dispatched, so the numpy
+            work and the device_put DMA overlap the running step — the
+            reference's analogue is the data-fetch Spark task overlapping
+            the parameter-sync jobs (DistriOptimizer.scala:330-339).
+
+            The two phase timers here run while the previous step is still
+            executing on-device, so their wall time OVERLAPS "computing
+            time average" (which spans dispatch -> loss sync); the phase
+            table is intentionally not additive."""
             with Timer(self.metrics, "data fetch time"):
-                batch: MiniBatch = next(data_iter)
+                batch: MiniBatch = next(data_iter, None)
+                if batch is None:  # finite stream exhausted
+                    logger.warning(
+                        "training data stream exhausted before the end "
+                        "trigger fired; stopping early (train=True datasets "
+                        "normally loop forever)")
+                    return None
             with Timer(self.metrics, "put batch on mesh"):
                 x = batch.get_input()
                 y = batch.get_target()
@@ -169,12 +189,24 @@ class DistriOptimizer(BaseOptimizer):
                      if isinstance(x, list) else shard_batch(mesh, x))
                 y = (Table(*[shard_batch(mesh, v) for v in y])
                      if isinstance(y, list) else shard_batch(mesh, y))
+            return batch, x, y
+
+        pending = fetch_and_place()
+        while pending is not None and not self.end_trigger(driver_state):
+            batch, x, y = pending
             lr = self.optim_method.current_lr()
             self.rng, step_rng = jax.random.split(self.rng)
-            with Timer(self.metrics, "computing time average"):
-                params, opt_state, new_ms, loss = step(
-                    params, opt_state, model_state, x, y, lr, step_rng)
-                loss = float(loss)
+            it_t0 = time.perf_counter_ns()
+            params, opt_state, new_ms, loss = step(
+                params, opt_state, model_state, x, y, lr, step_rng)
+            # prefetch while the dispatched step runs on-device (deliberate
+            # one-batch lookahead: the final prefetch of an optimize() call
+            # is discarded — one batch of host work per run buys the
+            # fetch/H2D overlap on every iteration)
+            pending = fetch_and_place()
+            loss = float(loss)  # sync: waits for the step to finish
+            self.metrics.add("computing time average",
+                             time.perf_counter_ns() - it_t0)
             model_state = merge_state(model_state, new_ms)
 
             n = batch.size() * num_hosts  # global records this step
@@ -206,6 +238,8 @@ class DistriOptimizer(BaseOptimizer):
                     self._save_checkpoint(params, model_state,
                                           tag=f"iter{driver_state['neval']}",
                                           opt_slots=opt_state)
+            if self.iteration_hook is not None:
+                self.iteration_hook(driver_state)
 
         # gather back to host (reference getModel:646 pulls partitions)
         self.model.set_params(jax.device_get(params))

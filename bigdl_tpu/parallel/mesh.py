@@ -44,13 +44,20 @@ def replicate_sharding(mesh: Mesh) -> NamedSharding:
 
 
 def shard_batch(mesh: Mesh, batch):
-    """Place a host numpy batch sharded over the data axis (per-host
-    device_put; the multi-host generalization uses
-    jax.make_array_from_process_local_data)."""
+    """Place a host batch sharded over the data axis.
+
+    Single-host: one async device_put. Multi-host: each process passes its
+    LOCAL shard of the global batch (per-host feeding,
+    DistriOptimizer.scala:211-212 / ZippedPartitionsWithLocalityRDD) and
+    jax.make_array_from_process_local_data assembles the global jax.Array
+    without any cross-host data motion."""
     import jax.numpy as jnp
     sh = data_sharding(mesh)
+    multi_host = jax.process_count() > 1
 
     def put(x):
+        if multi_host:
+            return jax.make_array_from_process_local_data(sh, np.asarray(x))
         return jax.device_put(jnp.asarray(x), sh)
 
     return jax.tree_util.tree_map(put, batch)

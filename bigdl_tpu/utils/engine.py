@@ -42,6 +42,15 @@ _DEFAULTS: Dict[str, Any] = {
     "check_singleton": False,
     # default matmul precision for the compute path
     "matmul_dtype": "bfloat16",
+    # multi-host (reference: Spark cluster via spark-submit; here the
+    # jax.distributed runtime). distributed=True (or
+    # BIGDL_TPU_DISTRIBUTED=1) calls jax.distributed.initialize before
+    # the backend starts; on TPU pods the three parameters autodetect,
+    # elsewhere (CPU/GPU clusters) set them explicitly.
+    "distributed": False,
+    "coordinator_address": "",
+    "num_processes": 0,
+    "process_id": -1,
 }
 
 _ENV_PREFIX = "BIGDL_TPU_"
@@ -53,6 +62,7 @@ class _Engine:
     def __init__(self):
         self._lock = threading.Lock()
         self._inited = False
+        self._distributed_started = False
         self.config: Dict[str, Any] = dict(_DEFAULTS)
         self._mesh = None
 
@@ -70,6 +80,11 @@ class _Engine:
                 if k not in self.config:
                     raise KeyError(f"unknown Engine config key: {k}")
                 self.config[k] = v
+            # distributed join happens on whichever init() call first asks
+            # for it — even if a library already ran a plain init()
+            if self.config["distributed"] and not self._distributed_started:
+                self._init_distributed()
+                self._distributed_started = True
             if self._inited:
                 return self
             if self.config["check_singleton"] and _SINGLETON.locked():
@@ -79,6 +94,21 @@ class _Engine:
             _SINGLETON.acquire(blocking=False)
             self._inited = True
             return self
+
+    def _init_distributed(self):
+        """Start the jax.distributed runtime (the reference's analogue is
+        joining the Spark cluster, Engine.scala:455-556). Must run before
+        the first backend touch; per-host feeding and psum-over-DCN both
+        ride on it."""
+        import jax
+        kwargs = {}
+        if self.config["coordinator_address"]:
+            kwargs["coordinator_address"] = self.config["coordinator_address"]
+        if self.config["num_processes"] > 0:
+            kwargs["num_processes"] = int(self.config["num_processes"])
+        if self.config["process_id"] >= 0:
+            kwargs["process_id"] = int(self.config["process_id"])
+        jax.distributed.initialize(**kwargs)
 
     # ------------------------------------------------------------ topology
     def node_number(self) -> int:

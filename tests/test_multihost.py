@@ -1,0 +1,118 @@
+"""Real multi-host execution: 2 jax processes, one global mesh.
+
+The reference's multi-executor contract (per-node feeding,
+DistriOptimizer.scala:211-212 + ZippedPartitionsWithLocalityRDD.scala:47)
+maps to: each process runs the same script, `Engine.init(distributed=True)`
+joins the jax.distributed runtime, `DistributedDataSet` shards records by
+process_index, and `shard_batch` assembles global arrays from process-local
+data. This test launches two REAL processes over the CPU backend (2 virtual
+devices each -> a 4-device global mesh) and checks both converge to
+identical parameters.
+"""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+_DRIVER = r"""
+import json, os, sys
+sys.path.insert(0, os.environ["REPO_ROOT"])
+import jax
+jax.config.update("jax_platforms", "cpu")
+
+from bigdl_tpu.utils.engine import Engine
+Engine.init(distributed=True,
+            coordinator_address=os.environ["COORD"],
+            num_processes=2,
+            process_id=int(os.environ["PROC_ID"]))
+
+import numpy as np
+import jax.numpy as jnp
+import bigdl_tpu.nn as nn
+import bigdl_tpu.optim as optim
+from bigdl_tpu.dataset.dataset import DistributedDataSet
+from bigdl_tpu.dataset.sample import MiniBatch
+from bigdl_tpu.optim.distri_optimizer import DistriOptimizer
+from bigdl_tpu.optim.trigger import max_iteration
+
+assert jax.process_count() == 2, jax.process_count()
+assert jax.device_count() == 4, jax.device_count()
+
+# global data, identical on every host; DistributedDataSet keeps this
+# host's shard of the pre-built per-host batches
+rs = np.random.RandomState(0)
+W_true = np.array([[1.0], [-2.0], [0.5], [3.0]], np.float32)
+batches = []
+for b in range(8):  # 8 global batches of local size 8 (global 16)
+    per_host = []
+    for h in range(2):
+        X = rs.randn(8, 4).astype(np.float32)
+        y = (X @ W_true).astype(np.float32)
+        per_host.append(MiniBatch(X, y))
+    batches.append(per_host)
+local_batches = [per_host[jax.process_index()] for per_host in batches]
+dataset = DistributedDataSet(local_batches)
+assert dataset.num_hosts == 2 and dataset.size() == 4
+
+model = nn.Linear(4, 1, with_bias=False)
+opt = DistriOptimizer(model, dataset, nn.MSECriterion())
+opt.set_optim_method(optim.SGD(learning_rate=0.05))
+opt.set_end_when(max_iteration(60))
+losses = []
+opt.set_iteration_hook(lambda s: losses.append(s["loss"]))
+opt.optimize()
+
+w = np.asarray(model.ensure_params()["weight"]).reshape(-1)
+out = {"first_loss": float(losses[0]), "last_loss": float(losses[-1]),
+       "weight": w.tolist()}
+with open(os.environ["OUT_PATH"], "w") as f:
+    json.dump(out, f)
+print("DONE", flush=True)
+"""
+
+
+def test_two_process_training(tmp_path):
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    driver = tmp_path / "driver.py"
+    driver.write_text(_DRIVER)
+    procs = []
+    for pid in range(2):
+        env = dict(os.environ)
+        env.pop("PYTHONPATH", None)
+        env.update({
+            "JAX_PLATFORMS": "cpu",
+            "XLA_FLAGS": "--xla_force_host_platform_device_count=2",
+            "REPO_ROOT": os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__))),
+            "COORD": f"127.0.0.1:{port}",
+            "PROC_ID": str(pid),
+            "OUT_PATH": str(tmp_path / f"out{pid}.json"),
+        })
+        procs.append(subprocess.Popen(
+            [sys.executable, str(driver)], env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True))
+    try:
+        for p in procs:
+            stdout, _ = p.communicate(timeout=600)
+            assert p.returncode == 0, f"worker failed:\n{stdout[-4000:]}"
+    finally:
+        for p in procs:  # don't leak a worker blocked on the coordinator
+            if p.poll() is None:
+                p.kill()
+    results = [json.load(open(tmp_path / f"out{i}.json")) for i in range(2)]
+    for r in results:
+        assert r["last_loss"] < r["first_loss"] / 10, r
+    # SPMD lockstep: both hosts hold identical final weights
+    np.testing.assert_array_equal(np.asarray(results[0]["weight"]),
+                                  np.asarray(results[1]["weight"]))
+    # and they actually learned W_true
+    np.testing.assert_allclose(
+        np.asarray(results[0]["weight"]),
+        np.array([1.0, -2.0, 0.5, 3.0]), atol=0.2)
