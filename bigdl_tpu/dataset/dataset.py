@@ -106,7 +106,14 @@ class DataSet:
     """Factory namespace mirroring the reference's `DataSet` object."""
 
     @staticmethod
-    def array(items: Sequence, host_index: int = 0, num_hosts: int = 1) -> LocalDataSet:
+    def array(items: Sequence, host_index: Optional[int] = None,
+              num_hosts: Optional[int] = None) -> LocalDataSet:
+        """Defaults shard by the jax.distributed topology (process_index /
+        process_count), so multi-host runs feed per-host shards without
+        code changes; single host degenerates to LocalDataSet."""
+        if num_hosts is None:
+            import jax
+            num_hosts = jax.process_count()
         if num_hosts > 1:
             return DistributedDataSet(items, host_index, num_hosts)
         return LocalDataSet(items)

@@ -1,0 +1,171 @@
+"""Compute/communication overlap evidence for ParallelOptimizer (C16).
+
+The reference overlaps per-layer gradient sync with the remaining backward
+pass via priority queues + fetch threads (ParallelOptimizer.scala,
+DistriParameterSynchronizer.scala:66). On TPU that scheduling belongs to
+XLA (SPMD partitioner inserts per-parameter all-reduces; the combiner and
+latency-hiding scheduler then choose batching/overlap). This file checks
+the mechanics the claim rests on, on an 8-device CPU mesh:
+
+1. the compiled step carries a compiler-inserted gradient collective that
+   covers EVERY parameter gradient (the C15 "parameter plane is psum"
+   claim, checked structurally);
+2. before XLA's all-reduce combiner runs, the module holds per-parameter
+   all-reduces — the per-layer sync units the scheduler can interleave
+   (the combiner may later merge them; on TPU its thresholds keep chunks
+   pipelined with compute);
+3. async all-reduce-start/done pairs are well-formed when the backend
+   emits them (TPU lowering; CPU emits sync collectives);
+4. ParallelOptimizer trains bit-identically to DistriOptimizer (same
+   compiled program — the scheduler owns the overlap).
+"""
+
+import glob
+import os
+import re
+import subprocess
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+import bigdl_tpu.nn as nn
+import bigdl_tpu.optim as optim
+from bigdl_tpu.nn.module import functional_apply
+from bigdl_tpu.parallel.mesh import build_mesh
+
+_N_PARAMS = 6  # 3 Linear layers x (weight, bias)
+
+
+def _build_step_and_args():
+    mesh = build_mesh(data=8, model=1, devices=jax.devices()[:8])
+    model = (nn.Sequential()
+             .add(nn.Linear(64, 128)).add(nn.Tanh())
+             .add(nn.Linear(128, 128)).add(nn.Tanh())
+             .add(nn.Linear(128, 8)).add(nn.LogSoftMax()))
+    crit = nn.ClassNLLCriterion()
+    method = optim.SGD(learning_rate=0.01, momentum=0.9)
+    params = model.init(jax.random.PRNGKey(0))
+    put = lambda leaf: jax.device_put(leaf, NamedSharding(mesh, P()))
+    params = jax.tree_util.tree_map(put, params)
+    opt_state = method.init_state(params)
+
+    def step(params, opt_state, x, y):
+        def loss_fn(p):
+            out, _ = functional_apply(model, p, x)
+            return crit(out, y)
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        new_p, new_o = method.update(grads, opt_state, params, 0.01)
+        return new_p, new_o, loss
+
+    x = jax.device_put(jnp.ones((64, 64)), NamedSharding(mesh, P("data")))
+    y = jax.device_put(jnp.ones((64,), jnp.int32),
+                       NamedSharding(mesh, P("data")))
+    return step, (params, opt_state, x, y)
+
+
+def test_gradient_collective_covers_every_param():
+    step, args = _build_step_and_args()
+    hlo = jax.jit(step).lower(*args).compile().as_text()
+    # collect every tensor flowing through an all-reduce (single ops and
+    # combiner tuples alike)
+    ar_lines = [l for l in hlo.splitlines() if re.search(
+        r"= (\(.*\) )?all-reduce(-start)?\(", l) or " all-reduce(" in l]
+    assert ar_lines, "no compiler-inserted all-reduce in the SPMD step"
+    n_operands = sum(
+        max(1, l.count("f32[")) - l.count("get-tuple-element")
+        for l in ar_lines)
+    # 6 param grads + the mean loss term ride the collective(s)
+    assert n_operands >= _N_PARAMS, (
+        f"gradient collective covers {n_operands} tensors < {_N_PARAMS} "
+        f"params:\n" + "\n".join(ar_lines))
+
+
+_DUMP_DRIVER = r"""
+import os, sys
+sys.path.insert(0, os.environ["REPO_ROOT"])
+sys.path.insert(0, os.path.join(os.environ["REPO_ROOT"], "tests"))
+import jax
+jax.config.update("jax_platforms", "cpu")
+import test_overlap
+step, args = test_overlap._build_step_and_args()
+jax.jit(step).lower(*args).compile()
+print("COMPILED", flush=True)
+"""
+
+
+def test_per_parameter_allreduces_exist_before_combiner(tmp_path):
+    """Dump HLO before/after passes; the module entering the all-reduce
+    combiner holds one all-reduce per parameter gradient."""
+    dump = str(tmp_path / "dump")
+    env = dict(os.environ)
+    env.pop("PYTHONPATH", None)
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "XLA_FLAGS": (f"--xla_force_host_platform_device_count=8 "
+                      f"--xla_dump_to={dump} "
+                      f"--xla_dump_hlo_pass_re=all-reduce-combiner"),
+        "REPO_ROOT": os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))),
+    })
+    driver = tmp_path / "driver.py"
+    driver.write_text(_DUMP_DRIVER)
+    proc = subprocess.run([sys.executable, str(driver)], env=env,
+                          capture_output=True, text=True, timeout=600)
+    assert proc.returncode == 0, proc.stderr[-3000:]
+    before = [f for f in glob.glob(f"{dump}/*step*before*all-reduce-combiner*")
+              if f.endswith(".txt")]
+    if not before:  # pass not run on this backend: nothing to combine check
+        before = [f for f in glob.glob(f"{dump}/*step*.txt")]
+    assert before, f"no HLO dumps under {dump}"
+    text = max((open(f).read() for f in before), key=lambda t: t.count(
+        "all-reduce"))
+    n = len(re.findall(r"= f32\[[^\]]*\]\{?[^=]*all-reduce\(", text)) or \
+        text.count("all-reduce(")
+    assert n >= _N_PARAMS, (
+        f"only {n} all-reduces before the combiner; expected one per "
+        f"parameter gradient (>= {_N_PARAMS})")
+
+
+def test_async_collective_pairs_well_formed():
+    step, args = _build_step_and_args()
+    hlo = jax.jit(step).lower(*args).compile().as_text()
+    lines = hlo.splitlines()
+    starts = [i for i, l in enumerate(lines) if "all-reduce-start" in l]
+    dones = [i for i, l in enumerate(lines) if "all-reduce-done" in l]
+    assert len(starts) == len(dones)
+    for s in starts:
+        assert any(d > s for d in dones), \
+            "all-reduce-start without a later done"
+
+
+def test_parallel_optimizer_matches_distri():
+    """ParallelOptimizer is the same compiled program as DistriOptimizer
+    (the scheduler owns the overlap) — training results must be identical."""
+    from bigdl_tpu.dataset.sample import MiniBatch
+    from bigdl_tpu.dataset.dataset import LocalDataSet
+    from bigdl_tpu.optim.distri_optimizer import (DistriOptimizer,
+                                                  ParallelOptimizer)
+    from bigdl_tpu.optim.trigger import max_iteration
+
+    rs = np.random.RandomState(0)
+    batches = [MiniBatch(rs.rand(16, 8).astype(np.float32),
+                         (rs.randint(0, 3, 16) + 1).astype(np.int32))
+               for _ in range(2)]
+
+    def run(cls):
+        model = (nn.Sequential().add(nn.Linear(8, 16)).add(nn.ReLU())
+                 .add(nn.Linear(16, 3)).add(nn.LogSoftMax()))
+        o = cls(model, LocalDataSet(list(batches)), nn.ClassNLLCriterion())
+        o.set_optim_method(optim.SGD(learning_rate=0.1))
+        o.set_end_when(max_iteration(5))
+        o.optimize()
+        return model.ensure_params()
+
+    pa = run(DistriOptimizer)
+    pb = run(ParallelOptimizer)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_array_equal(np.asarray(a),
+                                                   np.asarray(b)), pa, pb)
