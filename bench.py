@@ -1,109 +1,12 @@
-"""Benchmark driver: prints ONE JSON line with the headline metric.
+"""Benchmark driver (repo-root entry the round driver runs).
 
-Headline metric (BASELINE.json north star): ResNet-50 training throughput,
-imgs/sec/chip, synthetic ImageNet-shaped data — the TPU analogue of the
-reference's DistriOptimizerPerf (DL/models/utils/DistriOptimizerPerf.scala:32)
-and its per-iteration "Throughput is X records/second" log line
-(DistriOptimizer.scala:405-410).
-
-vs_baseline: the reference publishes no absolute imgs/sec in-tree
-(BASELINE.md; whitepaper positioning is "comparable with mainstream GPU" on
-a Xeon cluster). We compare against 55 imgs/sec — a representative published
-figure for BigDL-era ResNet-50 training on one dual-socket Xeon node (the
-reference's per-node unit). Falls back to LeNet if ResNet-50 cannot run
-(tiny hosts), flagged in the metric name.
-
-Compute dtype: bf16 matmuls via jax default_matmul_precision — the MXU's
-native mode; params stay f32 (matching the reference's fp32 master weights
-with fp16 wire compression, FP16CompressedTensor.scala:143).
+The implementation lives in bigdl_tpu.tools.bench_cli so installed copies
+get the same driver via the `bigdl-tpu-bench` console script; see that
+module's docstring for metric definitions.
 """
 
-from __future__ import annotations
-
-import json
-import time
-
-import numpy as np
-
-
-def _train_throughput(model, in_shape, n_class, batch_size, warmup, iters,
-                      seq_target=False):
-    import jax
-    import jax.numpy as jnp
-    import bigdl_tpu.nn as nn
-    import bigdl_tpu.optim as optim
-    from bigdl_tpu.nn.module import functional_apply
-
-    crit = nn.ClassNLLCriterion()
-    method = optim.SGD(learning_rate=0.01, momentum=0.9)
-    params = model.init(jax.random.PRNGKey(0))
-    state = model.state_init()
-    opt_state = method.init_state(params)
-
-    rs = np.random.RandomState(0)
-    x = jnp.asarray(rs.rand(batch_size, *in_shape).astype(np.float32))
-    y = jnp.asarray((rs.randint(0, n_class, size=batch_size) + 1)
-                    .astype(np.int32))
-
-    def step(params, opt_state, state, x, y):
-        def loss_fn(p):
-            with jax.default_matmul_precision("bfloat16"):
-                out, new_s = functional_apply(model, p, x, state=state,
-                                              training=True)
-            return crit(out, y), new_s
-
-        (loss, new_s), grads = jax.value_and_grad(
-            loss_fn, has_aux=True)(params)
-        p2, s2 = method.update(grads, opt_state, params, 0.01)
-        return p2, s2, new_s, loss
-
-    # donating params/opt/state buffers saves an HBM copy per step
-    # (~8% measured on ResNet-50)
-    step = jax.jit(step, donate_argnums=(0, 1, 2))
-
-    for _ in range(warmup):
-        params, opt_state, state, loss = step(params, opt_state, state, x, y)
-    loss.block_until_ready()
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        params, opt_state, state, loss = step(params, opt_state, state, x, y)
-    loss.block_until_ready()
-    dt = time.perf_counter() - t0
-    return batch_size * iters / dt
-
-
-def bench_resnet50(batch_size: int = 128, warmup: int = 2, iters: int = 10):
-    from bigdl_tpu.models.resnet import ResNet50
-    return _train_throughput(ResNet50(class_num=1000), (224, 224, 3), 1000,
-                             batch_size, warmup, iters)
-
-
-def bench_lenet(batch_size: int = 512, warmup: int = 3, iters: int = 20):
-    from bigdl_tpu.models.lenet import LeNet5
-    return _train_throughput(LeNet5(10), (28, 28), 10, batch_size, warmup,
-                             iters)
-
-
-def main():
-    import jax
-    on_accel = jax.devices()[0].platform not in ("cpu",)
-    try:
-        if not on_accel:
-            raise RuntimeError("CPU host: ResNet-50 bench too slow")
-        throughput = bench_resnet50()
-        metric = "resnet50_train_imgs_per_sec_per_chip"
-        baseline = 55.0  # BigDL-era ResNet-50 imgs/sec on one Xeon node
-    except Exception:
-        throughput = bench_lenet()
-        metric = "lenet_train_throughput"
-        baseline = 100.0
-    print(json.dumps({
-        "metric": metric,
-        "value": round(throughput, 1),
-        "unit": "imgs/sec",
-        "vs_baseline": round(throughput / baseline, 2),
-    }))
-
+from bigdl_tpu.tools.bench_cli import (bench_lenet, bench_resnet50,  # noqa: F401
+                                       main)
 
 if __name__ == "__main__":
     main()

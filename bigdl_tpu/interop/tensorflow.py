@@ -147,6 +147,38 @@ class TensorflowLoader:
                 node = module.inputs(build(src))
                 built[key] = node
                 return node
+            if nd.op == "Merge":
+                if idx != 0:
+                    raise NotImplementedError(
+                        f"{base}:{idx}: Merge's value_index output is "
+                        "unsupported (only the merged value, ':0')")
+                # loop Merge closes a cycle through NextIteration: register
+                # the node with its forward inputs first, then attach the
+                # back edge so the recursive build terminates
+                from bigdl_tpu.nn.dynamic_graph import MergeOps
+                module = MergeOps(name=nd.name)
+                fwd = [a for a in raw_args
+                       if nodes[parse_ref(a)[0]].op != "NextIteration"]
+                back = [a for a in raw_args
+                        if nodes[parse_ref(a)[0]].op == "NextIteration"]
+                node = module.inputs(*[build(a) for a in fwd])
+                built[key] = node
+                for a in back:
+                    node.prev.append(build(a))
+                return node
+            if nd.op == "Switch":
+                # two outputs (false, true); every consumer selects its
+                # port — ':0' unqualified included, like TopK
+                from bigdl_tpu.interop._tf_modules import _TFTableSelect
+                raw = built.get((base, -1))
+                if raw is None:
+                    from bigdl_tpu.nn.dynamic_graph import SwitchOps
+                    module = SwitchOps(name=base)
+                    raw = module.inputs(*[build(a) for a in raw_args])
+                    built[(base, -1)] = raw
+                node = _TFTableSelect(idx, name=f"{base}.{idx}").inputs(raw)
+                built[key] = node
+                return node
             if nd.op in ("TopKV2", "TopK"):
                 # Table-producing op: every output (incl. :0) selects its
                 # element so 'name' means 'name:0' like TF
@@ -182,7 +214,14 @@ class TensorflowLoader:
         # inputs may include names never reached (pruned); keep request order
         ordered_inputs = [built[(_clean(i), 0)] for i in inputs
                           if (_clean(i), 0) in built]
-        graph = nn.Graph(ordered_inputs or input_nodes, out_nodes)
+        control_ops = {"Switch", "Merge", "Enter", "RefEnter", "Exit",
+                       "RefExit", "NextIteration", "LoopCond"}
+        if any(nodes[b].op in control_ops for b, _ in built
+               if b in nodes):
+            from bigdl_tpu.nn.dynamic_graph import DynamicGraph
+            graph = DynamicGraph(ordered_inputs or input_nodes, out_nodes)
+        else:
+            graph = nn.Graph(ordered_inputs or input_nodes, out_nodes)
         graph.evaluate()
         return graph
 
@@ -245,6 +284,22 @@ class TensorflowLoader:
         if op in ("Identity", "CheckNumerics", "StopGradient", "NoOp",
                   "PlaceholderWithDefault"):
             return nn.Identity(name=nd.name), args[:1]
+        if op in ("Enter", "RefEnter"):
+            from bigdl_tpu.nn.dynamic_graph import Enter
+            frame = a["frame_name"].s.decode() if "frame_name" in a else ""
+            return Enter(frame, name=nd.name), args[:1]
+        if op in ("Exit", "RefExit"):
+            from bigdl_tpu.nn.dynamic_graph import Exit
+            return Exit(name=nd.name), args[:1]
+        if op == "NextIteration":
+            from bigdl_tpu.nn.dynamic_graph import NextIteration
+            return NextIteration(name=nd.name), args[:1]
+        if op == "LoopCond":
+            from bigdl_tpu.nn.dynamic_graph import LoopCondOps
+            return LoopCondOps(name=nd.name), args[:1]
+        if op == "ControlTrigger":
+            from bigdl_tpu.nn.dynamic_graph import ControlTrigger
+            return ControlTrigger(name=nd.name), []
         if op == "Conv2D":
             w = const_arg(1)  # HWIO
             strides = list(a["strides"].list.i) or [1, 1, 1, 1]
@@ -313,6 +368,12 @@ class TensorflowLoader:
         if op == "BatchMatMul" or op == "BatchMatMulV2":
             from bigdl_tpu.interop._tf_modules import _TFMatMul
             return _TFMatMul(a["adj_x"].b, a["adj_y"].b, name=nd.name), args
+        if op in ("Add", "AddV2") and has_const(1) \
+                and consts[cn[1]].size == 1:
+            # scalar const add keeps the operand's shape (a (1,) CAdd bias
+            # would broadcast scalars up to rank 1)
+            return nn.AddConstant(float(consts[cn[1]]), name=nd.name), \
+                args[:1]
         if op in ("BiasAdd", "BiasAddV1") or (
                 op in ("Add", "AddV2") and has_const(1)
                 and consts[cn[1]].ndim <= 1):

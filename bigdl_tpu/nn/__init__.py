@@ -10,6 +10,11 @@ from bigdl_tpu.nn.containers import (Bottle, CAddTable, CAveTable, CDivTable,
                                      InputNode, JoinTable, MapTable,
                                      MixtureTable, NarrowTable, ParallelTable,
                                      SelectTable, Sequential, SplitTable)
+from bigdl_tpu.nn.dynamic_graph import (DEAD, ControlOps, ControlTrigger,
+                                        DynamicGraph, Enter, Exit,
+                                        FrameManager, LoopCondOps, MergeOps,
+                                        NextIteration, Scheduler, SwitchOps,
+                                        switch_port)
 from bigdl_tpu.nn.linear import (Add, AddConstant, Bilinear, CAdd, CMul,
                                  Cosine, Euclidean, Highway, Linear, Maxout,
                                  Mul, MulConstant, Scale)

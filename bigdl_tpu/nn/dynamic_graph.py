@@ -1,0 +1,395 @@
+"""Dynamic graph execution: TF1-style control flow (Switch/Merge/loops).
+
+Parity: `DynamicGraph` (DL/nn/DynamicGraph.scala:28), `Scheduler`
+(DL/nn/Scheduler.scala) and `FrameManager` (DL/nn/FrameManager.scala) —
+the reference executes graphs with data-dependent control flow op-by-op:
+a scheduler fires nodes as their inputs become ready, Switch emits a
+"dead" token on the untaken branch, Merge fires on its first live input,
+and Enter/Exit/NextIteration run loop bodies under execution frames.
+
+TPU translation: the HOST drives the control decisions exactly like the
+reference's Scheduler (this is unavoidable for TF1 graphs — the loop
+structure is data-dependent), while every fired node still executes as an
+XLA computation. Graphs WITHOUT control ops should use `nn.Graph`, whose
+whole DAG traces into one jit program; `lax.cond`/`lax.while_loop` remain
+the idiomatic way to author new control flow inside jit (Graph docstring).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+import jax.numpy as jnp
+
+from bigdl_tpu.nn.containers import Container, Graph
+from bigdl_tpu.nn.module import ApplyContext, Module, Node
+from bigdl_tpu.utils.table import Table
+
+
+class _Dead:
+    """The dead token TF1 executors propagate down untaken branches
+    (Scheduler.scala's nodeStatus)."""
+
+    def __repr__(self):
+        return "<dead>"
+
+
+DEAD = _Dead()
+
+
+# ------------------------------------------------------------ control ops
+class ControlOps(Module):
+    """Marker base (DL/nn/tf/ControlOps.scala)."""
+
+
+class SwitchOps(ControlOps):
+    """switch(data, pred) -> (false_out, true_out); the untaken port is
+    DEAD; any dead input kills both ports (TF1 executor semantics)."""
+
+    def apply(self, params, input, ctx):
+        data, pred = input[1], input[2]
+        if data is DEAD or pred is DEAD:
+            return Table(DEAD, DEAD)
+        taken = bool(pred)
+        return Table(DEAD if taken else data, data if taken else DEAD)
+
+
+class MergeOps(ControlOps):
+    """Fires on the first live input (DL/nn/tf/ControlOps.scala
+    MergeOps); value = that input."""
+
+    def apply(self, params, input, ctx):
+        for v in list(input):
+            if v is not DEAD:
+                return v
+        return DEAD
+
+
+class Enter(ControlOps):
+    """Bring a value into a loop frame (frame entry marker)."""
+
+    def __init__(self, frame: str = "", name=None):
+        super().__init__(name)
+        self.frame = frame
+
+    def apply(self, params, input, ctx):
+        return input
+
+
+class Exit(ControlOps):
+    """Leave the loop frame with the final value. The Scheduler holds an
+    Exit back until its input is LIVE — during loop iterations it simply
+    has not produced yet (TF1 executors never send dead down an Exit while
+    the loop runs)."""
+
+    def apply(self, params, input, ctx):
+        return input
+
+
+class NextIteration(ControlOps):
+    """Feed a value to the next loop iteration (the back edge)."""
+
+    def apply(self, params, input, ctx):
+        return input
+
+
+class LoopCondOps(ControlOps):
+    """Marks the loop predicate."""
+
+    def apply(self, params, input, ctx):
+        return input
+
+
+class ControlTrigger(ControlOps):
+    def apply(self, params, input, ctx):
+        return Table()
+
+
+class _Frame:
+    """One loop frame: its Merges (loop variables), back edges, member
+    nodes (re-fired every iteration) and Exit boundary."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self.merges: List[Node] = []
+        self.back_edges: List[Tuple[Node, Node]] = []  # (next_iter, merge)
+        self.members: Set[int] = set()
+
+
+class FrameManager:
+    """Loop-frame bookkeeping (DL/nn/FrameManager.scala): groups loop
+    Merges into frames by their Enter's frame name, walks each frame's
+    membership (everything the iteration re-fires), and identifies the
+    frame's Exit boundary so outer walks pass through inner loops."""
+
+    def __init__(self, nodes: Sequence[Node]):
+        succ: Dict[int, List[Node]] = {}
+        for n in nodes:
+            for p in n.prev:
+                succ.setdefault(id(p), []).append(n)
+
+        frames: Dict[object, _Frame] = {}
+        for n in nodes:
+            if not isinstance(n.module, MergeOps):
+                continue
+            nis = [p for p in n.prev if isinstance(p.module, NextIteration)]
+            if not nis:
+                continue
+            # frame identity: the LoopCond driving this merge's Switch —
+            # all loop vars of one while share it, and two independent
+            # loops never do (frame NAMES may both be '' in hand-built
+            # graphs, so the name alone cannot key the frame)
+            key: object = None
+            for s in succ.get(id(n), []):
+                if isinstance(s.module, SwitchOps) and len(s.prev) > 1:
+                    cand = s.prev[1]
+                    if isinstance(cand.module, LoopCondOps):
+                        key = id(cand)
+                        break
+            if key is None:
+                enters = [p for p in n.prev if isinstance(p.module, Enter)]
+                key = enters[0].module.frame if enters and \
+                    enters[0].module.frame else id(n)
+            fr = frames.setdefault(key, _Frame(str(key)))
+            fr.merges.append(n)
+            fr.back_edges.extend((ni, n) for ni in nis)
+        self.frames = list(frames.values())
+
+        # a frame's own Exits: Exit fed (possibly via a Switch-port
+        # selector) by a Switch whose data input is one of the frame's
+        # Merges — the canonical tf.while_loop shape and this DSL's
+        for fr in self.frames:
+            merge_ids = {id(m) for m in fr.merges}
+            own_exits: Set[int] = set()
+            for n in nodes:
+                if not isinstance(n.module, Exit):
+                    continue
+                seen: Set[int] = set()
+                stack = list(n.prev)
+                hops = 0
+                while stack and hops < 8:
+                    p = stack.pop()
+                    hops += 1
+                    if id(p) in seen:
+                        continue
+                    seen.add(id(p))
+                    if isinstance(p.module, SwitchOps):
+                        if p.prev and id(p.prev[0]) in merge_ids:
+                            own_exits.add(id(n))
+                        break
+                    stack.extend(p.prev)
+            # membership: reachable from the frame's merges, stopping at
+            # (but including) this frame's own Exits
+            stack = list(fr.merges)
+            while stack:
+                n = stack.pop()
+                if id(n) in fr.members:
+                    continue
+                fr.members.add(id(n))
+                if id(n) in own_exits:
+                    continue
+                stack.extend(succ.get(id(n), []))
+
+    @property
+    def has_loops(self) -> bool:
+        return bool(self.frames)
+
+
+class Scheduler:
+    """Ready-queue executor with dead-token propagation
+    (DL/nn/Scheduler.scala). One `run` = one full forward; loop frames
+    re-fire their member nodes until the loop predicate goes false."""
+
+    MAX_ITERATIONS = 1_000_000
+
+    def __init__(self, nodes: Sequence[Node], frames: FrameManager):
+        self.nodes = list(nodes)
+        self.frames = frames
+
+    def run(self, fire, outputs: Sequence[Node]):
+        """`fire(node, values) -> value` executes one node given the dict
+        of produced values (keyed by node id). Successor-triggered ready
+        queue (Scheduler.scala's shape): firing a node enqueues exactly
+        the consumers it may have unblocked — O(edges) per loop sweep."""
+        from collections import deque
+
+        succ: Dict[int, List[Node]] = {}
+        for n in self.nodes:
+            for p in n.prev:
+                succ.setdefault(id(p), []).append(n)
+
+        values: Dict[int, object] = {}
+        q = deque(n for n in self.nodes if self._ready(n, values))
+        iterations = 0
+        while True:
+            while q:
+                n = q.popleft()
+                if id(n) in values or not self._ready(n, values):
+                    continue
+                values[id(n)] = fire(n, values)
+                for s in succ.get(id(n), []):
+                    if id(s) not in values and self._ready(s, values):
+                        q.append(s)
+            if all(id(o) in values and values[id(o)] is not DEAD
+                   for o in outputs):
+                break
+            if self._advance_frame(values):
+                iterations += 1
+                if iterations > self.MAX_ITERATIONS:
+                    raise RuntimeError("loop exceeded MAX_ITERATIONS")
+                q = deque(n for n in self.nodes
+                          if id(n) not in values and self._ready(n, values))
+                continue
+            stuck = [n.module.name for n in self.nodes
+                     if id(n) not in values]
+            raise RuntimeError(
+                f"dynamic graph deadlock; unfired nodes: {stuck[:10]}")
+        return values
+
+    # -- helpers
+    def _ready(self, node: Node, values) -> bool:
+        if isinstance(node.module, MergeOps):
+            # fires on ANY live input (TF1 Merge semantics)
+            return any(id(p) in values and values[id(p)] is not DEAD
+                       for p in node.prev) or \
+                all(id(p) in values for p in node.prev)
+        if not all(id(p) in values for p in node.prev):
+            return False
+        if isinstance(node.module, Exit):
+            # Exit produces nothing until the loop delivers a live value
+            return all(values[id(p)] is not DEAD for p in node.prev) and \
+                not self._port_dead(node, values)
+        return True
+
+    def _port_dead(self, node: Node, values) -> bool:
+        """True when the node's recorded Switch port currently carries
+        DEAD (the Switch output Table itself is live)."""
+        ports = getattr(node, "_switch_ports", None)
+        if not ports:
+            return False
+        for p in node.prev:
+            port = ports.get(id(p))
+            if port is None:
+                continue
+            v = values.get(id(p))
+            if isinstance(v, Table) and v[port + 1] is DEAD:
+                return True
+        return False
+
+    def _advance_frame(self, values) -> bool:
+        """Start the next iteration of the innermost stalled frame: clear
+        its members and reseed its Merges from the live back edges
+        (FrameManager.scala's role)."""
+        candidates = []
+        for fr in self.frames.frames:
+            back_vals = [(ni, m) for ni, m in fr.back_edges
+                         if id(ni) in values]
+            if len(back_vals) != len(fr.back_edges):
+                continue  # this frame's iteration has not finished
+            live = [(ni, m) for ni, m in back_vals
+                    if values[id(ni)] is not DEAD]
+            if live:
+                candidates.append((fr, live))
+        if not candidates:
+            return False
+        # innermost = smallest membership (an outer frame's walk contains
+        # every inner frame's nodes)
+        fr, live = min(candidates, key=lambda c: len(c[0].members))
+        carried = {id(m): values[id(ni)] for ni, m in live}
+        for n in self.nodes:
+            if id(n) in fr.members:
+                values.pop(id(n), None)
+        for m_id, v in carried.items():
+            values[m_id] = v
+        return True
+
+
+class DynamicGraph(Graph):
+    """Graph that executes control ops (DL/nn/DynamicGraph.scala). Build
+    with the same node DSL as Graph; back edges (NextIteration -> Merge)
+    are allowed."""
+
+    def __init__(self, inputs: Sequence[Node], outputs: Sequence[Node],
+                 name=None):
+        # bypass Graph.__init__: its topo sort rejects the loop back edges
+        Container.__init__(self, name)
+        self.input_nodes = list(inputs)
+        self.output_nodes = list(outputs)
+        self.exec_order = self._collect_nodes()  # reverse-reach order
+        self._frames = FrameManager(self.exec_order)
+        self._scheduler = Scheduler(self.exec_order, self._frames)
+        for n in self.exec_order:
+            self.children.append(n.module)
+            self._child_keys.append(n.key)
+
+    def _collect_nodes(self):
+        nodes: List[Node] = []
+        seen: Set[int] = set()
+        stack = list(self.output_nodes)
+        while stack:
+            n = stack.pop()
+            if id(n) in seen:
+                continue
+            seen.add(id(n))
+            nodes.append(n)
+            stack.extend(n.prev)  # seen-set breaks the loop cycles
+        return nodes
+
+    def apply(self, params, input, ctx: ApplyContext):
+        if isinstance(input, Table):
+            inputs = list(input)
+        elif isinstance(input, (list, tuple)):
+            inputs = list(input)
+        else:
+            inputs = [input]
+        if len(inputs) != len(self.input_nodes):
+            raise ValueError(
+                f"graph expects {len(self.input_nodes)} inputs, "
+                f"got {len(inputs)}")
+
+        input_vals = {id(n): v for n, v in zip(self.input_nodes, inputs)}
+
+        def fire(node: Node, values):
+            if id(node) in input_vals:
+                return input_vals[id(node)]
+            args = []
+            for p in node.prev:
+                v = values.get(id(p), DEAD)
+                if isinstance(p.module, SwitchOps):
+                    # consumer picks its Switch port by recorded edge index
+                    port = getattr(node, "_switch_ports", {}).get(id(p))
+                    if port is not None and not isinstance(v, _Dead):
+                        v = v[port + 1]
+                args.append(v)
+            if not isinstance(node.module, ControlOps) and any(
+                    a is DEAD for a in args):
+                return DEAD  # dead propagation through ordinary ops
+            if not args:
+                x = Table()
+            else:
+                x = args[0] if len(args) == 1 else Table(*args)
+            key = node.key
+            p = params.get(key, {}) if isinstance(params, dict) else {}
+            ctx.push(key)
+            try:
+                return node.module.apply(p, x, ctx)
+            finally:
+                ctx.pop()
+
+        values = self._scheduler.run(fire, self.output_nodes)
+        outs = [values[id(o)] for o in self.output_nodes]
+        return outs[0] if len(outs) == 1 else Table(*outs)
+
+    def init(self, rng):
+        # children/_child_keys mirror exec_order, so Container.init's
+        # pre-loaded-params rule applies unchanged
+        return {k: v for k, v in Container.init(self, rng).items() if v}
+
+
+def switch_port(consumer: Node, switch_node: Node, port: int) -> Node:
+    """Record which Switch output port `consumer` reads (0 = false,
+    1 = true). TF refs carry this as 'switch:0' / 'switch:1'."""
+    if not hasattr(consumer, "_switch_ports"):
+        consumer._switch_ports = {}
+    consumer._switch_ports[id(switch_node)] = port
+    return consumer

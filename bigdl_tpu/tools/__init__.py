@@ -1,0 +1,1 @@
+"""Command-line tools (perf driver, protobuf codegen helpers)."""

@@ -1,0 +1,214 @@
+"""DynamicGraph control-flow execution (DL/nn/DynamicGraph.scala,
+Scheduler.scala, FrameManager.scala)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import bigdl_tpu.nn as nn
+from bigdl_tpu.nn.dynamic_graph import switch_port
+from bigdl_tpu.utils.table import Table
+
+
+def _cond_graph():
+    """if pred: x * 2 else: x + 10 (TF1 Switch/Merge lowering of cond)."""
+    x_in, p_in = nn.InputNode(), nn.InputNode()
+    sw = nn.SwitchOps().inputs(x_in, p_in)
+    true_b = switch_port(nn.MulConstant(2.0).inputs(sw), sw, 1)
+    false_b = switch_port(nn.AddConstant(10.0).inputs(sw), sw, 0)
+    merge = nn.MergeOps().inputs(true_b, false_b)
+    return nn.DynamicGraph([x_in, p_in], [merge])
+
+
+class TestCond:
+    def test_true_branch(self):
+        g = _cond_graph()
+        out = g.forward(Table(jnp.asarray([3.0, 4.0]), jnp.asarray(True)))
+        np.testing.assert_allclose(np.asarray(out), [6.0, 8.0])
+
+    def test_false_branch(self):
+        g = _cond_graph()
+        out = g.forward(Table(jnp.asarray([3.0, 4.0]), jnp.asarray(False)))
+        np.testing.assert_allclose(np.asarray(out), [13.0, 14.0])
+
+    def test_dead_branch_not_executed(self):
+        calls = []
+
+        class Probe(nn.Identity):
+            def apply(self, params, input, ctx):
+                calls.append(1)
+                return input
+
+        x_in, p_in = nn.InputNode(), nn.InputNode()
+        sw = nn.SwitchOps().inputs(x_in, p_in)
+        true_b = switch_port(nn.MulConstant(2.0).inputs(sw), sw, 1)
+        probe = Probe()
+        false_b = switch_port(probe.inputs(sw), sw, 0)
+        merge = nn.MergeOps().inputs(true_b, false_b)
+        g = nn.DynamicGraph([x_in, p_in], [merge])
+        out = g.forward(Table(jnp.asarray([1.0]), jnp.asarray(True)))
+        np.testing.assert_allclose(np.asarray(out), [2.0])
+        # the dead branch's op body never ran with live data: Probe fired
+        # only to propagate the dead token -> our executor short-circuits
+        # before apply, so no calls at all
+        assert calls == []
+
+
+class TestWhileLoop:
+    def _loop_graph(self, limit: float):
+        """while i < limit: i = i + 1 (TF1 Enter/Merge/LoopCond/Switch/
+        NextIteration/Exit lowering of tf.while_loop)."""
+        import bigdl_tpu.ops as ops
+        from bigdl_tpu.interop._tf_modules import _TFConst
+
+        i_in = nn.InputNode()
+        enter = nn.Enter(frame="loop").inputs(i_in)
+        merge = nn.MergeOps().inputs(enter)
+        lim = _TFConst(np.asarray(limit, np.float32)).inputs()
+        pred = ops.Less().inputs(merge, lim)
+        cond = nn.LoopCondOps().inputs(pred)
+        sw = nn.SwitchOps().inputs(merge, cond)
+        body = switch_port(nn.AddConstant(1.0).inputs(sw), sw, 1)
+        ni = nn.NextIteration().inputs(body)
+        merge.prev.append(ni)  # the back edge
+        exit_ = switch_port(nn.Exit().inputs(sw), sw, 0)
+        return nn.DynamicGraph([i_in], [exit_])
+
+    def test_counts_to_limit(self):
+        g = self._loop_graph(10.0)
+        out = g.forward(jnp.asarray(0.0))
+        np.testing.assert_allclose(float(np.asarray(out)), 10.0)
+
+    def test_zero_iterations(self):
+        g = self._loop_graph(10.0)
+        out = g.forward(jnp.asarray(42.0))  # already >= limit
+        np.testing.assert_allclose(float(np.asarray(out)), 42.0)
+
+    def test_loop_with_parametrized_body(self):
+        """Loop body containing a real layer: x = relu(x) - 0.5 until
+        sum < 1."""
+        import bigdl_tpu.ops as ops
+        from bigdl_tpu.interop._tf_modules import _TFConst
+
+        x_in = nn.InputNode()
+        enter = nn.Enter().inputs(x_in)
+        merge = nn.MergeOps().inputs(enter)
+        s = ops.Sum(axis=0).inputs(merge)
+        lim = _TFConst(np.asarray(1.0, np.float32)).inputs()
+        pred = ops.Greater().inputs(s, lim)
+        cond = nn.LoopCondOps().inputs(pred)
+        sw = nn.SwitchOps().inputs(merge, cond)
+        relu = switch_port(nn.ReLU().inputs(sw), sw, 1)
+        step = nn.AddConstant(-0.5).inputs(relu)
+        ni = nn.NextIteration().inputs(step)
+        merge.prev.append(ni)
+        exit_ = switch_port(nn.Exit().inputs(sw), sw, 0)
+        g = nn.DynamicGraph([x_in], [exit_])
+        out = np.asarray(g.forward(jnp.asarray([2.0, 2.0])))
+        # iter1: [1.5,1.5] iter2: [1,1] iter3: [.5,.5] sum=1 -> stop
+        np.testing.assert_allclose(out, [0.5, 0.5])
+
+    def test_runaway_loop_guard(self):
+        g = self._loop_graph(float("inf"))
+        old = nn.Scheduler.MAX_ITERATIONS
+        nn.Scheduler.MAX_ITERATIONS = 50
+        try:
+            with pytest.raises(RuntimeError, match="MAX_ITERATIONS"):
+                g.forward(jnp.asarray(0.0))
+        finally:
+            nn.Scheduler.MAX_ITERATIONS = old
+
+
+class TestLoopComposition:
+    def test_ops_after_loop_exit(self):
+        """Post-processing after the loop result (review regression: nodes
+        downstream of Exit must wait, not cache a dead token)."""
+        import bigdl_tpu.ops as ops
+        from bigdl_tpu.interop._tf_modules import _TFConst
+
+        i_in = nn.InputNode()
+        enter = nn.Enter().inputs(i_in)
+        merge = nn.MergeOps().inputs(enter)
+        lim = _TFConst(np.asarray(10.0, np.float32)).inputs()
+        pred = ops.Less().inputs(merge, lim)
+        cond = nn.LoopCondOps().inputs(pred)
+        sw = nn.SwitchOps().inputs(merge, cond)
+        body = switch_port(nn.AddConstant(1.0).inputs(sw), sw, 1)
+        ni = nn.NextIteration().inputs(body)
+        merge.prev.append(ni)
+        exit_ = switch_port(nn.Exit().inputs(sw), sw, 0)
+        post = nn.MulConstant(2.0).inputs(exit_)   # <- after the loop
+        g = nn.DynamicGraph([i_in], [post])
+        out = float(np.asarray(g.forward(jnp.asarray(0.0))))
+        assert out == 20.0
+
+    def test_nested_while_loops(self):
+        """outer: for i in range(3): x = inner_loop(x) where
+        inner: while x % 4 != 0: x += 1 — i.e. 3 rounds of round-up-to-
+        multiple-of-4 then +1."""
+        import bigdl_tpu.ops as ops
+        from bigdl_tpu.interop._tf_modules import _TFConst
+
+        x_in = nn.InputNode()
+        # outer loop: counter + value as two loop vars
+        enter_c = nn.Enter(frame="outer").inputs(
+            _TFConst(np.asarray(0.0, np.float32)).inputs())
+        enter_x = nn.Enter(frame="outer").inputs(x_in)
+        merge_c = nn.MergeOps().inputs(enter_c)
+        merge_x = nn.MergeOps().inputs(enter_x)
+        three = _TFConst(np.asarray(3.0, np.float32)).inputs()
+        opred = ops.Less().inputs(merge_c, three)
+        ocond = nn.LoopCondOps().inputs(opred)
+        sw_c = nn.SwitchOps().inputs(merge_c, ocond)
+        sw_x = nn.SwitchOps().inputs(merge_x, ocond)
+        # outer body: inner loop over x
+        inner_in = switch_port(nn.AddConstant(1.0).inputs(sw_x), sw_x, 1)
+        enter_i = nn.Enter(frame="inner").inputs(inner_in)
+        merge_i = nn.MergeOps().inputs(enter_i)
+        four = _TFConst(np.asarray(4.0, np.float32)).inputs()
+        rem = ops.FloorMod().inputs(merge_i, four)
+        zero = _TFConst(np.asarray(0.0, np.float32)).inputs()
+        ipred = ops.Greater().inputs(rem, zero)
+        icond = nn.LoopCondOps().inputs(ipred)
+        sw_i = nn.SwitchOps().inputs(merge_i, icond)
+        ibody = switch_port(nn.AddConstant(1.0).inputs(sw_i), sw_i, 1)
+        ini = nn.NextIteration().inputs(ibody)
+        merge_i.prev.append(ini)
+        iexit = switch_port(nn.Exit().inputs(sw_i), sw_i, 0)
+        # close the outer loop
+        c_next = switch_port(nn.AddConstant(1.0).inputs(sw_c), sw_c, 1)
+        ni_c = nn.NextIteration().inputs(c_next)
+        ni_x = nn.NextIteration().inputs(iexit)
+        merge_c.prev.append(ni_c)
+        merge_x.prev.append(ni_x)
+        exit_x = switch_port(nn.Exit().inputs(sw_x), sw_x, 0)
+        g = nn.DynamicGraph([x_in], [exit_x])
+        # x=1: +1=2 -> 4; +1=5 -> 8; +1=9 -> 12
+        out = float(np.asarray(g.forward(jnp.asarray(1.0))))
+        assert out == 12.0
+
+    def test_two_sequential_independent_loops(self):
+        """Two separate while loops with DEFAULT frame names must not
+        coalesce (frames key on their LoopCond, not the name)."""
+        import bigdl_tpu.ops as ops
+        from bigdl_tpu.interop._tf_modules import _TFConst
+
+        def count_up_to(src_node, limit):
+            enter = nn.Enter().inputs(src_node)
+            merge = nn.MergeOps().inputs(enter)
+            lim = _TFConst(np.asarray(limit, np.float32)).inputs()
+            pred = ops.Less().inputs(merge, lim)
+            cond = nn.LoopCondOps().inputs(pred)
+            sw = nn.SwitchOps().inputs(merge, cond)
+            body = switch_port(nn.AddConstant(1.0).inputs(sw), sw, 1)
+            ni = nn.NextIteration().inputs(body)
+            merge.prev.append(ni)
+            return switch_port(nn.Exit().inputs(sw), sw, 0)
+
+        x_in = nn.InputNode()
+        first = count_up_to(x_in, 5.0)    # -> 5
+        scaled = nn.MulConstant(2.0).inputs(first)  # -> 10
+        second = count_up_to(scaled, 13.0)          # -> 13
+        g = nn.DynamicGraph([x_in], [second])
+        out = float(np.asarray(g.forward(jnp.asarray(0.0))))
+        assert out == 13.0

@@ -473,6 +473,17 @@ SKIP = {
     "Assert": "side-effecting op (raises on false), exercised in "
               "test_tf_import_ops.py",
     "NoOp": "placeholder with no output contract",
+    "ControlOps": "abstract control-op base (DynamicGraph)",
+    "SwitchOps": "control op emitting dead tokens; needs DynamicGraph "
+                 "scheduling, covered by tests/test_dynamic_graph.py",
+    "MergeOps": "ditto",
+    "Enter": "loop-frame marker, covered by test_dynamic_graph.py",
+    "Exit": "ditto",
+    "NextIteration": "ditto",
+    "LoopCondOps": "ditto",
+    "ControlTrigger": "control-dependency trigger, no tensor contract",
+    "DynamicGraph": "needs node-DSL wiring incl. back edges; exercised by "
+                    "test_dynamic_graph.py + TF control-flow import tests",
     "Proposal": "two-stage detection op requiring RPN tensors; exercised "
                 "in test_detection.py",
     "DetectionOutputFrcnn": "detection post-processor with dynamic-shaped "

@@ -507,3 +507,54 @@ class TestSubstr:
         g = _graph(outs=["y"], build=b)
         out = g.forward(np.array([b"hello", b"world!"], object))
         assert list(np.asarray(out).reshape(-1)) == [b"hel", b"wor"]
+
+
+class TestControlFlowImport:
+    """TF1 control flow -> DynamicGraph (reference DynamicGraph.scala /
+    FrameManager.scala; loaders ControlFlowOps.scala)."""
+
+    def test_cond_switch_merge(self):
+        # pred ? x*2 : x+10
+        def build(gd):
+            gd.node.add(name="sw", op="Switch", input=["x", "pred"])
+            two = gd.node.add(name="two", op="Const")
+            two.attr["value"].tensor.CopyFrom(
+                ndarray_to_tensor(np.asarray(2.0, np.float32)))
+            ten = gd.node.add(name="ten", op="Const")
+            ten.attr["value"].tensor.CopyFrom(
+                ndarray_to_tensor(np.asarray(10.0, np.float32)))
+            gd.node.add(name="tb", op="Mul", input=["sw:1", "two"])
+            gd.node.add(name="fb", op="AddV2", input=["sw:0", "ten"])
+            gd.node.add(name="out", op="Merge", input=["tb", "fb"])
+        g = _graph(outs=["out"], ins=("x", "pred"), build=build)
+        x = np.array([3.0, 4.0], np.float32)
+        got_t = np.asarray(g.forward([jnp.asarray(x),
+                                      jnp.asarray(True)]))
+        np.testing.assert_allclose(got_t, [6.0, 8.0])
+        got_f = np.asarray(g.forward([jnp.asarray(x),
+                                      jnp.asarray(False)]))
+        np.testing.assert_allclose(got_f, [13.0, 14.0])
+
+    def test_while_loop(self):
+        # while i < 10: i += 1   (canonical tf.while_loop lowering)
+        def build(gd):
+            e = gd.node.add(name="enter", op="Enter", input=["x"])
+            e.attr["frame_name"].s = b"loop"
+            gd.node.add(name="merge", op="Merge", input=["enter", "ni"])
+            lim = gd.node.add(name="lim", op="Const")
+            lim.attr["value"].tensor.CopyFrom(
+                ndarray_to_tensor(np.asarray(10.0, np.float32)))
+            gd.node.add(name="pred", op="Less", input=["merge", "lim"])
+            gd.node.add(name="cond", op="LoopCond", input=["pred"])
+            gd.node.add(name="sw", op="Switch", input=["merge", "cond"])
+            one = gd.node.add(name="one", op="Const")
+            one.attr["value"].tensor.CopyFrom(
+                ndarray_to_tensor(np.asarray(1.0, np.float32)))
+            gd.node.add(name="add", op="AddV2", input=["sw:1", "one"])
+            gd.node.add(name="ni", op="NextIteration", input=["add"])
+            gd.node.add(name="exit", op="Exit", input=["sw:0"])
+        g = _graph(outs=["exit"], build=build)
+        out = float(np.asarray(g.forward(jnp.asarray(0.0))))
+        assert out == 10.0
+        out = float(np.asarray(g.forward(jnp.asarray(42.0))))
+        assert out == 42.0
