@@ -35,6 +35,12 @@ class Cell(Module):
     def zero_state(self, batch: int, dtype=jnp.float32):
         raise NotImplementedError
 
+    def zero_state_for(self, x):
+        """Zero state inferred from ONE timestep of input `x` [B, ...].
+        Cells whose state depends on more than the batch dim (ConvLSTM
+        spatial maps) override this — callers never do shape bookkeeping."""
+        return self.zero_state(x.shape[0], x.dtype)
+
     def step(self, params, x, state, ctx):
         raise NotImplementedError
 
@@ -206,6 +212,11 @@ class MultiRNNCell(Cell):
     def zero_state(self, batch, dtype=jnp.float32):
         return tuple(c.zero_state(batch, dtype) for c in self.cells)
 
+    def zero_state_for(self, x):
+        # stacked cells share batch/spatial dims; channel dims come from
+        # each cell's own config
+        return tuple(c.zero_state_for(x) for c in self.cells)
+
     def step(self, params, x, state, ctx):
         new_states = []
         out = x
@@ -246,8 +257,12 @@ class ConvLSTMPeephole(Cell):
 
     def zero_state(self, batch, dtype=jnp.float32):
         raise NotImplementedError(
-            "ConvLSTM zero state needs spatial dims; use Recurrent with "
-            "explicit initial state or infer from input in scan wrapper")
+            "ConvLSTM zero state needs spatial dims; pass one input step "
+            "to zero_state_for(x) instead")
+
+    def zero_state_for(self, x):
+        return self.zero_state_hw(x.shape[0], x.shape[1], x.shape[2],
+                                  x.dtype)
 
     def zero_state_hw(self, batch, h, w, dtype=jnp.float32):
         z = jnp.zeros((batch, h, w, self.c_out), dtype)
@@ -291,13 +306,7 @@ class Recurrent(Module):
     def apply(self, params, input, ctx):
         x = input  # [B, T, ...]
         batch = x.shape[0]
-        if isinstance(self.cell, ConvLSTMPeephole3D):
-            init_state = self.cell.zero_state_dhw(
-                batch, x.shape[2], x.shape[3], x.shape[4])
-        elif isinstance(self.cell, ConvLSTMPeephole):
-            init_state = self.cell.zero_state_hw(batch, x.shape[2], x.shape[3])
-        else:
-            init_state = self.cell.zero_state(batch, x.dtype)
+        init_state = self.cell.zero_state_for(x[:, 0])
         xs = jnp.swapaxes(x, 0, 1)  # [T, B, ...]
         if self.reverse:
             xs = jnp.flip(xs, axis=0)
@@ -363,7 +372,7 @@ class RecurrentDecoder(Module):
 
     def apply(self, params, input, ctx):
         batch = input.shape[0]
-        state = self.cell.zero_state(batch, input.dtype)
+        state = self.cell.zero_state_for(input)
         cell_params = params["cell"]
         training = ctx.training
 
@@ -439,6 +448,10 @@ class ConvLSTMPeephole3D(Cell):
             p["peep_f"] = jnp.zeros((self.c_out,))
             p["peep_o"] = jnp.zeros((self.c_out,))
         return p
+
+    def zero_state_for(self, x):
+        return self.zero_state_dhw(x.shape[0], x.shape[1], x.shape[2],
+                                   x.shape[3], x.dtype)
 
     def zero_state_dhw(self, batch, d, h, w, dtype=jnp.float32):
         z = jnp.zeros((batch, d, h, w, self.c_out), dtype)

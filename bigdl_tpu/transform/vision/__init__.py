@@ -21,3 +21,5 @@ from bigdl_tpu.transform.vision.convertor import (ImageFeatureToSample,
                                                   ImageFrameToSample,
                                                   MatToFloats, MatToTensor,
                                                   MTImageFeatureToBatch)
+from bigdl_tpu.transform.vision.image_record import (ImageRecordDataset,
+                                                     write_image_records)

@@ -1,0 +1,110 @@
+"""Packed image-record shards: the ImageNet-scale input format.
+
+Parity role: the reference packs ImageNet into Hadoop SequenceFiles of
+encoded JPEGs (BGRImgToSeqFile / SeqFileToBytes in
+DL/dataset/image/..., consumed by the ImageNet examples). The TPU-native
+equivalent is TFRecord shards of {image bytes, label, uri} records — the
+format every TPU input pipeline ships — read back through the native
+prefetch reader (native/loader.cc) so decode overlaps the step loop.
+
+write_image_records(features, prefix, shards) packs ImageFeatures;
+ImageRecordDataset(paths) streams them back as ImageFeatures, pluggable
+straight into FeatureTransformer chains / MTImageFeatureToBatch.
+"""
+
+from __future__ import annotations
+
+import glob as _glob
+import io
+from typing import Iterable, Iterator, List, Optional, Sequence, Union
+
+import numpy as np
+
+from bigdl_tpu.interop.tfrecord import (bytes_feature, float_feature,
+                                        int64_feature, make_example,
+                                        parse_example, write_tfrecord)
+from bigdl_tpu.transform.vision.image import ImageFeature
+
+
+def _encode_png(img: np.ndarray, from_bgr: bool = True) -> bytes:
+    """Lossless PNG encode of an HWC uint8 image (PIL host-side, like the
+    reference's OpenCV imencode). Pipeline images are BGR (ImageFeature
+    convention); PNG stores RGB, so flip back before encoding."""
+    from PIL import Image
+    arr = np.asarray(img)
+    if arr.dtype != np.uint8:
+        arr = np.clip(arr, 0, 255).astype(np.uint8)
+    if from_bgr and arr.ndim == 3 and arr.shape[2] == 3:
+        arr = arr[..., ::-1]
+    buf = io.BytesIO()
+    Image.fromarray(arr).save(buf, format="PNG")
+    return buf.getvalue()
+
+
+def _decode_image(raw: bytes) -> np.ndarray:
+    """Mirror ImageFeature.from_bytes: force 3-channel RGB then flip to the
+    pipeline's BGR convention (grayscale/RGBA sources normalize too)."""
+    from PIL import Image
+    with Image.open(io.BytesIO(raw)) as im:
+        arr = np.asarray(im.convert("RGB"), np.float32)
+    return arr[..., ::-1]
+
+
+def write_image_records(features: Iterable[ImageFeature], prefix: str,
+                        shards: int = 1) -> List[str]:
+    """Pack ImageFeatures into `shards` TFRecord files
+    (`{prefix}-00000-of-0000N.tfrecord`). Features holding raw BYTES keep
+    their original encoding; decoded images are PNG-encoded (lossless)."""
+    feats = list(features)
+    paths = [f"{prefix}-{i:05d}-of-{shards:05d}.tfrecord"
+             for i in range(shards)]
+    for i, path in enumerate(paths):
+        examples = []
+        for f in feats[i::shards]:
+            raw = f.get(ImageFeature.BYTES)
+            if raw is None:
+                raw = _encode_png(f.image)
+            fields = {"image/encoded": bytes_feature(raw)}
+            if f.label is not None:
+                fields["image/class/label"] = float_feature(
+                    np.asarray(f.label, np.float32).reshape(-1))
+            uri = f.get(ImageFeature.URI)
+            if uri:
+                fields["image/uri"] = bytes_feature(str(uri).encode())
+            examples.append(make_example(fields))
+        write_tfrecord(path, examples)
+    return paths
+
+
+class ImageRecordDataset:
+    """Stream packed image records back as ImageFeatures (the reference's
+    SeqFileToBytes -> BytesToBGRImg stage). Accepts explicit paths or a
+    glob pattern; `decode=False` keeps the encoded bytes (for pipelines
+    that crop-before-decode)."""
+
+    def __init__(self, paths: Union[str, Sequence[str]], decode: bool = True):
+        if isinstance(paths, str):
+            expanded = sorted(_glob.glob(paths)) or [paths]
+        else:
+            expanded = list(paths)
+        self.paths = expanded
+        self.decode = decode
+
+    def __iter__(self) -> Iterator[ImageFeature]:
+        from bigdl_tpu.interop.tfrecord import TFRecordDataset
+        for parsed in TFRecordDataset(self.paths, parse=True):
+            raw = parsed.get("image/encoded", [b""])[0]
+            feat = ImageFeature()
+            feat[ImageFeature.BYTES] = raw
+            if self.decode:
+                feat.image = _decode_image(raw)
+                feat[ImageFeature.ORIGINAL_SIZE] = feat.image.shape
+            label = parsed.get("image/class/label")
+            if label is not None and len(label):
+                feat[ImageFeature.LABEL] = (float(label[0])
+                                            if len(label) == 1
+                                            else np.asarray(label))
+            uri = parsed.get("image/uri")
+            if uri:
+                feat[ImageFeature.URI] = uri[0].decode()
+            yield feat

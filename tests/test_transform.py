@@ -286,3 +286,44 @@ class TestDatasetLoaders:
         glove.write_text("the 0.1 0.2 0.3\ncat 1.0 2.0 3.0\n")
         w2v = news20.get_glove_w2v(str(glove), dim=3)
         np.testing.assert_allclose(w2v["cat"], [1.0, 2.0, 3.0])
+
+
+class TestImageRecords:
+    """Packed image-record shards (the reference's SeqFile ImageNet
+    format; TPU-native TFRecord shards)."""
+
+    def test_round_trip_shards(self, tmp_path):
+        pytest.importorskip("PIL")
+        from bigdl_tpu.transform.vision.image_record import (
+            ImageRecordDataset, write_image_records)
+        rs = np.random.RandomState(0)
+        feats = [V.ImageFeature((rs.rand(8, 8, 3) * 255).astype(np.uint8),
+                                label=float(i + 1), uri=f"img{i}")
+                 for i in range(7)]
+        paths = write_image_records(feats, str(tmp_path / "train"), shards=2)
+        assert len(paths) == 2
+        back = sorted(ImageRecordDataset(str(tmp_path / "train-*")),
+                      key=lambda f: f[V.ImageFeature.URI])
+        assert len(back) == 7
+        for f in back:
+            i = int(f[V.ImageFeature.URI][3:])
+            # PNG is lossless: pixel-exact round trip
+            np.testing.assert_array_equal(
+                f.image.astype(np.uint8), feats[i].image.astype(np.uint8))
+            assert f[V.ImageFeature.LABEL] == i + 1
+
+    def test_feeds_batcher(self, tmp_path):
+        pytest.importorskip("PIL")
+        from bigdl_tpu.transform.vision.image_record import (
+            ImageRecordDataset, write_image_records)
+        rs = np.random.RandomState(1)
+        feats = [V.ImageFeature((rs.rand(10, 12, 3) * 255).astype(np.uint8),
+                                label=1.0) for _ in range(6)]
+        write_image_records(feats, str(tmp_path / "d"), shards=1)
+        batcher = V.MTImageFeatureToBatch(
+            width=8, height=8, batch_size=3,
+            transformer=V.Resize(8, 8), num_threads=2)
+        batches = list(batcher(iter(ImageRecordDataset(
+            str(tmp_path / "d-*")))))
+        assert len(batches) == 2
+        assert batches[0].get_input().shape == (3, 8, 8, 3)
