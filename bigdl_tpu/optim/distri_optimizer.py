@@ -70,8 +70,20 @@ class DistriOptimizer(BaseOptimizer):
         self._param_shardings = None
 
     # ------------------------------------------------------------------ #
+    @property
+    def _single_device(self) -> bool:
+        """One-device mesh: plain device placement, no SPMD annotations.
+        Semantically identical (every spec degenerates to replicated) and
+        keeps the executable on the backend's fastest single-chip path."""
+        return int(np.prod(self.mesh.devices.shape)) == 1
+
     def _place(self, params, model_state, opt_state):
         mesh = self.mesh
+        if self._single_device:
+            dev = mesh.devices.reshape(-1)[0]
+            put1 = lambda leaf: jax.device_put(leaf, dev)
+            return (jax.tree_util.tree_map(put1, params),
+                    jax.tree_util.tree_map(put1, model_state))
         specs = infer_param_specs(params, mesh, self.rules)
         self._param_specs = specs
         put = lambda leaf, spec: jax.device_put(leaf, NamedSharding(mesh, spec))
@@ -185,10 +197,15 @@ class DistriOptimizer(BaseOptimizer):
             with Timer(self.metrics, "put batch on mesh"):
                 x = batch.get_input()
                 y = batch.get_target()
-                x = (Table(*[shard_batch(mesh, v) for v in x])
-                     if isinstance(x, list) else shard_batch(mesh, x))
-                y = (Table(*[shard_batch(mesh, v) for v in y])
-                     if isinstance(y, list) else shard_batch(mesh, y))
+                def place_any(v):
+                    if v is None:
+                        return None
+                    if isinstance(v, list):
+                        return Table(*[shard_batch(mesh, e) for e in v])
+                    return shard_batch(mesh, v)
+
+                x = place_any(x)
+                y = place_any(y)
             return batch, x, y
 
         pending = fetch_and_place()

@@ -52,6 +52,12 @@ def shard_batch(mesh: Mesh, batch):
     jax.make_array_from_process_local_data assembles the global jax.Array
     without any cross-host data motion."""
     import jax.numpy as jnp
+    if int(np.prod(mesh.devices.shape)) == 1:
+        # one-device mesh: plain placement keeps the backend's fastest
+        # single-chip path (no SPMD annotations to honor)
+        dev = mesh.devices.reshape(-1)[0]
+        return jax.tree_util.tree_map(
+            lambda x: jax.device_put(jnp.asarray(x), dev), batch)
     sh = data_sharding(mesh)
     multi_host = jax.process_count() > 1
 

@@ -26,6 +26,7 @@ from __future__ import annotations
 import math
 from typing import Iterable, Optional, Sequence, Tuple, Union
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -433,6 +434,144 @@ class Tensor:
 
     def clamp(self, lo, hi) -> "Tensor":
         return self._write(jnp.clip(self.to_jax(), lo, hi))
+
+    def addcmul(self, value, t1=None, t2=None) -> "Tensor":
+        """self += value * t1 * t2 (TensorMath.scala:324; 2-arg form has
+        value = 1)."""
+        if t2 is None:
+            value, t1, t2 = 1.0, value, t1
+        return self._write(self.to_jax() + value * t1.to_jax() * t2.to_jax())
+
+    def addcdiv(self, value, t1, t2) -> "Tensor":
+        """self += value * t1 / t2 (TensorMath.scala:338)."""
+        return self._write(self.to_jax() + value * t1.to_jax() / t2.to_jax())
+
+    def square(self) -> "Tensor":
+        """In-place square (TensorMath.scala:584)."""
+        return self._write(self.to_jax() ** 2)
+
+    def erf(self) -> "Tensor":
+        return self._write(jax.scipy.special.erf(self.to_jax()))
+
+    def erfc(self) -> "Tensor":
+        return self._write(jax.scipy.special.erfc(self.to_jax()))
+
+    def logGamma(self) -> "Tensor":
+        return self._write(jax.scipy.special.gammaln(self.to_jax()))
+
+    def digamma(self) -> "Tensor":
+        return self._write(jax.scipy.special.digamma(self.to_jax()))
+
+    def inv(self) -> "Tensor":
+        """Elementwise reciprocal (TensorMath.scala inv)."""
+        return self._write(1.0 / self.to_jax())
+
+    def unary_(self) -> "Tensor":
+        """Negate in place (TensorMath.scala unary_-)."""
+        return self._write(-self.to_jax())
+
+    def maskedCopy(self, mask: "Tensor", y: "Tensor") -> "Tensor":
+        """Copy y's elements (in order) into self where mask != 0
+        (TensorMath.scala:710)."""
+        m = np.asarray(mask.to_jax()).reshape(-1) != 0
+        dst = np.array(self.to_jax()).reshape(-1)
+        src = np.asarray(y.to_jax()).reshape(-1)
+        n = int(m.sum())
+        if n > src.size:
+            raise ValueError(
+                f"maskedCopy: mask selects {n} elements but y has "
+                f"{src.size}")
+        dst[m] = src[:n]
+        return self._write(jnp.asarray(dst.reshape(self._size)))
+
+    def indexAdd(self, dim: int, index: "Tensor", y: "Tensor") -> "Tensor":
+        """Accumulate y's slices into self at 1-based `index` positions
+        along 1-based `dim` (TensorMath.scala:751)."""
+        idx = jnp.asarray(index.to_jax(), jnp.int32).reshape(-1) - 1
+        arr = self.to_jax()
+        upd = y.to_jax()
+        axis = dim - 1
+        arr = jnp.moveaxis(arr, axis, 0).at[idx].add(
+            jnp.moveaxis(upd, axis, 0))
+        return self._write(jnp.moveaxis(arr, 0, axis))
+
+    def index(self, dim: int, index: "Tensor") -> "Tensor":
+        """Select slices at 1-based positions -> NEW tensor
+        (TensorMath.scala index)."""
+        idx = jnp.asarray(index.to_jax(), jnp.int32).reshape(-1) - 1
+        return Tensor(jnp.take(self.to_jax(), idx, axis=dim - 1))
+
+    def uniform(self, a: float = 0.0, b: float = 1.0) -> float:
+        """One uniform draw in [a, b) from the global RandomGenerator
+        (TensorMath.scala:500)."""
+        from bigdl_tpu.utils.random_generator import RNG
+        return float(RNG.uniform(a, b))
+
+    def range(self, xmin, xmax, step: int = 1) -> "Tensor":
+        """Fill self with the inclusive range (TensorMath.scala:808)."""
+        n = int(math.floor((xmax - xmin) / step)) + 1
+        vals = xmin + step * jnp.arange(n, dtype=self.to_jax().dtype)
+        self._size = (n,)
+        self._stride = (1,)
+        self._offset = 0
+        self._storage = Storage(vals)
+        self._cache = None  # new storage restarts the version counter
+        return self
+
+    def reduce(self, dim: int, result: "Tensor", reducer) -> "Tensor":
+        """Fold `reducer` along 1-based dim into `result`
+        (TensorMath.scala:824)."""
+        arr = np.asarray(self.to_jax())
+        import functools
+        out = np.apply_along_axis(
+            lambda v: functools.reduce(reducer, v), dim - 1, arr)
+        out = np.expand_dims(out, dim - 1)
+        result._write(jnp.asarray(out.astype(arr.dtype)))
+        return result
+
+    def sumSquare(self) -> float:
+        return float(jnp.sum(self.to_jax() ** 2))
+
+    def dist(self, y: "Tensor", norm: int = 2) -> float:
+        """||self - y||_norm (TensorMath.scala:313)."""
+        d = jnp.abs(self.to_jax() - y.to_jax())
+        return float(jnp.sum(d ** norm) ** (1.0 / norm))
+
+    def conv2(self, kernel: "Tensor", vf: str = "V") -> "Tensor":
+        """2-D convolution (flipped kernel) over the last two dims;
+        vf='V' valid / 'F' full (TensorMath.scala:222)."""
+        return self._corr2(kernel, vf, flip=True)
+
+    def xcorr2(self, kernel: "Tensor", vf: str = "V") -> "Tensor":
+        """2-D cross-correlation (TensorMath.scala:232)."""
+        return self._corr2(kernel, vf, flip=False)
+
+    def _corr2(self, kernel, vf, flip):
+        from jax import lax
+        x = self.to_jax()
+        k = kernel.to_jax()
+        if flip:  # XLA convs are cross-correlations; conv2 flips the kernel
+            k = jnp.flip(k, (-2, -1))
+        if vf not in ("V", "F"):
+            raise ValueError(f"vf must be 'V' or 'F', got {vf!r}")
+        kh, kw = k.shape[-2], k.shape[-1]
+        pad = ((kh - 1, kh - 1), (kw - 1, kw - 1)) if vf == "F" else \
+            ((0, 0), (0, 0))
+
+        def one(img, ker):
+            out = lax.conv_general_dilated(
+                img[None, None], ker[None, None], (1, 1), pad,
+                dimension_numbers=("NCHW", "OIHW", "NCHW"))
+            return out[0, 0]
+
+        if x.ndim == 2:
+            return Tensor(one(x, k if k.ndim == 2 else k[0]))
+        if x.ndim == 3:  # per-channel maps (TensorMath.scala:222 3-D form)
+            ks = k if k.ndim == 3 else jnp.broadcast_to(
+                k, (x.shape[0],) + k.shape)
+            return Tensor(jax.vmap(one)(x, ks))
+        raise ValueError(f"conv2/xcorr2 expect 2-D or 3-D input, "
+                         f"got {x.ndim}-D")
 
     def apply1(self, fn) -> "Tensor":
         """Elementwise host function, like DenseTensorApply (host-side)."""

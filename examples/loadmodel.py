@@ -1,0 +1,94 @@
+"""Load-and-predict across model formats.
+
+Parity: DL/example/loadmodel (SURVEY.md C37) — load a model saved as
+(a) this framework's own format, (b) Caffe prototxt+caffemodel,
+(c) a frozen TensorFlow GraphDef — and run the same prediction through
+each. The example builds its own tiny fixtures so it runs standalone;
+point the --*-path flags at real files to load those instead.
+"""
+
+from __future__ import annotations
+
+import os as _os
+import sys as _sys
+_sys.path.insert(0, _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__))))
+
+import argparse
+import tempfile
+
+import numpy as np
+
+
+def _build_fixture_model():
+    import bigdl_tpu.nn as nn
+    m = nn.Sequential()
+    m.add(nn.SpatialConvolution(3, 6, 3, 3, 1, 1, 1, 1))
+    m.add(nn.ReLU())
+    m.add(nn.SpatialMaxPooling(2, 2, 2, 2))
+    m.add(nn.Reshape([6 * 4 * 4]))
+    m.add(nn.Linear(6 * 4 * 4, 5))
+    m.add(nn.SoftMax())
+    m.evaluate()
+    m.ensure_params()
+    return m
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--bigdl-path", default=None)
+    p.add_argument("--caffe-prototxt", default=None)
+    p.add_argument("--caffe-model", default=None)
+    p.add_argument("--tf-pb", default=None)
+    args = p.parse_args(argv)
+
+    import jax.numpy as jnp
+    from bigdl_tpu.interop import (CaffeLoader, CaffePersister,
+                                   TensorflowLoader, TensorflowSaver)
+    from bigdl_tpu.serialization.module_serializer import ModuleSerializer
+
+    tmp = tempfile.mkdtemp()
+    model = _build_fixture_model()
+    x = jnp.asarray(np.random.RandomState(0).rand(2, 8, 8, 3), jnp.float32)
+    want = np.asarray(model.forward(x))
+
+    # (a) own format
+    bigdl_path = args.bigdl_path or f"{tmp}/model.bigdl"
+    if args.bigdl_path is None:
+        ModuleSerializer.save(model, bigdl_path)
+    own = ModuleSerializer.load(bigdl_path)
+    own_out = np.asarray(own.forward(x))
+
+    # (b) caffe
+    proto = args.caffe_prototxt or f"{tmp}/model.prototxt"
+    weights = args.caffe_model or f"{tmp}/model.caffemodel"
+    if args.caffe_prototxt is None:
+        CaffePersister.persist(proto, weights, model)
+    caffe = CaffeLoader.load(proto, weights)
+    caffe_out = np.asarray(caffe.forward(x))
+
+    # (c) frozen TF graph
+    pb_path = args.tf_pb or f"{tmp}/model.pb"
+    if args.tf_pb is None:
+        TensorflowSaver.save(model, pb_path)
+    tf_graph = TensorflowLoader.load(pb_path, ["input"],
+                                     [tf_graph_output(pb_path)])
+    tf_out = np.asarray(tf_graph.forward(x))
+
+    for name, out in [("bigdl", own_out), ("caffe", caffe_out),
+                      ("tensorflow", tf_out)]:
+        drift = float(np.abs(out - want).max())
+        print(f"{name:10s} prediction max drift vs source model: {drift:.2e}")
+        assert drift < 1e-4, name
+    print("all three formats agree")
+    return True
+
+
+def tf_graph_output(pb_path: str) -> str:
+    """Last node of the saved GraphDef = the output endpoint."""
+    from bigdl_tpu.proto import tf_graph_pb2 as tpb
+    gd = tpb.GraphDef.FromString(open(pb_path, "rb").read())
+    return gd.node[-1].name
+
+
+if __name__ == "__main__":
+    main()

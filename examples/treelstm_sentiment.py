@@ -1,0 +1,97 @@
+"""TreeLSTM sentiment classification.
+
+Parity: DL/example/treeLSTMSentiment (SURVEY.md C37) — classify sentences
+with a BinaryTreeLSTM over constituency trees. Synthetic corpus: token
+embeddings carry the sentiment signal; right-branching parse trees.
+"""
+
+from __future__ import annotations
+
+import os as _os
+import sys as _sys
+_sys.path.insert(0, _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__))))
+
+import argparse
+
+import numpy as np
+
+
+def _make_corpus(n, n_tokens, dim, seed=0):
+    """Sentences of `n_tokens` embedded words; label = sign of the sum of
+    each word's hidden 'sentiment' coordinate."""
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, n_tokens, dim).astype(np.float32) * 0.5
+    sentiment = X[:, :, 0].sum(axis=1)
+    y = (sentiment > 0).astype(np.int32) + 1
+    return X, y
+
+
+def _right_branching_tree(n_tokens):
+    """Tree table rows: [left_child, right_child, leaf_index(1-based)];
+    internal nodes combine leaf i with the subtree to its right."""
+    rows = [[0, 0, i + 1] for i in range(n_tokens)]  # leaves
+    prev = n_tokens  # 1-based row index of the rightmost leaf
+    for i in range(n_tokens - 1, 0, -1):
+        rows.append([i, prev, 0])
+        prev = len(rows)
+    return np.asarray(rows, np.int32)
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--sentences", type=int, default=256)
+    p.add_argument("--tokens", type=int, default=6)
+    p.add_argument("--embed-dim", type=int, default=8)
+    p.add_argument("--hidden", type=int, default=16)
+    p.add_argument("--max-iteration", type=int, default=120)
+    args = p.parse_args(argv)
+
+    import jax
+    import jax.numpy as jnp
+    import bigdl_tpu.nn as nn
+    import bigdl_tpu.optim as optim
+    from bigdl_tpu.nn.module import functional_apply
+    from bigdl_tpu.utils.table import Table
+
+    X, y = _make_corpus(args.sentences, args.tokens, args.embed_dim)
+    tree = _right_branching_tree(args.tokens)
+    trees = jnp.asarray(np.broadcast_to(
+        tree, (args.sentences,) + tree.shape))
+    root = tree.shape[0]  # root is the last row
+
+    # model: TreeLSTM -> root state -> Linear -> LogSoftMax
+    tl = nn.BinaryTreeLSTM(args.embed_dim, args.hidden)
+    head = nn.Sequential().add(nn.Linear(args.hidden, 2)).add(nn.LogSoftMax())
+    crit = nn.ClassNLLCriterion()
+    params = {"tree": tl.init(jax.random.PRNGKey(0)),
+              "head": head.init(jax.random.PRNGKey(1))}
+    opt_state = None
+    method = optim.Adam(learning_rate=5e-3)
+    opt_state = method.init_state(params)
+
+    xs = jnp.asarray(X)
+    ys = jnp.asarray(y)
+
+    @jax.jit
+    def step(params, opt_state):
+        def loss_fn(p):
+            states, _ = functional_apply(tl, p["tree"], Table(xs, trees))
+            logits, _ = functional_apply(head, p["head"],
+                                         states[:, root - 1])
+            return crit(logits, ys)
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        new_p, new_o = method.update(grads, opt_state, params, 5e-3)
+        return new_p, new_o, loss
+
+    loss = None
+    for it in range(args.max_iteration):
+        params, opt_state, loss = step(params, opt_state)
+    states, _ = functional_apply(tl, params["tree"], Table(xs, trees))
+    logits, _ = functional_apply(head, params["head"], states[:, root - 1])
+    acc = float((np.asarray(logits).argmax(1) + 1 == y).mean())
+    print(f"final loss {float(loss):.4f}, train accuracy {acc:.3f}")
+    return acc
+
+
+if __name__ == "__main__":
+    main()

@@ -62,3 +62,23 @@ class TestExamples:
         from examples.wide_n_deep import main
         acc = main(["--max-epoch", "2", "--model-type", "wide"])
         assert acc > 0.55
+
+    def test_autoencoder(self):
+        from examples.autoencoder import main
+        mse = main(["--max-epoch", "10"])
+        assert mse < 0.02
+
+    def test_inception_imagenet_records(self):
+        from examples.inception_imagenet import main
+        acc = main(["--image-size", "32", "--records", "64",
+                    "--max-iteration", "25", "--batch-size", "16"])
+        assert acc > 0.5
+
+    def test_loadmodel(self):
+        from examples.loadmodel import main
+        assert main([]) is True
+
+    def test_treelstm_sentiment(self):
+        from examples.treelstm_sentiment import main
+        acc = main(["--sentences", "128", "--max-iteration", "80"])
+        assert acc > 0.8

@@ -6,6 +6,7 @@ math vs a numpy oracle, sparse COO ops, int8 quantization error bounds.
 """
 
 import numpy as np
+import jax.numpy as jnp
 import pytest
 
 from bigdl_tpu.tensor import QuantizedTensor, SparseTensor, Tensor
@@ -213,3 +214,82 @@ class TestQuantizedTensor:
         assert q.scale.shape == ()
         np.testing.assert_allclose(np.asarray(q.dequantize())[1, 1], 127.0,
                                    rtol=1e-2)
+
+
+class TestTensorMathBreadth:
+    """TensorMath surface parity additions (DL/tensor/TensorMath.scala)."""
+
+    def _t(self, arr):
+        return Tensor(jnp.asarray(np.asarray(arr, np.float32)))
+
+    def test_addcmul_addcdiv(self):
+        t = self._t([1.0, 2.0])
+        t.addcmul(2.0, self._t([3.0, 4.0]), self._t([5.0, 6.0]))
+        np.testing.assert_allclose(t.to_numpy(), [31.0, 50.0])
+        t2 = self._t([1.0, 1.0])
+        t2.addcdiv(2.0, self._t([4.0, 9.0]), self._t([2.0, 3.0]))
+        np.testing.assert_allclose(t2.to_numpy(), [5.0, 7.0])
+
+    def test_square_inv_unary(self):
+        t = self._t([2.0, 4.0]).square()
+        np.testing.assert_allclose(t.to_numpy(), [4.0, 16.0])
+        np.testing.assert_allclose(self._t([2.0, 4.0]).inv().to_numpy(),
+                                   [0.5, 0.25])
+        np.testing.assert_allclose(self._t([1.0, -2.0]).unary_().to_numpy(),
+                                   [-1.0, 2.0])
+
+    def test_special_functions(self):
+        import scipy.special as sp
+        x = np.array([0.5, 1.5], np.float32)
+        np.testing.assert_allclose(self._t(x).erf().to_numpy(),
+                                   sp.erf(x), rtol=1e-5)
+        np.testing.assert_allclose(self._t(x).erfc().to_numpy(),
+                                   sp.erfc(x), rtol=1e-4)
+        np.testing.assert_allclose(self._t(x).logGamma().to_numpy(),
+                                   sp.gammaln(x), rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(self._t(x).digamma().to_numpy(),
+                                   sp.digamma(x), rtol=1e-4)
+
+    def test_masked_copy(self):
+        t = self._t([1.0, 2.0, 3.0, 4.0])
+        t.maskedCopy(self._t([0.0, 1.0, 0.0, 1.0]), self._t([9.0, 8.0]))
+        np.testing.assert_allclose(t.to_numpy(), [1.0, 9.0, 3.0, 8.0])
+
+    def test_index_add_and_index(self):
+        t = self._t([[1.0, 1.0], [2.0, 2.0], [3.0, 3.0]])
+        t.indexAdd(1, self._t([3.0, 1.0]),
+                   self._t([[10.0, 10.0], [20.0, 20.0]]))
+        np.testing.assert_allclose(
+            t.to_numpy(), [[21.0, 21.0], [2.0, 2.0], [13.0, 13.0]])
+        sel = t.index(1, self._t([2.0]))
+        np.testing.assert_allclose(sel.to_numpy(), [[2.0, 2.0]])
+
+    def test_range_reduce_sumsquare_dist(self):
+        t = Tensor(jnp.zeros((1,)))
+        t.range(2.0, 10.0, 2)
+        np.testing.assert_allclose(t.to_numpy(), [2, 4, 6, 8, 10])
+        src = self._t([[1.0, 5.0, 3.0]])
+        out = Tensor(jnp.zeros((1, 1)))
+        src.reduce(2, out, lambda a, b: max(a, b))
+        np.testing.assert_allclose(out.to_numpy(), [[5.0]])
+        assert self._t([3.0, 4.0]).sumSquare() == 25.0
+        assert abs(self._t([1.0, 1.0]).dist(self._t([4.0, 5.0]), 2)
+                   - 5.0) < 1e-6
+
+    def test_conv2_xcorr2(self):
+        import scipy.signal as ss
+        rs = np.random.RandomState(0)
+        x = rs.rand(5, 5).astype(np.float32)
+        k = rs.rand(3, 3).astype(np.float32)
+        np.testing.assert_allclose(
+            self._t(x).conv2(self._t(k), "V").to_numpy(),
+            ss.convolve2d(x, k, mode="valid"), rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(
+            self._t(x).xcorr2(self._t(k), "F").to_numpy(),
+            ss.correlate2d(x, k, mode="full"), rtol=1e-4, atol=1e-5)
+
+    def test_uniform_draw(self):
+        from bigdl_tpu.utils.random_generator import RNG
+        RNG.setSeed(42)
+        v = Tensor(jnp.zeros((1,))).uniform(2.0, 4.0)
+        assert 2.0 <= v < 4.0
