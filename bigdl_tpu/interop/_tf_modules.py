@@ -138,6 +138,23 @@ class _TFTableSelect(Module):
         return input[self.index + 1]  # Table is 1-based
 
 
+class _TFDynamicReshape(Module):
+    """Reshape whose target shape is computed in-graph (slim's
+    Flatten/concat pattern). Shape values resolve host-side, so this node
+    executes eagerly — which is how imported graphs run."""
+
+    def apply(self, params, input, ctx):
+        x, shape = input[1], input[2]
+        try:
+            dims = tuple(int(s) for s in np.asarray(shape))
+        except Exception as e:
+            raise ValueError(
+                "in-graph Reshape shape is data-dependent under tracing; "
+                "run the imported graph eagerly (no jit) or freeze the "
+                "shape to a constant before import") from e
+        return jnp.reshape(x, dims)
+
+
 class _TFDilation2D(Module):
     """TF Dilation2D with a static filter const (morphological dilation);
     delegates the math to ops.Dilation2D (DL/nn/ops/Dilation2D.scala)."""
@@ -160,6 +177,6 @@ class _TFDilation2D(Module):
 from bigdl_tpu.serialization.module_serializer import register_module as _reg
 for _cls in (_TFConst, _TFPad, _TFPermute, _TFFill, _TFStridedSlice,
              _TFUnstack, _TFAxisSlice, _TFMatMul, _TFTableSelect,
-             _TFDilation2D):
+             _TFDilation2D, _TFDynamicReshape):
     _reg(_cls)
 del _reg, _cls

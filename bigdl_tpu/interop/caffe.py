@@ -94,10 +94,16 @@ class CaffeLoader:
                  "Exp", "Split", "Slice")
 
     @staticmethod
-    def load(prototxt_path: str, caffemodel_path: Optional[str] = None):
+    def load(prototxt_path: str, caffemodel_path: Optional[str] = None,
+             customized: Optional[Dict[str, "callable"]] = None):
+        """`customized` maps a layer TYPE to `fn(layer, blobs) -> Module`
+        for types the stock converter doesn't know (reference
+        CaffeLoader customizedConverters, CaffeLoaderSpec)."""
         net = pb.NetParameter()
         with open(prototxt_path) as f:
-            text_format.Parse(f.read(), net)
+            # the schema is a field-number-compatible subset; prototxts may
+            # carry params (fillers, solver hints) the loader doesn't read
+            text_format.Parse(f.read(), net, allow_unknown_field=True)
         if net.layers and not net.layer:  # V1 era definition
             net = CaffeLoader._v1_to_v2(net)
         weights: Dict[str, List[np.ndarray]] = {}
@@ -108,7 +114,7 @@ class CaffeLoader:
                 if layer.blobs:
                     weights[layer.name] = [_blob_array(b)
                                            for b in layer.blobs]
-        return CaffeLoader._build(net, weights)
+        return CaffeLoader._build(net, weights, customized or {})
 
     # V1LayerParameter.LayerType -> modern type string
     # (reference V1LayerConverter.scala:38 converts the same set)
@@ -180,7 +186,9 @@ class CaffeLoader:
         return out
 
     @staticmethod
-    def _build(net: pb.NetParameter, weights: Dict[str, List[np.ndarray]]):
+    def _build(net: pb.NetParameter, weights: Dict[str, List[np.ndarray]],
+               customized: Optional[Dict[str, "callable"]] = None):
+        customized = customized or {}
         producers: Dict[str, Node] = {}  # blob name -> producing node
         input_nodes: List[Node] = []
 
@@ -229,8 +237,12 @@ class CaffeLoader:
                     producers[top] = seg.inputs(bottom)
                 continue
             flat_input = bool(layer.bottom) and layer.bottom[0] in flat_blobs
-            module = CaffeLoader._convert(layer, weights.get(layer.name),
-                                          flat_input=flat_input)
+            if layer.type in customized:
+                module = customized[layer.type](layer,
+                                                weights.get(layer.name))
+            else:
+                module = CaffeLoader._convert(layer, weights.get(layer.name),
+                                              flat_input=flat_input)
             if module is None:       # train-only layers (SoftmaxWithLoss)
                 continue
             bottoms = [producers[b] for b in layer.bottom]

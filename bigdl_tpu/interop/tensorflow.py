@@ -78,6 +78,50 @@ def _clean(name: str) -> str:
     return name.split(":")[0]
 
 
+def _assign_initializers(gd: "pb.GraphDef") -> Dict[str, str]:
+    """variable name -> its (first) Assign initializer's value ref."""
+    out: Dict[str, str] = {}
+    for n in gd.node:
+        if n.op == "Assign" and len(n.input) >= 2:
+            out.setdefault(_clean(n.input[0]), _clean(n.input[1]))
+    return out
+
+
+def _data_ancestors(gd: "pb.GraphDef", endpoints) -> set:
+    """Names reachable from `endpoints` along data edges; variables pull
+    in their Assign initializer subgraph (it feeds their value)."""
+    nodes = {n.name: n for n in gd.node}
+    assigns = _assign_initializers(gd)
+    keep, stack = set(), [_clean(e) for e in endpoints]
+    while stack:
+        name = stack.pop()
+        if name in keep or name not in nodes:
+            continue
+        keep.add(name)
+        nd = nodes[name]
+        stack.extend(_clean(i) for i in nd.input if not i.startswith("^"))
+        if nd.op in ("VariableV2", "Variable") and name in assigns:
+            stack.append(assigns[name])
+    return keep
+
+
+def _prune_to(gd: "pb.GraphDef", endpoint: str) -> "pb.GraphDef":
+    """Sub-GraphDef holding only `endpoint`'s ancestors (data edges, plus
+    Assign initializers of any variables among them)."""
+    keep = _data_ancestors(gd, [endpoint])
+    assigns = _assign_initializers(gd)
+    sub = pb.GraphDef()
+    for n in gd.node:
+        if n.name in keep or (n.op == "Assign" and len(n.input) >= 2
+                              and _clean(n.input[0]) in keep):
+            new = sub.node.add()
+            new.CopyFrom(n)
+            # control deps may point outside the pruned set
+            del new.input[:]
+            new.input.extend(i for i in n.input if not i.startswith("^"))
+    return sub
+
+
 class TensorflowLoader:
     """load(pb_path, inputs, outputs) -> Graph over standard layers."""
 
@@ -88,12 +132,27 @@ class TensorflowLoader:
 
     @staticmethod
     def from_graph_def(gd: pb.GraphDef, inputs: Sequence[str],
-                       outputs: Sequence[str]):
+                       outputs: Sequence[str],
+                       variables: Optional[Dict[str, np.ndarray]] = None):
+        """`variables` supplies VariableV2 values by node name (e.g. from a
+        checkpoint); unsupplied variables materialize from their Assign
+        initializer subgraph (the reference keeps them in a Context fed by
+        either path, TensorflowLoader.scala:55)."""
         nodes: Dict[str, pb.NodeDef] = {n.name: n for n in gd.node}
         consts: Dict[str, np.ndarray] = {}
         for n in gd.node:
             if n.op == "Const":
                 consts[n.name] = tensor_to_ndarray(n.attr["value"].tensor)
+        var_nodes = [n for n in gd.node if n.op in ("VariableV2", "Variable")]
+        if var_nodes:
+            # only variables the requested outputs actually read — a
+            # stripped saver/training branch elsewhere must not break or
+            # slow the import
+            reachable = _data_ancestors(gd, outputs)
+            var_nodes = [n for n in var_nodes if n.name in reachable]
+        if var_nodes:
+            TensorflowLoader._materialize_variables(
+                gd, consts, var_nodes, variables or {})
         # Identity-of-const folding (frozen graphs wrap weights in Identity)
         changed = True
         while changed:
@@ -224,6 +283,35 @@ class TensorflowLoader:
             graph = nn.Graph(ordered_inputs or input_nodes, out_nodes)
         graph.evaluate()
         return graph
+
+    @staticmethod
+    def _materialize_variables(gd, consts, var_nodes, supplied):
+        """Turn VariableV2 nodes into consts: supplied values win;
+        otherwise evaluate the variable's Assign initializer subgraph
+        (Consts, Fill, RandomUniform/TruncatedNormal arithmetic — all
+        regular loader ops) host-side."""
+        import jax
+
+        assigns = _assign_initializers(gd)
+        rng = jax.random.PRNGKey(0)
+        for i, v in enumerate(var_nodes):
+            if v.name in supplied:
+                consts[v.name] = np.asarray(supplied[v.name])
+                continue
+            init = assigns.get(v.name)
+            if init is None:
+                raise ValueError(
+                    f"variable '{v.name}' has no supplied value and no "
+                    "Assign initializer; pass variables={...} or freeze "
+                    "the graph")
+            # the pruned subgraph keeps Assigns of any variables the
+            # initializer itself reads (w2 = f(w1) chains), and `supplied`
+            # flows through the recursion
+            sub = TensorflowLoader.from_graph_def(
+                _prune_to(gd, init), [], [init], variables=supplied)
+            out = sub.forward([], training=False,
+                              rng=jax.random.fold_in(rng, i))
+            consts[v.name] = np.asarray(out)
 
     # ---------------------------------------------------------- op loaders
     @staticmethod
@@ -487,6 +575,9 @@ class TensorflowLoader:
 
         # --- shape / array ops ---
         if op == "Reshape":
+            if not has_const(1):  # shape computed in-graph (slim Flatten)
+                from bigdl_tpu.interop._tf_modules import _TFDynamicReshape
+                return _TFDynamicReshape(name=nd.name), args
             shape = const_arg(1).reshape(-1).tolist()
             return nn.InferReshape([int(s) for s in shape],
                                    name=nd.name), args[:1]
@@ -579,13 +670,19 @@ class TensorflowLoader:
             return ops.CrossEntropy(name=nd.name), args
         if op == "RandomUniform":
             return ops.RandomUniform(name=nd.name), args
+        if op == "TruncatedNormal":
+            return ops.TruncatedNormal(name=nd.name), args
+        if op == "RandomStandardNormal":
+            return ops.RandomNormal(name=nd.name), args
         if op == "Assert":
             return ops.Assert(name=nd.name), args[:1]
         if op == "VariableV2" or op == "Variable":
+            if nd.name in consts:  # materialized from init/supplied value
+                return _TFConst(consts[nd.name], name=nd.name), []
             raise ValueError(
                 f"graph contains an unfrozen variable '{nd.name}'; freeze "
-                "the graph (convert variables to consts) before import, or "
-                "use interop.tf_session.Session for training graphs")
+                "the graph, supply variables={...}, or keep its Assign "
+                "initializer in the GraphDef")
         raise ValueError(
             f"unsupported TF op '{op}' (node {nd.name}); extend "
             "TensorflowLoader._convert (op-loader registry parity: "

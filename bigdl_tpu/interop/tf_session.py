@@ -93,6 +93,29 @@ class Session:
         opt.optimize()
         return model
 
+    def model(self, end_points: Sequence[str],
+              variables: Optional[Dict] = None):
+        """Build the MODEL subgraph ending at `end_points` without any
+        data plumbing: queue/dequeue inputs become placeholders, Variables
+        materialize from `variables` or their initializers. This is the
+        reference's constructModel (Session.scala:633) surface for
+        imported-then-inspect use."""
+        deq = self._find_dequeue(end_points, required=False)
+        if deq is None:
+            placeholders = [n.name for n in self.graph_def.node
+                            if n.op == "Placeholder"]
+            m = TensorflowLoader.from_graph_def(
+                self.graph_def, placeholders, list(end_points),
+                variables=variables)
+        else:
+            n_out = self._dequeue_arity(deq)
+            input_names = [f"{deq.name}__out{i}" for i in range(n_out)]
+            gd = self._rewrite_dequeue(deq, input_names, end_points)
+            m = TensorflowLoader.from_graph_def(
+                gd, input_names, list(end_points), variables=variables)
+        self._last_model = m
+        return m
+
     def predict(self, end_points: Sequence[str], batch_size: int = 32):
         """Queue-fed inference (Session.scala:166-176): returns the list of
         per-batch outputs."""
@@ -141,8 +164,11 @@ class Session:
                        for s in samples]
         return model, samples
 
-    def _find_dequeue(self, end_points: Sequence[str]) -> pb.NodeDef:
-        """DFS from the endpoints to the dequeue node feeding them."""
+    def _find_dequeue(self, end_points: Sequence[str],
+                      required: bool = True) -> Optional[pb.NodeDef]:
+        """DFS from the endpoints to the dequeue node feeding them.
+        `required=False` returns None when no queue feeds the endpoints;
+        the multiple-queues error always surfaces."""
         seen, stack = set(), [_clean(e) for e in end_points]
         found = []
         while stack:
@@ -159,6 +185,8 @@ class Session:
                 continue
             stack.extend(_clean(i) for i in nd.input)
         if not found:
+            if not required:
+                return None
             raise ValueError(
                 f"no queue dequeue/reader node feeds {list(end_points)}; "
                 "use train(outputs, dataset, ...) for placeholder graphs")
@@ -185,16 +213,28 @@ class Session:
         removed = {deq.name} | {
             nd.name for nd in self.graph_def.node
             if nd.op in _ENQUEUE_OPS + _QUEUE_OPS + _READER_OPS}
+        from bigdl_tpu.interop.tensorflow import _assign_initializers
+        assigns_of = _assign_initializers(self.graph_def)
         keep, stack = set(), [_clean(e) for e in end_points]
         while stack:
             name = stack.pop()
             if name in keep or name not in self.nodes or name in removed:
                 continue
             keep.add(name)
-            stack.extend(_clean(i) for i in self.nodes[name].input)
+            nd = self.nodes[name]
+            stack.extend(_clean(i) for i in nd.input)
+            if nd.op in ("VariableV2", "Variable") and name in assigns_of:
+                # keep the initializer subgraph so the loader can
+                # materialize the variable
+                stack.append(assigns_of[name])
         gd = pb.GraphDef()
         for nd in self.graph_def.node:
-            if nd.name in removed or nd.name not in keep:
+            kept = nd.name in keep or (
+                # Assign nodes of kept variables carry the initializer
+                # wiring the loader's materialization step reads
+                nd.op == "Assign" and len(nd.input) >= 2
+                and _clean(nd.input[0]) in keep)
+            if nd.name in removed or not kept:
                 continue
             new = pb.NodeDef()
             new.CopyFrom(nd)

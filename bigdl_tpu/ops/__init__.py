@@ -27,7 +27,8 @@ from bigdl_tpu.ops.operation import (Abs, Add, All, Any, ApproximateEqual,
                                      Slice, SplitAndSelect, Sqrt, Square,
                                      SquaredDifference, StridedSlice, Sub,
                                      Sum, TensorModuleWrapper, TensorOp, Tile,
-                                     TopK, TruncateDiv, TruncatedNormal)
+                                     TopK, TruncateDiv, TruncatedNormal,
+                                     RandomNormal)
 from bigdl_tpu.ops.feature_col import (BucketizedCol, CategoricalColHashBucket,
                                        CategoricalColVocaList, CrossCol,
                                        IndicatorCol, Kv2Tensor, MkString,

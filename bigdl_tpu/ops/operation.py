@@ -379,6 +379,19 @@ class RandomUniform(Operation):
                                   minval=self.minval, maxval=self.maxval)
 
 
+class RandomNormal(Operation):
+    """Unbounded N(mean, stddev) sampler (TF RandomStandardNormal)."""
+
+    def __init__(self, mean: float = 0.0, stddev: float = 1.0, name=None):
+        super().__init__(name)
+        self.mean, self.stddev = mean, stddev
+
+    def apply(self, params, input, ctx):
+        shape = tuple(int(s) for s in np.asarray(input))
+        z = jax.random.normal(ctx.make_rng(), shape)
+        return z * self.stddev + self.mean
+
+
 class TruncatedNormal(Operation):
     """Truncated-normal sampler (DL/nn/ops/TruncatedNormal.scala)."""
 

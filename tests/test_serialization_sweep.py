@@ -393,6 +393,8 @@ SPECS.update({
         MAT.copy(), np.array([1, 2], np.int32))),
     "RandomUniform": (lambda: ops.RandomUniform(),
                       np.array([2, 3], np.int32)),
+    "RandomNormal": (lambda: ops.RandomNormal(),
+                     np.array([2, 3], np.int32)),
     "TruncatedNormal": (lambda: ops.TruncatedNormal(),
                         np.array([2, 3], np.int32)),
     "BucketizedCol": (lambda: ops.BucketizedCol([0.0, 0.5]), MAT),
@@ -416,7 +418,8 @@ SPECS.update({
 
 # TF loader-internal modules (ctor args are plain ndarrays/ints)
 from bigdl_tpu.interop._tf_modules import (_TFAxisSlice, _TFConst,
-                                           _TFDilation2D, _TFFill,
+                                           _TFDilation2D, _TFDynamicReshape,
+                                           _TFFill,
                                            _TFMatMul, _TFPad, _TFPermute,
                                            _TFStridedSlice, _TFTableSelect,
                                            _TFUnstack)
@@ -432,6 +435,8 @@ SPECS.update({
     "_TFTableSelect": (lambda: _TFTableSelect(1), PAIR),
     "_TFDilation2D": (lambda: _TFDilation2D(np.ones((2, 2, 3), np.float32)),
                       IMG),
+    "_TFDynamicReshape": (lambda: _TFDynamicReshape(), Table(
+        MAT.copy(), np.array([4, 2], np.int32))),
 })
 
 from bigdl_tpu.interop.caffe import _CaffeFlatten, _CaffeSlice
