@@ -21,6 +21,7 @@ import jax.numpy as jnp
 
 import bigdl_tpu.nn as nn
 from bigdl_tpu.nn.module import functional_apply
+from bigdl_tpu.utils.table import T
 
 TOL = 1e-5
 RS = np.random.RandomState(20260729)
@@ -480,3 +481,128 @@ def test_convnet_end_to_end_matches_torch():
     y = y.permute(0, 2, 3, 1).reshape(2, -1)  # NHWC flatten = our Reshape
     y = F.linear(y, torch.tensor(wl.T), torch.tensor(bl))
     np.testing.assert_allclose(ours, y.numpy(), atol=TOL, rtol=TOL)
+
+
+# ---------------------------------------------------------------- recurrent
+class TestRecurrentGolden:
+    """LSTM/GRU/RNN cells vs torch.nn counterparts (the reference checks
+    recurrent numerics against Torch in TEST/torch/{LSTM,GRU}Spec)."""
+
+    B, T, I, H = 3, 5, 4, 6
+
+    def _x(self):
+        return np.random.RandomState(0).randn(
+            self.B, self.T, self.I).astype(np.float32)
+
+    def _copy_lstm_weights(self, cell_params, tl):
+        import torch
+        # torch packs gates i,f,g,o rowwise: weight_ih [4H, I]
+        wi = np.asarray(cell_params["wi"])  # [I, 4H], cols i,f,g,o
+        wh = np.asarray(cell_params["wh"])
+        b = np.asarray(cell_params["bias"])
+        with torch.no_grad():
+            tl.weight_ih_l0.copy_(torch.tensor(wi.T))
+            tl.weight_hh_l0.copy_(torch.tensor(wh.T))
+            tl.bias_ih_l0.copy_(torch.tensor(b))
+            tl.bias_hh_l0.zero_()
+
+    def test_lstm_matches_torch(self):
+        torch = pytest.importorskip("torch")
+        m = nn.Recurrent(nn.LSTMCell(self.I, self.H), return_sequences=True)
+        params = m.init(jax.random.PRNGKey(0))
+        x = self._x()
+        got = np.asarray(functional_apply(m, params, jnp.asarray(x))[0])
+
+        tl = torch.nn.LSTM(self.I, self.H, batch_first=True)
+        self._copy_lstm_weights(params["cell"], tl)
+        want = tl(torch.tensor(x))[0].detach().numpy()
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+    def test_gru_matches_original_formulation(self):
+        """GRU oracle: the ORIGINAL Cho et al. candidate n = tanh(Wx +
+        U(r*h)) — the variant DL/nn/GRU.scala and Keras implement.
+        (torch.nn.GRU uses the cuDNN variant r*(Uh + b) and is NOT a valid
+        oracle for this layer.)"""
+        m = nn.Recurrent(nn.GRUCell(self.I, self.H), return_sequences=True)
+        params = m.init(jax.random.PRNGKey(1))
+        x = self._x()
+        got = np.asarray(functional_apply(m, params, jnp.asarray(x))[0])
+
+        p = jax.tree_util.tree_map(np.asarray, params["cell"])
+        H = self.H
+
+        def sigm(v):
+            return 1.0 / (1.0 + np.exp(-v))
+
+        h = np.zeros((self.B, H), np.float32)
+        want = np.zeros_like(got)
+        for t in range(self.T):
+            xt = x[:, t]
+            rz = sigm(xt @ p["wi_rz"] + h @ p["wh_rz"] + p["b_rz"])
+            r, z = rz[:, :H], rz[:, H:]
+            n = np.tanh(xt @ p["wi_n"] + (r * h) @ p["wh_n"] + p["b_n"])
+            h = (1.0 - z) * n + z * h
+            want[:, t] = h
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+    def test_rnn_tanh_matches_torch(self):
+        torch = pytest.importorskip("torch")
+        m = nn.Recurrent(nn.RnnCell(self.I, self.H), return_sequences=True)
+        params = m.init(jax.random.PRNGKey(2))
+        x = self._x()
+        got = np.asarray(functional_apply(m, params, jnp.asarray(x))[0])
+        tr = torch.nn.RNN(self.I, self.H, batch_first=True)
+        p = params["cell"]
+        with torch.no_grad():
+            tr.weight_ih_l0.copy_(torch.tensor(np.asarray(p["wi"]).T))
+            tr.weight_hh_l0.copy_(torch.tensor(np.asarray(p["wh"]).T))
+            tr.bias_ih_l0.copy_(torch.tensor(np.asarray(p["bias"])))
+            tr.bias_hh_l0.zero_()
+        want = tr(torch.tensor(x))[0].detach().numpy()
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+    def test_lstm_grad_matches_torch(self):
+        torch = pytest.importorskip("torch")
+        m = nn.Recurrent(nn.LSTMCell(self.I, self.H), return_sequences=True)
+        params = m.init(jax.random.PRNGKey(3))
+        x = self._x()
+
+        def loss(p, xx):
+            out, _ = functional_apply(m, p, xx)
+            return jnp.sum(out ** 2)
+
+        gx = np.asarray(jax.grad(loss, argnums=1)(
+            params, jnp.asarray(x)))
+        tl = torch.nn.LSTM(self.I, self.H, batch_first=True)
+        self._copy_lstm_weights(params["cell"], tl)
+        tx = torch.tensor(x, requires_grad=True)
+        (tl(tx)[0] ** 2).sum().backward()
+        np.testing.assert_allclose(gx, tx.grad.numpy(), rtol=1e-3,
+                                   atol=1e-4)
+
+
+class TestAttentionGolden:
+    def test_scaled_dot_product_matches_torch(self):
+        torch = pytest.importorskip("torch")
+        rs = np.random.RandomState(0)
+        q, k, v = (rs.randn(2, 2, 5, 4).astype(np.float32)
+                   for _ in range(3))
+        m = nn.ScaledDotProductAttention(use_flash=False)
+        got = np.asarray(m.forward(T(jnp.asarray(q), jnp.asarray(k),
+                                     jnp.asarray(v))))
+        want = torch.nn.functional.scaled_dot_product_attention(
+            torch.tensor(q), torch.tensor(k), torch.tensor(v)).numpy()
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+    def test_causal_attention_matches_torch(self):
+        torch = pytest.importorskip("torch")
+        rs = np.random.RandomState(1)
+        q, k, v = (rs.randn(2, 2, 6, 4).astype(np.float32)
+                   for _ in range(3))
+        m = nn.ScaledDotProductAttention(causal=True, use_flash=False)
+        got = np.asarray(m.forward(T(jnp.asarray(q), jnp.asarray(k),
+                                     jnp.asarray(v))))
+        want = torch.nn.functional.scaled_dot_product_attention(
+            torch.tensor(q), torch.tensor(k), torch.tensor(v),
+            is_causal=True).numpy()
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
