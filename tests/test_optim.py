@@ -232,7 +232,7 @@ class TestEndToEnd:
         o = optim.Optimizer(model, (X, Y), nn.ClassNLLCriterion(),
                             batch_size=32, local=True)
         o.set_optim_method(optim.Adam(learning_rate=3e-3))
-        o.set_end_when(optim.max_iteration(40))
+        o.set_end_when(optim.max_iteration(60))
         o.set_checkpoint(str(tmp_path / "ckpt"), optim.several_iteration(20))
         trained = o.optimize()
         res = trained.evaluate_on(
