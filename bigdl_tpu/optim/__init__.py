@@ -1,6 +1,7 @@
 """bigdl_tpu.optim — optimization methods, training loops, validation."""
 
-from bigdl_tpu.optim.optim_method import (SGD, Adadelta, Adagrad, Adam, Adamax,
+from bigdl_tpu.optim.optim_method import (CompositeOptimMethod,
+                                          SGD, Adadelta, Adagrad, Adam, Adamax,
                                           Ftrl, LBFGS, OptimMethod,
                                           ParallelAdam, RMSprop)
 from bigdl_tpu.optim import schedules

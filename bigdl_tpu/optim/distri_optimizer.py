@@ -241,7 +241,10 @@ class DistriOptimizer(BaseOptimizer):
             if self.train_summary is not None:
                 it = driver_state["neval"]
                 self.train_summary.add_scalar("Loss", loss, it)
-                self.train_summary.add_scalar("LearningRate", lr, it)
+                self.train_summary.add_scalar(
+                    "LearningRate",
+                    float(np.mean(lr)) if isinstance(lr, tuple)
+                    else lr, it)
                 self.train_summary.add_scalar("Throughput", throughput, it)
 
             if driver_state["recordsProcessedThisEpoch"] >= epoch_size:

@@ -73,6 +73,15 @@ class BaseOptimizer:
 
     setOptimMethod = set_optim_method
 
+    def set_optim_methods(self, methods: Dict[str, "OptimMethod"]):
+        """Per-submodule optimization methods keyed by top-level child
+        name (Optimizer.scala:120 setOptimMethods)."""
+        from bigdl_tpu.optim.optim_method import CompositeOptimMethod
+        self.optim_method = CompositeOptimMethod(self.model, methods)
+        return self
+
+    setOptimMethods = set_optim_methods
+
     def set_end_when(self, trigger: Trigger):
         self.end_trigger = trigger
         return self
@@ -294,7 +303,10 @@ class LocalOptimizer(BaseOptimizer):
             if self.train_summary is not None:
                 it = driver_state["neval"]
                 self.train_summary.add_scalar("Loss", loss, it)
-                self.train_summary.add_scalar("LearningRate", lr, it)
+                self.train_summary.add_scalar(
+                    "LearningRate",
+                    float(np.mean(lr)) if isinstance(lr, tuple)
+                    else lr, it)
                 self.train_summary.add_scalar("Throughput", throughput, it)
                 # Parameters histograms only behind an explicit trigger —
                 # they pull every weight to host (AbstractOptimizer.scala:47-92)

@@ -379,3 +379,67 @@ class LBFGS(OptimMethod):
                 break
             x = x_new
         return unpack(x)
+
+
+class CompositeOptimMethod(OptimMethod):
+    """Per-submodule optimization methods.
+
+    Parity: `Optimizer.setOptimMethods(Map[subModuleName -> OptimMethod])`
+    (DL/optim/Optimizer.scala:120 + per-submodule application,
+    DistriOptimizer.scala:818-839): each TOP-LEVEL child of the model
+    trains under its named method (distinct LR/schedule/slots). Built by
+    `BaseOptimizer.set_optim_methods`; presents the single-OptimMethod
+    interface, so the jitted train step is unchanged — `current_lr()`
+    returns a tuple (one entry per child) that `update` unpacks.
+    """
+
+    def __init__(self, model, methods: Dict[str, "OptimMethod"]):
+        super().__init__()
+        self.methods = dict(methods)
+        self._keys = list(model._child_keys)
+        self._method_of: Dict[str, OptimMethod] = {}
+        unused = set(methods)
+        for key, child in zip(model._child_keys, model.children):
+            m = methods.get(child.name)
+            self._method_of[key] = m
+            if m is not None:
+                unused.discard(child.name)
+        if unused:
+            raise ValueError(
+                f"set_optim_methods: no top-level submodule named "
+                f"{sorted(unused)}; children are "
+                f"{[c.name for c in model.children]}")
+
+    def _pairs(self, params):
+        for key in params:
+            if not params[key]:  # parameter-less child (activation etc.)
+                continue
+            m = self._method_of.get(key)
+            if m is None:
+                raise ValueError(
+                    f"submodule '{key}' has parameters but no optim "
+                    "method; cover every trainable top-level child")
+            yield key, m
+
+    def init_state(self, params):
+        return {k: m.init_state(params[k]) for k, m in self._pairs(params)}
+
+    def current_lr(self):
+        return tuple(m.current_lr() if m else 0.0
+                     for m in (self._method_of.get(k) for k in self._keys))
+
+    def update(self, grads, opt_state, params, lr):
+        lrs = dict(zip(self._keys, lr))
+        new_p, new_o = {}, {}
+        for k, m in self._pairs(grads):
+            new_p[k], new_o[k] = m.update(grads[k], opt_state[k],
+                                          params[k], lrs[k])
+        # untouched (parameterless) subtrees pass through
+        for k in params:
+            if k not in new_p:
+                new_p[k] = params[k]
+        return new_p, new_o
+
+    def get_hyper_parameter(self) -> str:
+        return "; ".join(f"{name}: {m.get_hyper_parameter()}"
+                         for name, m in self.methods.items())

@@ -337,3 +337,59 @@ class TestCheckpointSlots:
         out = jnp.eye(3)
         r = optim.MAE().apply(out, jnp.array([1, 2, 3]))
         assert r.result()[0] == 0.0
+
+
+class TestCompositeOptimMethods:
+    """Per-submodule optim methods (Optimizer.scala setOptimMethods,
+    DistriOptimizer.scala:818-839)."""
+
+    def _model(self):
+        return (nn.Sequential()
+                .add(nn.Linear(6, 8, name="encoder"))
+                .add(nn.ReLU(name="act"))
+                .add(nn.Linear(8, 3, name="head"))
+                .add(nn.LogSoftMax(name="out")))
+
+    def test_submodules_train_under_their_methods(self, tmp_path):
+        rs = np.random.RandomState(0)
+        X = rs.randn(128, 6).astype(np.float32)
+        y = (rs.randint(0, 3, 128) + 1).astype(np.int32)
+        m = self._model()
+        o = optim.Optimizer(m, (X, y), nn.ClassNLLCriterion(),
+                            batch_size=32, local=True)
+        o.set_optim_methods({"encoder": optim.SGD(learning_rate=0.0),
+                             "head": optim.Adam(learning_rate=5e-2)})
+        o.set_end_when(optim.max_iteration(20))
+        before = jax.tree_util.tree_map(np.asarray, m.ensure_params())
+        o.optimize()
+        after = m.ensure_params()
+        # frozen encoder (lr=0) unchanged; head moved
+        for k in before:
+            if "encoder" in k:
+                jax.tree_util.tree_map(
+                    lambda a, b: np.testing.assert_array_equal(
+                        a, np.asarray(b)), before[k], after[k])
+            if "head" in k:
+                moved = any(
+                    not np.allclose(a, np.asarray(b))
+                    for a, b in zip(jax.tree_util.tree_leaves(before[k]),
+                                    jax.tree_util.tree_leaves(after[k])))
+                assert moved
+
+    def test_uncovered_trainable_child_raises(self):
+        m = self._model()
+        o = optim.Optimizer(m, (np.zeros((8, 6), np.float32),
+                                np.ones(8, np.int32)),
+                            nn.ClassNLLCriterion(), batch_size=8, local=True)
+        o.set_optim_methods({"encoder": optim.SGD()})  # head missing
+        o.set_end_when(optim.max_iteration(1))
+        with pytest.raises(ValueError, match="head"):
+            o.optimize()
+
+    def test_unknown_submodule_name_raises(self):
+        m = self._model()
+        o = optim.Optimizer(m, (np.zeros((8, 6), np.float32),
+                                np.ones(8, np.int32)),
+                            nn.ClassNLLCriterion(), batch_size=8, local=True)
+        with pytest.raises(ValueError, match="nope"):
+            o.set_optim_methods({"nope": optim.SGD()})
