@@ -27,6 +27,6 @@ from bigdl_tpu.optim.local_optimizer import LocalOptimizer
 from bigdl_tpu.optim.distri_optimizer import (DistriOptimizer,
                                               ParallelOptimizer)
 from bigdl_tpu.optim.optimizer import Optimizer
-from bigdl_tpu.optim.predictor import (LocalPredictor, PredictionService,
-                                       Predictor)
+from bigdl_tpu.optim.predictor import (DistriPredictor, LocalPredictor,
+                                       PredictionService, Predictor)
 from bigdl_tpu.optim.evaluator import DistriValidator, Evaluator, LocalValidator

@@ -243,8 +243,8 @@ class DistriOptimizer(BaseOptimizer):
                 self.train_summary.add_scalar("Loss", loss, it)
                 self.train_summary.add_scalar(
                     "LearningRate",
-                    float(np.mean(lr)) if isinstance(lr, tuple)
-                    else lr, it)
+                    float(np.mean([v for v in lr if v]) if any(lr) else 0.0)
+                    if isinstance(lr, tuple) else lr, it)
                 self.train_summary.add_scalar("Throughput", throughput, it)
 
             if driver_state["recordsProcessedThisEpoch"] >= epoch_size:

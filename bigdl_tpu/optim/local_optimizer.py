@@ -305,8 +305,8 @@ class LocalOptimizer(BaseOptimizer):
                 self.train_summary.add_scalar("Loss", loss, it)
                 self.train_summary.add_scalar(
                     "LearningRate",
-                    float(np.mean(lr)) if isinstance(lr, tuple)
-                    else lr, it)
+                    float(np.mean([v for v in lr if v]) if any(lr) else 0.0)
+                    if isinstance(lr, tuple) else lr, it)
                 self.train_summary.add_scalar("Throughput", throughput, it)
                 # Parameters histograms only behind an explicit trigger —
                 # they pull every weight to host (AbstractOptimizer.scala:47-92)

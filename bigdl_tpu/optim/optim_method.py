@@ -424,9 +424,38 @@ class CompositeOptimMethod(OptimMethod):
     def init_state(self, params):
         return {k: m.init_state(params[k]) for k, m in self._pairs(params)}
 
+    def _sync_counters(self):
+        """Propagate the driver's counters into every sub-method's state so
+        their LR schedules/decay see training progress (the reference keeps
+        one state Table per method and advances each,
+        DistriOptimizer.scala:826)."""
+        for m in self.methods.values():
+            for key in ("neval", "epoch", "recordsProcessedThisEpoch",
+                        "loss", "score"):
+                if key in self.state:
+                    m.state[key] = self.state[key]
+
     def current_lr(self):
+        self._sync_counters()
         return tuple(m.current_lr() if m else 0.0
                      for m in (self._method_of.get(k) for k in self._keys))
+
+    @property
+    def schedule(self):
+        """Plateau-style schedules on sub-methods receive validation
+        scores through this proxy (BaseOptimizer._validate feeds
+        optim_method.schedule.record)."""
+        class _Proxy:
+            def __init__(p_self, methods):
+                p_self._methods = methods
+
+            def record(p_self, score, _method):
+                for m in p_self._methods.values():
+                    sched = getattr(m, "schedule", None)
+                    if sched is not None and hasattr(sched, "record"):
+                        sched.record(score, m)
+
+        return _Proxy(self.methods)
 
     def update(self, grads, opt_state, params, lr):
         lrs = dict(zip(self._keys, lr))

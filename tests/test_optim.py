@@ -393,3 +393,52 @@ class TestCompositeOptimMethods:
                             nn.ClassNLLCriterion(), batch_size=8, local=True)
         with pytest.raises(ValueError, match="nope"):
             o.set_optim_methods({"nope": optim.SGD()})
+
+
+class TestDistriPredictor:
+    """Mesh-sharded inference (DL/optim/Predictor.scala role)."""
+
+    def test_matches_local_predictor(self):
+        from bigdl_tpu.optim.predictor import DistriPredictor, LocalPredictor
+        rs = np.random.RandomState(0)
+        m = (nn.Sequential().add(nn.Linear(6, 8)).add(nn.Tanh())
+             .add(nn.Linear(8, 3)).add(nn.SoftMax()))
+        m.ensure_params()
+        X = rs.randn(26, 6).astype(np.float32)  # ragged vs 8 devices
+        local = LocalPredictor(m, batch_size=8).predict(X)
+        distri = DistriPredictor(m, batch_size=8).predict(X)
+        assert len(local) == len(distri) == 26
+        for a, b in zip(local, distri):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-5, atol=1e-6)
+
+    def test_predict_class(self):
+        from bigdl_tpu.optim.predictor import DistriPredictor
+        m = (nn.Sequential().add(nn.Linear(4, 2)).add(nn.LogSoftMax()))
+        m.ensure_params()
+        X = np.random.RandomState(1).randn(10, 4).astype(np.float32)
+        cls = DistriPredictor(m, batch_size=4).predict_class(X)
+        assert len(cls) == 10 and all(c in (1, 2) for c in cls)
+
+    def test_schedule_decay_advances_under_composite(self):
+        """LR schedules on sub-methods must see training progress
+        (review regression: frozen neval froze every schedule)."""
+        rs = np.random.RandomState(0)
+        X = rs.randn(64, 6).astype(np.float32)
+        y = (rs.randint(0, 3, 64) + 1).astype(np.int32)
+        m = (nn.Sequential()
+             .add(nn.Linear(6, 8, name="encoder"))
+             .add(nn.ReLU(name="act"))
+             .add(nn.Linear(8, 3, name="head"))
+             .add(nn.LogSoftMax(name="out")))
+        method = optim.SGD(learning_rate=0.1, learning_rate_decay=0.5)
+        o = optim.Optimizer(m, (X, y), nn.ClassNLLCriterion(),
+                            batch_size=32, local=True)
+        o.set_optim_methods({"encoder": method,
+                             "head": optim.SGD(learning_rate=0.1)})
+        o.set_end_when(optim.max_iteration(4))
+        lrs = []
+        o.set_iteration_hook(
+            lambda s: lrs.append(o.optim_method.current_lr()[0]))
+        o.optimize()
+        assert lrs[-1] < lrs[0], lrs  # 0.1/(1+0.5*neval) decays
