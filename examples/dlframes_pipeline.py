@@ -1,0 +1,51 @@
+"""DataFrame ML-pipeline example.
+
+Parity: DL/example/MLPipeline + dlframes (SURVEY.md C31/C37) — fit a
+DLClassifier stage on a feature frame, transform a prediction frame.
+Pandas plays the DataFrame role (declared design delta: no Spark).
+"""
+
+from __future__ import annotations
+
+import os as _os
+import sys as _sys
+_sys.path.insert(0, _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__))))
+
+import argparse
+
+import numpy as np
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--rows", type=int, default=256)
+    p.add_argument("--max-epoch", type=int, default=8)
+    args = p.parse_args(argv)
+
+    import pandas as pd
+    import bigdl_tpu.nn as nn
+    import bigdl_tpu.optim as optim
+    from bigdl_tpu.dlframes import DLClassifier
+
+    rs = np.random.RandomState(0)
+    X = rs.randn(args.rows, 4).astype(np.float32)
+    labels = (X[:, 0] + X[:, 1] > 0).astype(np.int64) + 1
+    df = pd.DataFrame({"features": list(X), "label": labels})
+
+    model = (nn.Sequential()
+             .add(nn.Linear(4, 16)).add(nn.ReLU())
+             .add(nn.Linear(16, 2)).add(nn.LogSoftMax()))
+    clf = (DLClassifier(model, nn.ClassNLLCriterion(), (4,))
+           .set_batch_size(32)
+           .set_max_epoch(args.max_epoch)
+           .set_learning_rate(0.05))
+    fitted = clf.fit(df)
+
+    pred = fitted.transform(df)
+    acc = float((pred["prediction"].to_numpy() == labels).mean())
+    print(f"pipeline train accuracy: {acc:.3f}")
+    return acc
+
+
+if __name__ == "__main__":
+    main()

@@ -82,3 +82,8 @@ class TestExamples:
         from examples.treelstm_sentiment import main
         acc = main(["--sentences", "128", "--max-iteration", "80"])
         assert acc > 0.8
+
+    def test_dlframes_pipeline(self):
+        from examples.dlframes_pipeline import main
+        acc = main(["--max-epoch", "8"])
+        assert acc > 0.85
