@@ -4,3 +4,5 @@ from bigdl_tpu.parallel.sharding import (ShardingRules, infer_param_specs)
 from bigdl_tpu.parallel.sequence import (SequenceParallelAttention,
                                          make_sequence_parallel_attention,
                                          ring_attention, ulysses_attention)
+from bigdl_tpu.parallel.pipeline import GPipe
+from bigdl_tpu.parallel.moe import MoE

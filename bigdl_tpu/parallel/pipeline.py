@@ -1,0 +1,124 @@
+"""Pipeline parallelism: GPipe over a 'pipe' mesh axis.
+
+Beyond-parity (the reference scales only by data parallelism): stage
+parameters live one-stage-per-device on the mesh's 'pipe' axis, the batch
+splits into microbatches, and activations flow stage-to-stage with
+`lax.ppermute` — XLA lowers the shifts to ICI neighbor sends, and its
+scheduler overlaps them with the next microbatch's compute (the same
+mechanism ring attention uses, parallel/sequence.py).
+
+Shape contract (classic homogeneous GPipe): every stage is the same block
+module, so inter-stage activations share one shape and the stage loop is
+a single traced body under `lax.scan` — one compilation regardless of
+stage count or microbatch count.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from bigdl_tpu.nn.module import ApplyContext, Module
+
+
+class GPipe(Module):
+    """`n_stages` copies of `block` run as a pipeline.
+
+    `init` returns the block's params STACKED on a leading stage axis —
+    shard that axis over the mesh's 'pipe' dimension (`place_params`).
+    `pipeline_apply` runs the schedule inside shard_map; microbatch count
+    defaults to the stage count (fill efficiency n_micro/(n_micro+S-1)).
+    """
+
+    def __init__(self, block: Module, n_stages: int,
+                 n_micro: Optional[int] = None, name=None):
+        super().__init__(name)
+        self.block = block
+        self.n_stages = n_stages
+        self.n_micro = n_micro or n_stages
+
+    # -- params ----------------------------------------------------------
+    def init(self, rng):
+        keys = jax.random.split(rng, self.n_stages)
+        per_stage = [self.block.init(k) for k in keys]
+        return jax.tree_util.tree_map(
+            lambda *leaves: jnp.stack(leaves), *per_stage)
+
+    def place_params(self, mesh: Mesh, params):
+        """Shard the stacked stage axis over 'pipe'."""
+        sh = NamedSharding(mesh, P("pipe"))
+        return jax.tree_util.tree_map(
+            lambda leaf: jax.device_put(leaf, sh), params)
+
+    # -- sequential reference (single device; also the Module contract) --
+    def apply(self, params, input, ctx: ApplyContext):
+        out, _ = lax.scan(lambda h, p: (self.block.apply(p, h, ctx), None),
+                          input, params)
+        return out
+
+    # -- pipelined execution --------------------------------------------
+    def pipeline_apply(self, mesh: Mesh, params, x, training: bool = False):
+        """Run the GPipe schedule over mesh axis 'pipe'.
+
+        x: [B, ...] host/global batch, B divisible by n_micro. Returns the
+        same result as sequential `apply`, computed with each stage on its
+        own device."""
+        n_micro, S = self.n_micro, self.n_stages
+        mesh_pipe = int(dict(zip(mesh.axis_names,
+                                 mesh.devices.shape)).get("pipe", 0))
+        if mesh_pipe != S:
+            raise ValueError(
+                f"mesh 'pipe' axis has {mesh_pipe} devices but the "
+                f"pipeline has {S} stages")
+        B = x.shape[0]
+        if B % n_micro:
+            raise ValueError(f"batch {B} not divisible by n_micro {n_micro}")
+        micro = x.reshape((n_micro, B // n_micro) + x.shape[1:])
+        block = self.block
+        ctx = ApplyContext(training=training)
+
+        def staged(params_stage, micro_all):
+            # params_stage: this device's stage params (leading axis
+            # sliced to 1 by shard_map) — drop the stage dim
+            params_local = jax.tree_util.tree_map(
+                lambda l: l[0], params_stage)
+            idx = lax.axis_index("pipe")
+            zeros = jnp.zeros_like(micro_all[0])
+            try:
+                # scan carry must be device-varying like the loop outputs
+                zeros = lax.pcast(zeros, ("pipe",), to="varying")
+            except AttributeError:
+                pass
+            T = n_micro + S - 1
+            perm = [(i, (i + 1) % S) for i in range(S)]
+
+            def tick(state, t):
+                inject = lax.dynamic_index_in_dim(
+                    micro_all, jnp.minimum(t, n_micro - 1), axis=0,
+                    keepdims=False)
+                h_in = jnp.where(idx == 0, inject, state)
+                h_out = block.apply(params_local, h_in, ctx)
+                return lax.ppermute(h_out, "pipe", perm), h_out
+
+            _, hs = lax.scan(tick, zeros, jnp.arange(T))
+            # the LAST stage's outputs at ticks [S-1, S-1+n_micro) are the
+            # pipeline results; broadcast them to every device
+            out_local = lax.dynamic_slice_in_dim(hs, S - 1, n_micro, axis=0)
+            out_local = jnp.where(idx == S - 1, out_local,
+                                  jnp.zeros_like(out_local))
+            return lax.psum(out_local, "pipe")
+
+        from bigdl_tpu.parallel.mesh import get_shard_map
+        shard_map = get_shard_map()
+        stage_spec = jax.tree_util.tree_map(lambda _: P("pipe"), params)
+        mapped = shard_map(
+            staged, mesh=mesh,
+            in_specs=(stage_spec, P()),   # params by stage, batch replicated
+            out_specs=P())
+        out_micro = mapped(params, micro)
+        return out_micro.reshape((B,) + out_micro.shape[2:])
