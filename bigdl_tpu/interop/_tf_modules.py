@@ -18,7 +18,11 @@ class _TFConst(Module):
 
     def __init__(self, value, name=None):
         super().__init__(name)
-        self.value = jnp.asarray(np.asarray(value))
+        arr = np.asarray(value)
+        # string/bytes consts (ParseExample keys, filename lists) stay
+        # host-side numpy — jnp has no string dtype
+        self.value = arr if arr.dtype.kind in ("U", "S", "O") \
+            else jnp.asarray(arr)
 
     def apply(self, params, input, ctx):
         return self.value

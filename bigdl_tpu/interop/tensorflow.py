@@ -78,6 +78,13 @@ def _clean(name: str) -> str:
     return name.split(":")[0]
 
 
+# ops whose TF output is a tuple: consumers always select a port, and an
+# unqualified 'name' means 'name:0' (element 1 of the Table)
+_TABLE_OUTPUT_OPS = ("TopKV2", "TopK", "FusedBatchNormGrad",
+                     "FusedBatchNormGradV2", "BroadcastGradientArgs",
+                     "ParseExample", "ParseSingleExample")
+
+
 def _assign_initializers(gd: "pb.GraphDef") -> Dict[str, str]:
     """variable name -> its (first) Assign initializer's value ref."""
     out: Dict[str, str] = {}
@@ -238,7 +245,7 @@ class TensorflowLoader:
                 node = _TFTableSelect(idx, name=f"{base}.{idx}").inputs(raw)
                 built[key] = node
                 return node
-            if nd.op in ("TopKV2", "TopK"):
+            if nd.op in _TABLE_OUTPUT_OPS:
                 # Table-producing op: every output (incl. :0) selects its
                 # element so 'name' means 'name:0' like TF
                 from bigdl_tpu.interop._tf_modules import _TFTableSelect
@@ -342,9 +349,10 @@ class TensorflowLoader:
     def _convert(nd: pb.NodeDef, consts: Dict[str, np.ndarray],
                  args: List[str]) -> Tuple[Module, List[str]]:
         """Return (module, dynamic-input refs); const args fold into the
-        module (op-loader registry parity: DL/utils/tf/loaders/, 161 files;
-        this table covers the inference surface — grad/queue/decode ops are
-        handled by Session/input-pipeline code paths, not the graph).
+        module (op-loader registry parity: DL/utils/tf/loaders/, 161 files —
+        inference ops, gradient ops (ops/gradients.py), and decode/parse
+        input-pipeline ops (ops/parsing.py); queue/reader plumbing is
+        handled by TFSession, as in the reference's Session.scala).
 
         `args` are raw input refs (may carry ':k' output qualifiers); const
         lookups use the cleaned base name."""
@@ -676,6 +684,117 @@ class TensorflowLoader:
             return ops.RandomNormal(name=nd.name), args
         if op == "Assert":
             return ops.Assert(name=nd.name), args[:1]
+        # --- gradient ops (training-graph surface; Conv2DBackpropInput is
+        # also TF's transposed conv in inference graphs) ---
+        _EGRAD = {"ReluGrad": ops.ReluGrad, "Relu6Grad": ops.Relu6Grad,
+                  "EluGrad": ops.EluGrad, "SoftplusGrad": ops.SoftplusGrad,
+                  "SoftsignGrad": ops.SoftsignGrad,
+                  "SigmoidGrad": ops.SigmoidGrad, "TanhGrad": ops.TanhGrad,
+                  "SqrtGrad": ops.SqrtGrad, "RsqrtGrad": ops.RsqrtGrad,
+                  "InvGrad": ops.InvGrad,
+                  "ReciprocalGrad": ops.ReciprocalGrad}
+        if op in _EGRAD:
+            return _EGRAD[op](name=nd.name), args
+        if op == "BiasAddGrad":
+            fmt = a["data_format"].s.decode() if "data_format" in a \
+                else "NHWC"
+            return ops.BiasAddGrad(fmt, name=nd.name), args
+        if op == "BroadcastGradientArgs":
+            return ops.BroadcastGradientArgs(name=nd.name), args
+
+        def _conv_attrs(spatial):
+            strides = list(a["strides"].list.i) or [1] * (spatial + 2)
+            return (tuple(int(s) for s in strides[1:1 + spatial]),
+                    a["padding"].s.decode() or "SAME")
+
+        _CONV_GRAD = {
+            "Conv2DBackpropInput": (ops.Conv2DBackpropInput, 2),
+            "Conv2DBackpropFilter": (ops.Conv2DBackpropFilter, 2),
+            "Conv3DBackpropInput": (ops.Conv3DBackpropInput, 3),
+            "Conv3DBackpropInputV2": (ops.Conv3DBackpropInput, 3),
+            "Conv3DBackpropFilter": (ops.Conv3DBackpropFilter, 3),
+            "Conv3DBackpropFilterV2": (ops.Conv3DBackpropFilter, 3),
+            "DepthwiseConv2dNativeBackpropInput":
+                (ops.DepthwiseConv2dNativeBackpropInput, 2),
+            "DepthwiseConv2dNativeBackpropFilter":
+                (ops.DepthwiseConv2dNativeBackpropFilter, 2),
+        }
+        if op in _CONV_GRAD:
+            cls, spatial = _CONV_GRAD[op]
+            strides, padding = _conv_attrs(spatial)
+            return cls(strides, padding, name=nd.name), args
+        if op in ("Dilation2DBackpropInput", "Dilation2DBackpropFilter"):
+            strides = list(a["strides"].list.i) or [1, 1, 1, 1]
+            rates = list(a["rates"].list.i) or [1, 1, 1, 1]
+            cls = ops.Dilation2DBackpropInput \
+                if op == "Dilation2DBackpropInput" \
+                else ops.Dilation2DBackpropFilter
+            return cls((int(strides[1]), int(strides[2])),
+                       (int(rates[1]), int(rates[2])),
+                       a["padding"].s.decode() or "SAME",
+                       name=nd.name), args
+        if op in ("MaxPoolGrad", "AvgPoolGrad"):
+            ksize = list(a["ksize"].list.i)
+            strides = list(a["strides"].list.i)
+            padding = a["padding"].s.decode() or "VALID"
+            cls = ops.MaxPoolGrad if op == "MaxPoolGrad" else ops.AvgPoolGrad
+            return cls(ksize, strides, padding, name=nd.name), args
+        if op == "LRNGrad":
+            return ops.LRNGrad(
+                int(a["depth_radius"].i) if "depth_radius" in a else 5,
+                float(a["bias"].f) if "bias" in a else 1.0,
+                float(a["alpha"].f) if "alpha" in a else 1.0,
+                float(a["beta"].f) if "beta" in a else 0.5,
+                name=nd.name), args
+        if op in ("FusedBatchNormGrad", "FusedBatchNormGradV2"):
+            eps = float(a["epsilon"].f) if "epsilon" in a else 1e-3
+            training = bool(a["is_training"].b) if "is_training" in a \
+                else True
+            return ops.FusedBatchNormGrad(eps, training, name=nd.name), args
+        if op == "ResizeBilinearGrad":
+            return ops.ResizeBilinearGrad(bool(a["align_corners"].b),
+                                          name=nd.name), args
+
+        # --- input-pipeline decode/parse ops (host-side, eager) ---
+        if op == "DecodeJpeg":
+            return ops.DecodeJpeg(
+                int(a["channels"].i) if "channels" in a else 0,
+                int(a["ratio"].i) if "ratio" in a else 1,
+                name=nd.name), args[:1]
+        if op == "DecodePng":
+            return ops.DecodePng(
+                int(a["channels"].i) if "channels" in a else 0,
+                name=nd.name), args[:1]
+        if op == "DecodeBmp":
+            return ops.DecodeBmp(
+                int(a["channels"].i) if "channels" in a else 0,
+                name=nd.name), args[:1]
+        if op == "DecodeGif":
+            return ops.DecodeGif(name=nd.name), args[:1]
+        if op == "DecodeRaw":
+            dt = _DTYPES.get(a["out_type"].type, np.float32)
+            little = bool(a["little_endian"].b) \
+                if "little_endian" in a else True
+            return ops.DecodeRaw(np.dtype(dt).name, little,
+                                 name=nd.name), args[:1]
+        if op == "ParseExample":
+            n_dense = int(a["Ndense"].i)
+            types = [np.dtype(_DTYPES.get(t, np.float32)).name
+                     if t != pb.DT_STRING else "object"
+                     for t in a["Tdense"].list.type]
+            shapes = [[int(d.size) for d in sh.dim]
+                      for sh in a["dense_shapes"].list.shape]
+            return ops.ParseExample(n_dense, types, shapes,
+                                    name=nd.name), args
+        if op == "ParseSingleExample":
+            keys = [k.decode() for k in a["dense_keys"].list.s]
+            types = [np.dtype(_DTYPES.get(t, np.float32)).name
+                     if t != pb.DT_STRING else "object"
+                     for t in a["Tdense"].list.type]
+            shapes = [[int(d.size) for d in sh.dim]
+                      for sh in a["dense_shapes"].list.shape]
+            return ops.ParseSingleExample(keys, types, shapes,
+                                          name=nd.name), args
         if op == "VariableV2" or op == "Variable":
             if nd.name in consts:  # materialized from init/supplied value
                 return _TFConst(consts[nd.name], name=nd.name), []

@@ -33,3 +33,21 @@ from bigdl_tpu.ops.feature_col import (BucketizedCol, CategoricalColHashBucket,
                                        CategoricalColVocaList, CrossCol,
                                        IndicatorCol, Kv2Tensor, MkString,
                                        Substr)
+from bigdl_tpu.ops.gradients import (AvgPoolGrad, BiasAddGrad,
+                                     BroadcastGradientArgs,
+                                     Conv2DBackpropFilter,
+                                     Conv2DBackpropInput,
+                                     Conv3DBackpropFilter,
+                                     Conv3DBackpropInput,
+                                     DepthwiseConv2dNativeBackpropFilter,
+                                     DepthwiseConv2dNativeBackpropInput,
+                                     Dilation2DBackpropFilter,
+                                     Dilation2DBackpropInput, EluGrad,
+                                     FusedBatchNormGrad, InvGrad, LRNGrad,
+                                     MaxPoolGrad, ReciprocalGrad, Relu6Grad,
+                                     ReluGrad, ResizeBilinearGrad, RsqrtGrad,
+                                     SigmoidGrad, SoftplusGrad, SoftsignGrad,
+                                     SqrtGrad, TanhGrad)
+from bigdl_tpu.ops.parsing import (DecodeBmp, DecodeGif, DecodeJpeg,
+                                   DecodePng, DecodeRaw, ParseExample,
+                                   ParseSingleExample)

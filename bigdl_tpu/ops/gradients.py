@@ -1,0 +1,464 @@
+"""TF gradient ops — the backward half of the loader registry.
+
+Parity: the reference ships explicit loader files for every grad op an
+exported TF *training* graph contains (`DL/utils/tf/loaders/ReluGrad.scala`,
+`Conv2DBackpropInput.scala`, `MaxPoolGrad.scala`, `BiasAddGrad.scala`,
+`FusedBatchNormGrad.scala`, ... — 161-file registry,
+`utils/tf/TensorflowLoader.scala:55`), each mapping to a hand-written
+backward module under `DL/nn/tf/`. Here every structural grad
+(conv/pool/LRN/resize/batch-norm) is the `jax.vjp` of the matching forward
+— one definition, guaranteed consistent with the forward op and jittable —
+and the elementwise grads are their closed forms.
+
+`Conv2DBackpropInput` doubles as TF's transposed convolution: inference
+graphs (segmentation/GAN decoders) emit it with a const filter, so this is
+inference-surface coverage too, not just training-graph support.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from bigdl_tpu.utils.table import Table
+
+from .operation import Operation
+
+_CONV2D_DN = ("NHWC", "HWIO", "NHWC")
+_CONV3D_DN = ("NDHWC", "DHWIO", "NDHWC")
+
+
+def _sizes_or_shape(v) -> Tuple[int, ...]:
+    """TF v1 backprop ops pass the original *tensor*, v2 its int32 sizes."""
+    arr = np.asarray(v)
+    if arr.ndim == 1 and arr.dtype.kind in ("i", "u"):
+        return tuple(int(s) for s in arr)
+    return tuple(int(s) for s in arr.shape)
+
+
+def _grad_at(fwd, primal, cotangent):
+    """d(fwd)/d(its argument) at `primal` applied to `cotangent`."""
+    _, vjp = jax.vjp(fwd, primal)
+    return vjp(cotangent)[0]
+
+
+class _ElementwiseGrad(Operation):
+    """Table(a, b) -> grad; `fn` is the closed-form backward."""
+    fn = None
+
+    def apply(self, params, input, ctx):
+        return type(self).fn(input[1], input[2])
+
+
+def _egrad(name: str, fn, doc: str) -> type:
+    return type(name, (_ElementwiseGrad,),
+                {"fn": staticmethod(fn),
+                 "__doc__": f"TF `{name}` (DL/utils/tf/loaders/{name}.scala)"
+                            f": {doc}"})
+
+
+# activation grads: (gradients, features) -> dx
+ReluGrad = _egrad("ReluGrad", lambda g, x: g * (x > 0).astype(g.dtype),
+                  "dy * 1[x>0]")
+Relu6Grad = _egrad("Relu6Grad",
+                   lambda g, x: g * ((x > 0) & (x < 6)).astype(g.dtype),
+                   "dy * 1[0<x<6]")
+EluGrad = _egrad("EluGrad",
+                 lambda g, y: g * jnp.where(y > 0, 1.0, y + 1.0),
+                 "grad wrt input from the ELU *output* y")
+SoftplusGrad = _egrad("SoftplusGrad",
+                      lambda g, x: g * jax.nn.sigmoid(x),
+                      "dy * sigmoid(x)")
+SoftsignGrad = _egrad("SoftsignGrad",
+                      lambda g, x: g / jnp.square(1.0 + jnp.abs(x)),
+                      "dy / (1+|x|)^2")
+# output-parameterized grads: (y, dy) -> dx
+SigmoidGrad = _egrad("SigmoidGrad", lambda y, g: g * y * (1.0 - y),
+                     "dy * y * (1-y)")
+TanhGrad = _egrad("TanhGrad", lambda y, g: g * (1.0 - jnp.square(y)),
+                  "dy * (1-y^2)")
+SqrtGrad = _egrad("SqrtGrad", lambda y, g: g * 0.5 / y, "dy * 0.5/y")
+RsqrtGrad = _egrad("RsqrtGrad", lambda y, g: -0.5 * g * y * y * y,
+                   "-dy * y^3 / 2")
+InvGrad = _egrad("InvGrad", lambda y, g: -g * y * y, "-dy * y^2")
+ReciprocalGrad = InvGrad
+
+
+class BiasAddGrad(Operation):
+    """TF `BiasAddGrad` (loaders/BiasAddGrad.scala): sum the out-backprop
+    over every axis but channels (NHWC: the last)."""
+
+    def __init__(self, data_format: str = "NHWC", name=None):
+        super().__init__(name)
+        self.data_format = data_format
+
+    def apply(self, params, input, ctx):
+        if self.data_format == "NCHW":
+            axes = (0,) + tuple(range(2, input.ndim))
+            return jnp.sum(input, axis=axes)
+        return jnp.sum(input, axis=tuple(range(input.ndim - 1)))
+
+
+class BroadcastGradientArgs(Operation):
+    """TF `BroadcastGradientArgs` (loaders/BroadcastGradientArgs.scala):
+    given the two operand shapes of a broadcasting binary op, the reduction
+    axes each grad must be summed over. Shape metadata resolves host-side
+    (eager), like Shape/Rank."""
+
+    def apply(self, params, input, ctx):
+        s0 = [int(v) for v in np.asarray(input[1])]
+        s1 = [int(v) for v in np.asarray(input[2])]
+        n = max(len(s0), len(s1))
+        p0 = [1] * (n - len(s0)) + s0
+        p1 = [1] * (n - len(s1)) + s1
+        r0 = [i for i in range(n) if p0[i] == 1 and p1[i] != 1
+              or i < n - len(s0)]
+        r1 = [i for i in range(n) if p1[i] == 1 and p0[i] != 1
+              or i < n - len(s1)]
+        return Table(jnp.asarray(sorted(set(r0)), jnp.int32),
+                     jnp.asarray(sorted(set(r1)), jnp.int32))
+
+
+class Conv2DBackpropInput(Operation):
+    """TF `Conv2DBackpropInput` (loaders/Conv2DBackpropInput.scala) — the
+    vjp of Conv2D wrt its input; also TF's transposed conv (decoder /
+    SpatialFullConvolution role). Table(input_sizes|input, filter, dout)."""
+
+    def __init__(self, strides: Sequence[int] = (1, 1),
+                 padding: str = "SAME", name=None):
+        super().__init__(name)
+        self.strides = tuple(int(s) for s in strides)
+        self.padding = padding
+
+    def apply(self, params, input, ctx):
+        sizes = _sizes_or_shape(input[1])
+        w, dout = input[2], input[3]
+
+        def fwd(x):
+            return lax.conv_general_dilated(
+                x, w, window_strides=self.strides, padding=self.padding,
+                dimension_numbers=_CONV2D_DN)
+
+        return _grad_at(lambda x: fwd(x), jnp.zeros(sizes, dout.dtype), dout)
+
+
+class Conv2DBackpropFilter(Operation):
+    """TF `Conv2DBackpropFilter` (loaders/Conv2DBackpropFilter.scala):
+    vjp of Conv2D wrt the HWIO filter. Table(input, filter_sizes, dout)."""
+
+    def __init__(self, strides: Sequence[int] = (1, 1),
+                 padding: str = "SAME", name=None):
+        super().__init__(name)
+        self.strides = tuple(int(s) for s in strides)
+        self.padding = padding
+
+    def apply(self, params, input, ctx):
+        x, dout = input[1], input[3]
+        sizes = _sizes_or_shape(input[2])
+
+        def fwd(w):
+            return lax.conv_general_dilated(
+                x, w, window_strides=self.strides, padding=self.padding,
+                dimension_numbers=_CONV2D_DN)
+
+        return _grad_at(fwd, jnp.zeros(sizes, x.dtype), dout)
+
+
+class Conv3DBackpropInput(Operation):
+    """TF `Conv3DBackpropInput(V2)` (loaders/Conv3DBackpropInputV2.scala):
+    vjp of Conv3D wrt input. Table(input_sizes|input, filter, dout)."""
+
+    def __init__(self, strides: Sequence[int] = (1, 1, 1),
+                 padding: str = "SAME", name=None):
+        super().__init__(name)
+        self.strides = tuple(int(s) for s in strides)
+        self.padding = padding
+
+    def apply(self, params, input, ctx):
+        sizes = _sizes_or_shape(input[1])
+        w, dout = input[2], input[3]
+
+        def fwd(x):
+            return lax.conv_general_dilated(
+                x, w, window_strides=self.strides, padding=self.padding,
+                dimension_numbers=_CONV3D_DN)
+
+        return _grad_at(fwd, jnp.zeros(sizes, dout.dtype), dout)
+
+
+class Conv3DBackpropFilter(Operation):
+    """TF `Conv3DBackpropFilter(V2)` (loaders/Conv3DBackpropFilterV2.scala):
+    vjp of Conv3D wrt the DHWIO filter. Table(input, filter_sizes|filter,
+    dout)."""
+
+    def __init__(self, strides: Sequence[int] = (1, 1, 1),
+                 padding: str = "SAME", name=None):
+        super().__init__(name)
+        self.strides = tuple(int(s) for s in strides)
+        self.padding = padding
+
+    def apply(self, params, input, ctx):
+        x, dout = input[1], input[3]
+        sizes = _sizes_or_shape(input[2])
+
+        def fwd(w):
+            return lax.conv_general_dilated(
+                x, w, window_strides=self.strides, padding=self.padding,
+                dimension_numbers=_CONV3D_DN)
+
+        return _grad_at(fwd, jnp.zeros(sizes, x.dtype), dout)
+
+
+def _depthwise_fwd(x, w_hwcm, strides, padding):
+    """TF depthwise conv: filter [H, W, C, mult] -> grouped lax conv."""
+    h, wd, c, m = w_hwcm.shape
+    w = jnp.reshape(w_hwcm, (h, wd, 1, c * m))
+    return lax.conv_general_dilated(
+        x, w, window_strides=strides, padding=padding,
+        dimension_numbers=_CONV2D_DN, feature_group_count=c)
+
+
+class DepthwiseConv2dNativeBackpropInput(Operation):
+    """TF `DepthwiseConv2dNativeBackpropInput`
+    (loaders/DepthwiseConv2dNativeBackpropInput.scala).
+    Table(input_sizes|input, filter[H,W,C,M], dout)."""
+
+    def __init__(self, strides: Sequence[int] = (1, 1),
+                 padding: str = "SAME", name=None):
+        super().__init__(name)
+        self.strides = tuple(int(s) for s in strides)
+        self.padding = padding
+
+    def apply(self, params, input, ctx):
+        sizes = _sizes_or_shape(input[1])
+        w, dout = input[2], input[3]
+        return _grad_at(
+            lambda x: _depthwise_fwd(x, w, self.strides, self.padding),
+            jnp.zeros(sizes, dout.dtype), dout)
+
+
+class DepthwiseConv2dNativeBackpropFilter(Operation):
+    """TF `DepthwiseConv2dNativeBackpropFilter`
+    (loaders/DepthwiseConv2dNativeBackpropFilter.scala).
+    Table(input, filter_sizes[H,W,C,M], dout) -> [H,W,C,M] grad."""
+
+    def __init__(self, strides: Sequence[int] = (1, 1),
+                 padding: str = "SAME", name=None):
+        super().__init__(name)
+        self.strides = tuple(int(s) for s in strides)
+        self.padding = padding
+
+    def apply(self, params, input, ctx):
+        x, dout = input[1], input[3]
+        sizes = _sizes_or_shape(input[2])
+        return _grad_at(
+            lambda w: _depthwise_fwd(x, w, self.strides, self.padding),
+            jnp.zeros(sizes, x.dtype), dout)
+
+
+def _dilation2d_fwd(x, filt, strides, rates, padding):
+    from . import operation as _ops
+    inner = _ops.Dilation2D(strides, rates, padding)
+    return inner.apply({}, Table(x, filt), None)
+
+
+class Dilation2DBackpropInput(Operation):
+    """TF `Dilation2DBackpropInput` (loaders/Dilation2DBackpropInput.scala):
+    vjp of morphological dilation wrt input. Table(input, filter, dout)."""
+
+    def __init__(self, strides=(1, 1), rates=(1, 1), padding="SAME",
+                 name=None):
+        super().__init__(name)
+        self.strides = tuple(int(s) for s in strides)
+        self.rates = tuple(int(r) for r in rates)
+        self.padding = padding
+
+    def apply(self, params, input, ctx):
+        x, filt, dout = input[1], input[2], input[3]
+        return _grad_at(
+            lambda v: _dilation2d_fwd(v, filt, self.strides, self.rates,
+                                      self.padding), x, dout)
+
+
+class Dilation2DBackpropFilter(Operation):
+    """TF `Dilation2DBackpropFilter`
+    (loaders/Dilation2DBackpropFilter.scala). Table(input, filter, dout)."""
+
+    def __init__(self, strides=(1, 1), rates=(1, 1), padding="SAME",
+                 name=None):
+        super().__init__(name)
+        self.strides = tuple(int(s) for s in strides)
+        self.rates = tuple(int(r) for r in rates)
+        self.padding = padding
+
+    def apply(self, params, input, ctx):
+        x, filt, dout = input[1], input[2], input[3]
+        return _grad_at(
+            lambda w: _dilation2d_fwd(x, w, self.strides, self.rates,
+                                      self.padding), filt, dout)
+
+
+def _pool_dims(ksize, strides):
+    """TF NHWC ksize/strides (len 2 or 4) -> lax window dims."""
+    k = list(ksize)
+    s = list(strides)
+    if len(k) == 2:
+        k = [1, k[0], k[1], 1]
+    if len(s) == 2:
+        s = [1, s[0], s[1], 1]
+    return tuple(int(v) for v in k), tuple(int(v) for v in s)
+
+
+class MaxPoolGrad(Operation):
+    """TF `MaxPoolGrad` (loaders/MaxPoolGrad.scala): vjp of max-pooling —
+    routes each output grad to its argmax cell.
+    Table(orig_input, orig_output, dout)."""
+
+    def __init__(self, ksize=(2, 2), strides=(2, 2), padding="VALID",
+                 name=None):
+        super().__init__(name)
+        self.ksize, self.strides = _pool_dims(ksize, strides)
+        self.padding = padding
+
+    def apply(self, params, input, ctx):
+        x, dout = input[1], input[3]
+
+        def fwd(v):
+            return lax.reduce_window(v, -jnp.inf, lax.max, self.ksize,
+                                     self.strides, self.padding)
+
+        return _grad_at(fwd, x, dout)
+
+
+class AvgPoolGrad(Operation):
+    """TF `AvgPoolGrad` (loaders/AvgPoolGrad.scala): vjp of average
+    pooling. Table(orig_input_shape, dout)."""
+
+    def __init__(self, ksize=(2, 2), strides=(2, 2), padding="VALID",
+                 count_include_pad: bool = False, name=None):
+        super().__init__(name)
+        self.ksize, self.strides = _pool_dims(ksize, strides)
+        self.padding = padding
+        self.count_include_pad = count_include_pad
+
+    def apply(self, params, input, ctx):
+        sizes = _sizes_or_shape(input[1])
+        dout = input[2]
+
+        def fwd(v):
+            s = lax.reduce_window(v, 0.0, lax.add, self.ksize, self.strides,
+                                  self.padding)
+            if self.padding == "VALID" or self.count_include_pad:
+                return s / float(np.prod(self.ksize))
+            ones = jnp.ones(sizes, v.dtype)
+            cnt = lax.reduce_window(ones, 0.0, lax.add, self.ksize,
+                                    self.strides, self.padding)
+            return s / cnt
+
+        return _grad_at(fwd, jnp.zeros(sizes, dout.dtype), dout)
+
+
+def _tf_lrn(x, depth_radius, bias, alpha, beta):
+    """TF-semantics LRN (alpha NOT pre-divided by window size)."""
+    c = x.shape[-1]
+    xt = jnp.moveaxis(x, -1, 0)
+    sq = jnp.square(xt)
+    pad = jnp.pad(sq, [(depth_radius, depth_radius)] + [(0, 0)] * (x.ndim - 1))
+    win = sum(pad[i:i + c] for i in range(2 * depth_radius + 1))
+    denom = jnp.power(bias + alpha * win, beta)
+    return jnp.moveaxis(xt / denom, 0, -1)
+
+
+class LRNGrad(Operation):
+    """TF `LRNGrad` (loaders/LRNGrad.scala): vjp of TF-semantics LRN.
+    Table(input_grads, input_image, output_image)."""
+
+    def __init__(self, depth_radius: int = 5, bias: float = 1.0,
+                 alpha: float = 1.0, beta: float = 0.5, name=None):
+        super().__init__(name)
+        self.depth_radius = int(depth_radius)
+        self.bias = float(bias)
+        self.alpha = float(alpha)
+        self.beta = float(beta)
+
+    def apply(self, params, input, ctx):
+        dout, x = input[1], input[2]
+        return _grad_at(
+            lambda v: _tf_lrn(v, self.depth_radius, self.bias, self.alpha,
+                              self.beta), x, dout)
+
+
+class FusedBatchNormGrad(Operation):
+    """TF `FusedBatchNormGrad(V2)` (loaders/FusedBatchNormGrad.scala):
+    vjp of batch normalization. Table(y_backprop, x, scale,
+    reserve_1=batch mean, reserve_2=batch var) ->
+    Table(dx, dscale, doffset) (+ two empty reserves like TF).
+
+    is_training=True differentiates through the batch statistics (the
+    saved mean/var are recomputed from x inside the vjp, matching TF's
+    training-mode kernel); False treats mean/var as constants."""
+
+    def __init__(self, epsilon: float = 1e-3, is_training: bool = True,
+                 name=None):
+        super().__init__(name)
+        self.epsilon = float(epsilon)
+        self.is_training = bool(is_training)
+
+    def apply(self, params, input, ctx):
+        dy, x, scale = input[1], input[2], input[3]
+        mean, var = input[4], input[5]
+        axes = tuple(range(x.ndim - 1))
+        eps = self.epsilon
+
+        if self.is_training:
+            def fwd(x_, s_, o_):
+                m = jnp.mean(x_, axis=axes)
+                v = jnp.mean(jnp.square(x_ - m), axis=axes)
+                return (x_ - m) * lax.rsqrt(v + eps) * s_ + o_
+        else:
+            def fwd(x_, s_, o_):
+                return (x_ - mean) * lax.rsqrt(var + eps) * s_ + o_
+
+        offset = jnp.zeros_like(scale)
+        _, vjp = jax.vjp(fwd, x, scale, offset)
+        dx, dscale, doffset = vjp(dy)
+        empty = jnp.zeros((0,), x.dtype)
+        return Table(dx, dscale, doffset, empty, empty)
+
+
+class ResizeBilinearGrad(Operation):
+    """TF `ResizeBilinearGrad` (loaders/ResizeBilinearGrad.scala): vjp of
+    bilinear resize back to the original image shape.
+    Table(grads, original_image)."""
+
+    def __init__(self, align_corners: bool = False, name=None):
+        super().__init__(name)
+        self.align_corners = bool(align_corners)
+
+    def apply(self, params, input, ctx):
+        dout, orig = input[1], input[2]
+        out_h, out_w = dout.shape[1], dout.shape[2]
+        from .operation import ResizeBilinearOps
+        inner = ResizeBilinearOps(self.align_corners)
+
+        def fwd(v):
+            return inner.apply({}, Table(
+                v, jnp.asarray([out_h, out_w], jnp.int32)), None)
+
+        return _grad_at(fwd, orig, dout)
+
+
+__all__ = [
+    "ReluGrad", "Relu6Grad", "EluGrad", "SoftplusGrad", "SoftsignGrad",
+    "SigmoidGrad", "TanhGrad", "SqrtGrad", "RsqrtGrad", "InvGrad",
+    "ReciprocalGrad", "BiasAddGrad", "BroadcastGradientArgs",
+    "Conv2DBackpropInput", "Conv2DBackpropFilter", "Conv3DBackpropInput",
+    "Conv3DBackpropFilter", "DepthwiseConv2dNativeBackpropInput",
+    "DepthwiseConv2dNativeBackpropFilter", "Dilation2DBackpropInput",
+    "Dilation2DBackpropFilter", "MaxPoolGrad", "AvgPoolGrad", "LRNGrad",
+    "FusedBatchNormGrad", "ResizeBilinearGrad",
+]

@@ -1,0 +1,230 @@
+"""TF input-pipeline parsing/decoding ops.
+
+Parity: `DL/utils/tf/loaders/{DecodeJpeg,DecodePng,DecodeBmp,DecodeGif,
+DecodeRaw,ParseExample,ParseSingleExample}.scala` backed by
+`DL/nn/tf/ParsingOps.scala` / `ImageOps.scala`. These run host-side on
+numpy object arrays of bytes — exactly where the reference runs them (JVM
+heap, outside the MKL compute path): they sit in input pipelines that
+`TFSession` executes eagerly, feeding decoded batches to the jitted step.
+"""
+
+from __future__ import annotations
+
+import io
+from typing import Optional, Sequence
+
+import numpy as np
+
+from bigdl_tpu.utils.table import Table
+
+from .operation import Operation
+
+_PIL_MODES = {1: "L", 3: "RGB", 4: "RGBA"}
+
+
+def _as_bytes(v) -> bytes:
+    if isinstance(v, (bytes, bytearray)):
+        return bytes(v)
+    if isinstance(v, str):
+        return v.encode("latin-1")
+    arr = np.asarray(v)
+    if arr.ndim == 0:
+        return _as_bytes(arr.item())
+    raise ValueError(f"expected a scalar bytes value, got shape {arr.shape}")
+
+
+class _DecodeImage(Operation):
+    """Common PIL-backed image decode: scalar bytes -> uint8 [H, W, C]."""
+
+    format: Optional[str] = None
+
+    def __init__(self, channels: int = 0, name=None):
+        super().__init__(name)
+        self.channels = int(channels)
+
+    def _decode_one(self, data: bytes) -> np.ndarray:
+        from PIL import Image
+        img = Image.open(io.BytesIO(data))
+        fmt = type(self).format
+        if fmt and (img.format or "").upper() not in (fmt, fmt + "2000"):
+            raise ValueError(
+                f"{type(self).__name__}: payload is "
+                f"{img.format or 'unknown'}, expected {fmt}")
+        if self.channels:
+            img = img.convert(_PIL_MODES[self.channels])
+        arr = np.asarray(img, np.uint8)
+        if arr.ndim == 2:
+            arr = arr[:, :, None]
+        return arr
+
+    def apply(self, params, input, ctx):
+        return self._decode_one(_as_bytes(input))
+
+
+class DecodeJpeg(_DecodeImage):
+    """TF `DecodeJpeg` (loaders/DecodeJpeg.scala). The `ratio` attr
+    (1/2/4/8 downscale-during-decode) is applied after decoding."""
+
+    format = "JPEG"
+
+    def __init__(self, channels: int = 0, ratio: int = 1, name=None):
+        super().__init__(channels, name)
+        self.ratio = int(ratio)
+
+    def apply(self, params, input, ctx):
+        arr = self._decode_one(_as_bytes(input))
+        if self.ratio > 1:
+            arr = arr[::self.ratio, ::self.ratio]
+        return arr
+
+
+class DecodePng(_DecodeImage):
+    """TF `DecodePng` (loaders/DecodePng.scala)."""
+    format = "PNG"
+
+
+class DecodeBmp(_DecodeImage):
+    """TF `DecodeBmp` (loaders/DecodeBmp.scala)."""
+    format = "BMP"
+
+
+class DecodeGif(Operation):
+    """TF `DecodeGif` (loaders/DecodeGif.scala): all frames,
+    uint8 [N, H, W, 3]."""
+
+    def apply(self, params, input, ctx):
+        from PIL import Image, ImageSequence
+        img = Image.open(io.BytesIO(_as_bytes(input)))
+        frames = [np.asarray(f.convert("RGB"), np.uint8)
+                  for f in ImageSequence.Iterator(img)]
+        return np.stack(frames)
+
+
+class DecodeRaw(Operation):
+    """TF `DecodeRaw` (loaders/DecodeRaw.scala): bytes -> fixed-dtype
+    vector; vectorizes over a batch of strings ([...] -> [..., N])."""
+
+    def __init__(self, out_type="float32", little_endian: bool = True,
+                 name=None):
+        super().__init__(name)
+        self.out_type = np.dtype(out_type).name
+        self.little_endian = bool(little_endian)
+
+    def apply(self, params, input, ctx):
+        dt = np.dtype(self.out_type)
+        if not self.little_endian:
+            dt = dt.newbyteorder(">")
+
+        arr = np.asarray(input, object) if not isinstance(
+            input, (bytes, bytearray, str)) else None
+        if arr is None or arr.ndim == 0:
+            return np.frombuffer(_as_bytes(input), dt).astype(
+                np.dtype(self.out_type))
+        if arr.size == 0:  # empty batch (e.g. last partial batch)
+            return np.zeros(arr.shape + (0,), np.dtype(self.out_type))
+        flat = [np.frombuffer(_as_bytes(v), dt) for v in arr.reshape(-1)]
+        n = len(flat[0])
+        if any(len(f) != n for f in flat):
+            raise ValueError("DecodeRaw: ragged byte strings in one batch")
+        out = np.stack(flat).astype(np.dtype(self.out_type))
+        return out.reshape(arr.shape + (n,))
+
+
+_EX_FIELDS = {"float_list": np.float32, "int64_list": np.int64,
+              "bytes_list": object}
+
+
+def _example_feature(ex, key):
+    feat = ex.features.feature
+    if key not in feat:
+        return None
+    f = feat[key]
+    for field, dtype in _EX_FIELDS.items():
+        vals = getattr(f, field).value
+        if len(vals):
+            return np.asarray(list(vals), dtype)
+    return None
+
+
+class ParseExample(Operation):
+    """TF `ParseExample` (loaders/ParseExample.scala → ParsingOps.scala):
+    batch of serialized `tf.Example` protos -> Table of dense tensors.
+
+    Matches the reference's dense-only contract: `n_dense` keys with
+    `dense_types`/`dense_shapes` from the node attrs; input Table is
+    (serialized, names, dense_key_1..N, dense_default_1..N) and defaults
+    fill missing features. Output i has shape [batch, *dense_shapes[i]].
+    """
+
+    def __init__(self, n_dense: int, dense_types: Sequence[str],
+                 dense_shapes: Sequence[Sequence[int]], name=None):
+        super().__init__(name)
+        self.n_dense = int(n_dense)
+        self.dense_types = [np.dtype(t).name for t in dense_types]
+        self.dense_shapes = [tuple(int(d) for d in s) for s in dense_shapes]
+
+    def _parse_batch(self, serialized, keys, defaults):
+        from bigdl_tpu.proto import tf_example_pb2 as epb
+        ser = np.asarray(serialized, object).reshape(-1)
+        cols = [[] for _ in range(self.n_dense)]
+        for rec in ser:
+            ex = epb.Example.FromString(_as_bytes(rec))
+            for i, key in enumerate(keys):
+                vals = _example_feature(ex, key)
+                if vals is None:
+                    vals = np.asarray(defaults[i]).reshape(-1)
+                dt = self.dense_types[i]
+                vals = vals if dt == "object" else vals.astype(dt)
+                cols[i].append(vals.reshape(self.dense_shapes[i]))
+        return [np.stack(c) for c in cols]
+
+    def apply(self, params, input, ctx):
+        serialized = input[1]
+        keys = [str(_as_bytes(np.asarray(input[3 + i]).reshape(-1)[0]),
+                    "utf-8") for i in range(self.n_dense)]
+        defaults = [input[3 + self.n_dense + i]
+                    for i in range(self.n_dense)]
+        out = self._parse_batch(serialized, keys, defaults)
+        return Table(*out)  # TF output is a tuple even for one dense key
+
+
+class ParseSingleExample(Operation):
+    """TF `ParseSingleExample` (loaders/ParseSingleExample.scala): one
+    serialized `tf.Example` -> Table of dense tensors (no batch dim);
+    dense keys live in the node attrs. The op's inputs are
+    (serialized, dense_default_1..N) — a bare serialized scalar is also
+    accepted (defaults then unavailable)."""
+
+    def __init__(self, dense_keys: Sequence[str],
+                 dense_types: Sequence[str],
+                 dense_shapes: Sequence[Sequence[int]], name=None):
+        super().__init__(name)
+        self.dense_keys = [str(k) for k in dense_keys]
+        self.dense_types = [np.dtype(t).name for t in dense_types]
+        self.dense_shapes = [tuple(int(d) for d in s) for s in dense_shapes]
+
+    def apply(self, params, input, ctx):
+        from bigdl_tpu.proto import tf_example_pb2 as epb
+        if isinstance(input, Table):
+            serialized = input[1]
+            defaults = [input[2 + i] if 2 + i in input else None
+                        for i in range(len(self.dense_keys))]
+        else:
+            serialized, defaults = input, [None] * len(self.dense_keys)
+        ex = epb.Example.FromString(_as_bytes(serialized))
+        out = []
+        for i, (key, dt, shape) in enumerate(zip(
+                self.dense_keys, self.dense_types, self.dense_shapes)):
+            vals = _example_feature(ex, key)
+            if vals is None:
+                if defaults[i] is None:
+                    raise ValueError(f"ParseSingleExample: missing feature "
+                                     f"'{key}' and no default")
+                vals = np.asarray(defaults[i]).reshape(-1)
+            vals = vals if dt == "object" else vals.astype(dt)
+            out.append(vals.reshape(shape))
+        return Table(*out)  # TF output is a tuple even for one dense key
+
+
+__all__ = ["DecodeJpeg", "DecodePng", "DecodeBmp", "DecodeGif", "DecodeRaw",
+           "ParseExample", "ParseSingleExample"]

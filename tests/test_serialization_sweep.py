@@ -439,6 +439,116 @@ SPECS.update({
         MAT.copy(), np.array([4, 2], np.int32))),
 })
 
+# TF gradient ops (ops/gradients.py): Table conventions follow the TF op
+# signatures; structural grads need consistent primal/cotangent shapes
+_CONV_DOUT = np.ones((2, 8, 8, 4), np.float32)
+_POOL_DOUT = np.ones((2, 4, 4, 3), np.float32)
+SPECS.update({
+    "ReluGrad": (lambda: ops.ReluGrad(), PAIR),
+    "Relu6Grad": (lambda: ops.Relu6Grad(), PAIR),
+    "EluGrad": (lambda: ops.EluGrad(), PAIR),
+    "SoftplusGrad": (lambda: ops.SoftplusGrad(), PAIR),
+    "SoftsignGrad": (lambda: ops.SoftsignGrad(), PAIR),
+    "SigmoidGrad": (lambda: ops.SigmoidGrad(), PAIR),
+    "TanhGrad": (lambda: ops.TanhGrad(), PAIR),
+    "SqrtGrad": (lambda: ops.SqrtGrad(), Table(POS.copy(), MAT.copy())),
+    "RsqrtGrad": (lambda: ops.RsqrtGrad(), Table(POS.copy(), MAT.copy())),
+    "InvGrad": (lambda: ops.InvGrad(), Table(POS.copy(), MAT.copy())),
+    "ReciprocalGrad": (lambda: ops.ReciprocalGrad(),
+                       Table(POS.copy(), MAT.copy())),
+    "BiasAddGrad": (lambda: ops.BiasAddGrad(), IMG),
+    "BroadcastGradientArgs": (lambda: ops.BroadcastGradientArgs(), Table(
+        np.array([2, 1, 4], np.int32), np.array([4], np.int32))),
+    "Conv2DBackpropInput": (lambda: ops.Conv2DBackpropInput(), Table(
+        np.array([2, 8, 8, 3], np.int32),
+        np.ones((3, 3, 3, 4), np.float32), _CONV_DOUT.copy())),
+    "Conv2DBackpropFilter": (lambda: ops.Conv2DBackpropFilter(), Table(
+        IMG.copy(), np.array([3, 3, 3, 4], np.int32), _CONV_DOUT.copy())),
+    "Conv3DBackpropInput": (lambda: ops.Conv3DBackpropInput(), Table(
+        np.array([2, 4, 8, 8, 3], np.int32),
+        np.ones((2, 2, 2, 3, 4), np.float32),
+        np.ones((2, 4, 8, 8, 4), np.float32))),
+    "Conv3DBackpropFilter": (lambda: ops.Conv3DBackpropFilter(), Table(
+        VID.copy(), np.array([2, 2, 2, 3, 4], np.int32),
+        np.ones((2, 4, 8, 8, 4), np.float32))),
+    "DepthwiseConv2dNativeBackpropInput": (
+        lambda: ops.DepthwiseConv2dNativeBackpropInput(), Table(
+            np.array([2, 8, 8, 3], np.int32),
+            np.ones((3, 3, 3, 2), np.float32),
+            np.ones((2, 8, 8, 6), np.float32))),
+    "DepthwiseConv2dNativeBackpropFilter": (
+        lambda: ops.DepthwiseConv2dNativeBackpropFilter(), Table(
+            IMG.copy(), np.array([3, 3, 3, 2], np.int32),
+            np.ones((2, 8, 8, 6), np.float32))),
+    "Dilation2DBackpropInput": (lambda: ops.Dilation2DBackpropInput(),
+                                Table(IMG.copy(),
+                                      np.ones((2, 2, 3), np.float32),
+                                      np.ones((2, 8, 8, 3), np.float32))),
+    "Dilation2DBackpropFilter": (lambda: ops.Dilation2DBackpropFilter(),
+                                 Table(IMG.copy(),
+                                       np.ones((2, 2, 3), np.float32),
+                                       np.ones((2, 8, 8, 3), np.float32))),
+    "MaxPoolGrad": (lambda: ops.MaxPoolGrad(), Table(
+        IMG.copy(), _POOL_DOUT.copy(), _POOL_DOUT.copy())),
+    "AvgPoolGrad": (lambda: ops.AvgPoolGrad(), Table(
+        np.array([2, 8, 8, 3], np.int32), _POOL_DOUT.copy())),
+    "LRNGrad": (lambda: ops.LRNGrad(2), Table(
+        IMG.copy(), IMG.copy(), IMG.copy())),
+    "FusedBatchNormGrad": (lambda: ops.FusedBatchNormGrad(), Table(
+        IMG.copy(), IMG.copy(), np.ones(3, np.float32),
+        np.zeros(3, np.float32), np.ones(3, np.float32))),
+    "ResizeBilinearGrad": (lambda: ops.ResizeBilinearGrad(), Table(
+        _POOL_DOUT.copy(), IMG.copy())),
+})
+
+# decode/parse ops: host-side bytes in, numpy out. PIL is optional at the
+# package level (ops/parsing.py imports it lazily), so the image-decode
+# specs degrade to justified skips when pillow is absent rather than
+# failing the whole sweep at collection.
+import io as _io
+
+
+def _example_bytes():
+    from bigdl_tpu.interop.tfrecord import float_feature, make_example
+    ex = make_example({"x": float_feature([1.0, 2.0])})
+    return ex.SerializeToString()
+
+
+SPECS.update({
+    "DecodeRaw": (lambda: ops.DecodeRaw("float32"), np.asarray(
+        np.arange(4, dtype=np.float32).tobytes(), object)),
+    "ParseExample": (lambda: ops.ParseExample(1, ["float32"], [[2]]), Table(
+        np.asarray([_example_bytes()], object),
+        np.asarray([b""], object), np.asarray(b"x", object),
+        np.zeros(2, np.float32))),
+    "ParseSingleExample": (
+        lambda: ops.ParseSingleExample(["x"], ["float32"], [[2]]),
+        np.asarray(_example_bytes(), object)),
+})
+
+try:
+    from PIL import Image as _PILImage
+
+    _RAMP = np.linspace(0, 255, 4 * 4, dtype=np.uint8).reshape(4, 4)
+    _RGB = np.stack([_RAMP] * 3, -1)
+
+    def _img_bytes(fmt):
+        buf = _io.BytesIO()
+        _PILImage.fromarray(_RGB).save(buf, format=fmt)
+        return np.asarray(buf.getvalue(), object)
+
+    SPECS.update({
+        "DecodeJpeg": (lambda: ops.DecodeJpeg(channels=3),
+                       _img_bytes("JPEG")),
+        "DecodePng": (lambda: ops.DecodePng(), _img_bytes("PNG")),
+        "DecodeBmp": (lambda: ops.DecodeBmp(), _img_bytes("BMP")),
+        "DecodeGif": (lambda: ops.DecodeGif(), _img_bytes("GIF")),
+    })
+except ImportError:  # pragma: no cover - pillow always present in CI image
+    _PIL_MISSING = True
+else:
+    _PIL_MISSING = False
+
 from bigdl_tpu.interop.caffe import _CaffeFlatten, _CaffeSlice
 SPECS["_CaffeSlice"] = (lambda: _CaffeSlice(-1, 1, 3), MAT)
 SPECS["_CaffeFlatten"] = (lambda: _CaffeFlatten(), IMG)
@@ -495,6 +605,10 @@ SKIP = {
                             "NMS output; exercised in test_detection.py",
     "DetectionOutputSSD": "ditto",
 }
+
+if _PIL_MISSING:  # pragma: no cover
+    for _n in ("DecodeJpeg", "DecodePng", "DecodeBmp", "DecodeGif"):
+        SKIP[_n] = "pillow not installed in this environment"
 
 
 def _registry_entries():

@@ -558,3 +558,370 @@ class TestControlFlowImport:
         assert out == 10.0
         out = float(np.asarray(g.forward(jnp.asarray(42.0))))
         assert out == 42.0
+
+
+class TestGradOps:
+    """Gradient-op loaders (the training-graph half of the 161-file
+    registry): each checked against jax autodiff of the matching forward."""
+
+    def _vjp(self, fwd, primal, dout):
+        import jax
+        _, vjp = jax.vjp(fwd, primal)
+        return np.asarray(vjp(jnp.asarray(dout))[0])
+
+    @pytest.mark.parametrize("op,fwd", [
+        ("ReluGrad", lambda x: jnp.maximum(x, 0.0)),
+        ("Relu6Grad", lambda x: jnp.clip(x, 0.0, 6.0)),
+        ("SoftplusGrad", lambda x: jnp.log1p(jnp.exp(x))),
+        ("SoftsignGrad", lambda x: x / (1 + jnp.abs(x))),
+    ])
+    def test_feature_parameterized(self, op, fwd):
+        # signature (gradients, features)
+        x = RS.randn(3, 4).astype(np.float32) + 0.1
+        dout = RS.randn(3, 4).astype(np.float32)
+        def b(gd):
+            gd.node.add(name="y", op=op, input=["g", "x"])
+        g = _graph(outs=["y"], ins=("g", "x"), build=b)
+        got = np.asarray(g.forward([jnp.asarray(dout), jnp.asarray(x)]))
+        np.testing.assert_allclose(got, self._vjp(fwd, jnp.asarray(x), dout),
+                                   rtol=1e-5, atol=1e-6)
+
+    @pytest.mark.parametrize("op,fwd", [
+        ("SigmoidGrad", lambda x: jax.nn.sigmoid(x)),
+        ("TanhGrad", lambda x: jnp.tanh(x)),
+        ("SqrtGrad", lambda x: jnp.sqrt(x)),
+        ("RsqrtGrad", lambda x: 1.0 / jnp.sqrt(x)),
+        ("InvGrad", lambda x: 1.0 / x),
+        ("ReciprocalGrad", lambda x: 1.0 / x),
+    ])
+    def test_output_parameterized(self, op, fwd):
+        # signature (y, dy) where y = fwd(x)
+        x = np.abs(RS.randn(3, 4).astype(np.float32)) + 0.5
+        y = np.asarray(fwd(jnp.asarray(x)))
+        dout = RS.randn(3, 4).astype(np.float32)
+        def b(gd):
+            gd.node.add(name="g", op=op, input=["y", "dy"])
+        g = _graph(outs=["g"], ins=("y", "dy"), build=b)
+        got = np.asarray(g.forward([jnp.asarray(y), jnp.asarray(dout)]))
+        np.testing.assert_allclose(got, self._vjp(fwd, jnp.asarray(x), dout),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_elu_grad(self):
+        import jax
+        x = RS.randn(3, 4).astype(np.float32)
+        y = np.asarray(jax.nn.elu(jnp.asarray(x)))
+        dout = RS.randn(3, 4).astype(np.float32)
+        def b(gd):
+            gd.node.add(name="g", op="EluGrad", input=["dy", "y"])
+        g = _graph(outs=["g"], ins=("dy", "y"), build=b)
+        got = np.asarray(g.forward([jnp.asarray(dout), jnp.asarray(y)]))
+        np.testing.assert_allclose(
+            got, self._vjp(jax.nn.elu, jnp.asarray(x), dout),
+            rtol=1e-5, atol=1e-6)
+
+    def test_bias_add_grad(self):
+        dout = RS.randn(2, 5, 5, 3).astype(np.float32)
+        def b(gd):
+            gd.node.add(name="g", op="BiasAddGrad", input=["dy"])
+        g = _graph(outs=["g"], ins=("dy",), build=b)
+        got = np.asarray(g.forward(jnp.asarray(dout)))
+        np.testing.assert_allclose(got, dout.sum((0, 1, 2)), rtol=1e-5)
+
+    def test_broadcast_gradient_args(self):
+        def b(gd):
+            _const(gd, "s0", np.asarray([2, 1, 4], np.int32))
+            _const(gd, "s1", np.asarray([4], np.int32))
+            gd.node.add(name="r", op="BroadcastGradientArgs",
+                        input=["s0", "s1"])
+        gd = tpb.GraphDef()
+        b(gd)
+        g = TensorflowLoader.from_graph_def(gd, [], ["r:0", "r:1"])
+        out = g.forward([])
+        # grad wrt [2,1,4] already has the output's shape: no reduction;
+        # grad wrt [4] sums over the two leading broadcast axes
+        np.testing.assert_array_equal(np.asarray(out[1]), [])
+        np.testing.assert_array_equal(np.asarray(out[2]), [0, 1])
+
+    def test_conv2d_backprop_input(self):
+        from jax import lax
+        w = RS.randn(3, 3, 3, 4).astype(np.float32) * 0.1
+        dout = RS.randn(2, 8, 8, 4).astype(np.float32)
+        def fwd(x):
+            return lax.conv_general_dilated(
+                x, jnp.asarray(w), (1, 1), "SAME",
+                dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        def b(gd):
+            _const(gd, "sizes", np.asarray([2, 8, 8, 3], np.int32))
+            _const(gd, "w", w)
+            n = gd.node.add(name="g", op="Conv2DBackpropInput",
+                            input=["sizes", "w", "dy"])
+            n.attr["strides"].list.i.extend([1, 1, 1, 1])
+            n.attr["padding"].s = b"SAME"
+        g = _graph(outs=["g"], ins=("dy",), build=b)
+        got = np.asarray(g.forward(jnp.asarray(dout)))
+        want = self._vjp(fwd, jnp.zeros((2, 8, 8, 3), jnp.float32), dout)
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+    def test_conv2d_backprop_filter(self):
+        from jax import lax
+        x = RS.randn(2, 8, 8, 3).astype(np.float32)
+        dout = RS.randn(2, 8, 8, 4).astype(np.float32)
+        def fwd(w):
+            return lax.conv_general_dilated(
+                jnp.asarray(x), w, (1, 1), "SAME",
+                dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        def b(gd):
+            _const(gd, "sizes", np.asarray([3, 3, 3, 4], np.int32))
+            n = gd.node.add(name="g", op="Conv2DBackpropFilter",
+                            input=["x", "sizes", "dy"])
+            n.attr["strides"].list.i.extend([1, 1, 1, 1])
+            n.attr["padding"].s = b"SAME"
+        g = _graph(outs=["g"], ins=("x", "dy"), build=b)
+        got = np.asarray(g.forward([jnp.asarray(x), jnp.asarray(dout)]))
+        want = self._vjp(fwd, jnp.zeros((3, 3, 3, 4), jnp.float32), dout)
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+    def test_max_pool_grad(self):
+        from jax import lax
+        x = RS.randn(2, 8, 8, 3).astype(np.float32)
+        dout = RS.randn(2, 4, 4, 3).astype(np.float32)
+        def fwd(v):
+            return lax.reduce_window(v, -jnp.inf, lax.max, (1, 2, 2, 1),
+                                     (1, 2, 2, 1), "VALID")
+        y = np.asarray(fwd(jnp.asarray(x)))
+        def b(gd):
+            n = gd.node.add(name="g", op="MaxPoolGrad",
+                            input=["x", "y", "dy"])
+            n.attr["ksize"].list.i.extend([1, 2, 2, 1])
+            n.attr["strides"].list.i.extend([1, 2, 2, 1])
+            n.attr["padding"].s = b"VALID"
+        g = _graph(outs=["g"], ins=("x", "y", "dy"), build=b)
+        got = np.asarray(g.forward([jnp.asarray(x), jnp.asarray(y),
+                                    jnp.asarray(dout)]))
+        np.testing.assert_allclose(got, self._vjp(fwd, jnp.asarray(x), dout),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_avg_pool_grad(self):
+        from jax import lax
+        dout = RS.randn(2, 4, 4, 3).astype(np.float32)
+        def fwd(v):
+            s = lax.reduce_window(v, 0.0, lax.add, (1, 2, 2, 1),
+                                  (1, 2, 2, 1), "VALID")
+            return s / 4.0
+        def b(gd):
+            _const(gd, "sizes", np.asarray([2, 8, 8, 3], np.int32))
+            n = gd.node.add(name="g", op="AvgPoolGrad",
+                            input=["sizes", "dy"])
+            n.attr["ksize"].list.i.extend([1, 2, 2, 1])
+            n.attr["strides"].list.i.extend([1, 2, 2, 1])
+            n.attr["padding"].s = b"VALID"
+        g = _graph(outs=["g"], ins=("dy",), build=b)
+        got = np.asarray(g.forward(jnp.asarray(dout)))
+        want = self._vjp(fwd, jnp.zeros((2, 8, 8, 3), jnp.float32), dout)
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+    def test_fused_batch_norm_grad_training(self):
+        import jax
+        from jax import lax
+        x = RS.randn(2, 4, 4, 3).astype(np.float32)
+        scale = RS.rand(3).astype(np.float32) + 0.5
+        dout = RS.randn(2, 4, 4, 3).astype(np.float32)
+        eps = 1e-3
+        def fwd(x_, s_, o_):
+            m = jnp.mean(x_, axis=(0, 1, 2))
+            v = jnp.mean(jnp.square(x_ - m), axis=(0, 1, 2))
+            return (x_ - m) * lax.rsqrt(v + eps) * s_ + o_
+        _, vjp = jax.vjp(fwd, jnp.asarray(x), jnp.asarray(scale),
+                         jnp.zeros(3, jnp.float32))
+        dx, dscale, doffset = (np.asarray(v) for v in vjp(jnp.asarray(dout)))
+        mean = x.mean((0, 1, 2))
+        var = x.var((0, 1, 2))
+        def b(gd):
+            _const(gd, "scale", scale)
+            _const(gd, "m", mean.astype(np.float32))
+            _const(gd, "v", var.astype(np.float32))
+            n = gd.node.add(name="g", op="FusedBatchNormGrad",
+                            input=["dy", "x", "scale", "m", "v"])
+            n.attr["epsilon"].f = eps
+            n.attr["is_training"].b = True
+        g = _graph(outs=["g:0", "g:1", "g:2"], ins=("dy", "x"), build=b)
+        out = g.forward([jnp.asarray(dout), jnp.asarray(x)])
+        np.testing.assert_allclose(np.asarray(out[1]), dx, rtol=1e-4,
+                                   atol=1e-5)
+        np.testing.assert_allclose(np.asarray(out[2]), dscale, rtol=1e-4,
+                                   atol=1e-4)
+        np.testing.assert_allclose(np.asarray(out[3]), doffset, rtol=1e-4,
+                                   atol=1e-4)
+
+    def test_lrn_grad(self):
+        import jax
+        from bigdl_tpu.ops.gradients import _tf_lrn
+        x = RS.rand(2, 4, 4, 6).astype(np.float32)
+        dout = RS.randn(2, 4, 4, 6).astype(np.float32)
+        def fwd(v):
+            return _tf_lrn(v, 2, 1.0, 1e-4, 0.75)
+        def b(gd):
+            n = gd.node.add(name="g", op="LRNGrad", input=["dy", "x", "y"])
+            n.attr["depth_radius"].i = 2
+            n.attr["bias"].f = 1.0
+            n.attr["alpha"].f = 1e-4
+            n.attr["beta"].f = 0.75
+        g = _graph(outs=["g"], ins=("dy", "x", "y"), build=b)
+        y = np.asarray(fwd(jnp.asarray(x)))
+        got = np.asarray(g.forward([jnp.asarray(dout), jnp.asarray(x),
+                                    jnp.asarray(y)]))
+        np.testing.assert_allclose(got, self._vjp(fwd, jnp.asarray(x), dout),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_resize_bilinear_grad(self):
+        x = RS.randn(2, 8, 8, 3).astype(np.float32)
+        dout = RS.randn(2, 4, 4, 3).astype(np.float32)
+        def fwd(v):
+            return jax.image.resize(v, (2, 4, 4, 3), "bilinear")
+        def b(gd):
+            n = gd.node.add(name="g", op="ResizeBilinearGrad",
+                            input=["dy", "x"])
+            n.attr["align_corners"].b = False
+        g = _graph(outs=["g"], ins=("dy", "x"), build=b)
+        got = np.asarray(g.forward([jnp.asarray(dout), jnp.asarray(x)]))
+        np.testing.assert_allclose(got, self._vjp(fwd, jnp.asarray(x), dout),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_depthwise_backprop_input(self):
+        from jax import lax
+        w = RS.randn(3, 3, 3, 2).astype(np.float32) * 0.1
+        dout = RS.randn(2, 8, 8, 6).astype(np.float32)
+        def fwd(x):
+            wr = jnp.reshape(jnp.asarray(w), (3, 3, 1, 6))
+            return lax.conv_general_dilated(
+                x, wr, (1, 1), "SAME",
+                dimension_numbers=("NHWC", "HWIO", "NHWC"),
+                feature_group_count=3)
+        def b(gd):
+            _const(gd, "sizes", np.asarray([2, 8, 8, 3], np.int32))
+            _const(gd, "w", w)
+            n = gd.node.add(name="g",
+                            op="DepthwiseConv2dNativeBackpropInput",
+                            input=["sizes", "w", "dy"])
+            n.attr["strides"].list.i.extend([1, 1, 1, 1])
+            n.attr["padding"].s = b"SAME"
+        g = _graph(outs=["g"], ins=("dy",), build=b)
+        got = np.asarray(g.forward(jnp.asarray(dout)))
+        want = self._vjp(fwd, jnp.zeros((2, 8, 8, 3), jnp.float32), dout)
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+class TestDecodeParseOps:
+    """Input-pipeline decode/parse loaders (DecodeJpeg/Png/Raw,
+    ParseExample) — host-side ops the reference backs with
+    nn/tf/ParsingOps.scala."""
+
+    def _img_bytes(self, fmt):
+        import io
+        from PIL import Image
+        arr = (RS.rand(5, 7, 3) * 255).astype(np.uint8)
+        buf = io.BytesIO()
+        Image.fromarray(arr).save(buf, format=fmt)
+        return arr, buf.getvalue()
+
+    @pytest.mark.parametrize("fmt,op", [
+        ("PNG", "DecodePng"), ("BMP", "DecodeBmp")])
+    def test_decode_lossless(self, fmt, op):
+        arr, data = self._img_bytes(fmt)
+        def b(gd):
+            gd.node.add(name="img", op=op, input=["contents"])
+        g = _graph(outs=["img"], ins=("contents",), build=b)
+        got = np.asarray(g.forward(np.asarray(data, object)))
+        np.testing.assert_array_equal(got, arr)
+
+    def test_decode_jpeg(self):
+        # smooth ramp: random noise is unrecognizable after lossy JPEG
+        import io
+        from PIL import Image
+        ramp = np.linspace(0, 255, 5 * 7, dtype=np.uint8).reshape(5, 7)
+        arr = np.stack([ramp, ramp, ramp], -1)
+        buf = io.BytesIO()
+        Image.fromarray(arr).save(buf, format="JPEG", quality=95)
+        data = buf.getvalue()
+        def b(gd):
+            n = gd.node.add(name="img", op="DecodeJpeg",
+                            input=["contents"])
+            n.attr["channels"].i = 3
+        g = _graph(outs=["img"], ins=("contents",), build=b)
+        got = np.asarray(g.forward(np.asarray(data, object)))
+        assert got.shape == arr.shape
+        assert np.abs(got.astype(int) - arr.astype(int)).mean() < 16
+
+    def test_decode_gif(self):
+        import io
+        from PIL import Image
+        frames = [(RS.rand(4, 4, 3) * 255).astype(np.uint8)
+                  for _ in range(3)]
+        ims = [Image.fromarray(f).convert("P") for f in frames]
+        buf = io.BytesIO()
+        ims[0].save(buf, format="GIF", save_all=True,
+                    append_images=ims[1:])
+        def b(gd):
+            gd.node.add(name="img", op="DecodeGif", input=["contents"])
+        g = _graph(outs=["img"], ins=("contents",), build=b)
+        got = np.asarray(g.forward(np.asarray(buf.getvalue(), object)))
+        assert got.shape == (3, 4, 4, 3)
+
+    def test_decode_raw(self):
+        from bigdl_tpu.proto import tf_graph_pb2 as _pb
+        vals = RS.randn(6).astype(np.float32)
+        def b(gd):
+            n = gd.node.add(name="out", op="DecodeRaw",
+                            input=["contents"])
+            n.attr["out_type"].type = _pb.DT_FLOAT
+            n.attr["little_endian"].b = True
+        g = _graph(outs=["out"], ins=("contents",), build=b)
+        got = np.asarray(g.forward(np.asarray(vals.tobytes(), object)))
+        np.testing.assert_array_equal(got, vals)
+
+    def test_parse_example(self):
+        from bigdl_tpu.interop.tfrecord import (float_feature, int64_feature,
+                                                make_example)
+        from bigdl_tpu.proto import tf_graph_pb2 as _pb
+        exs = [make_example({"x": float_feature([1.0, 2.0]),
+                             "y": int64_feature([7])}),
+               make_example({"x": float_feature([3.0, 4.0]),
+                             "y": int64_feature([9])})]
+        ser = np.asarray([e.SerializeToString() for e in exs], object)
+        def b(gd):
+            _const(gd, "names", np.asarray([b"", b""], object))
+            _const(gd, "kx", np.asarray(b"x", object))
+            _const(gd, "ky", np.asarray(b"y", object))
+            _const(gd, "dx", np.zeros(2, np.float32))
+            _const(gd, "dy", np.zeros(1, np.int64))
+            n = gd.node.add(name="parsed", op="ParseExample",
+                            input=["serialized", "names", "kx", "ky",
+                                   "dx", "dy"])
+            n.attr["Ndense"].i = 2
+            n.attr["Tdense"].list.type.extend([_pb.DT_FLOAT, _pb.DT_INT64])
+            sx = n.attr["dense_shapes"].list.shape.add()
+            sx.dim.add(size=2)
+            sy = n.attr["dense_shapes"].list.shape.add()
+            sy.dim.add(size=1)
+        g = _graph(outs=["parsed:0", "parsed:1"], ins=("serialized",),
+                   build=b)
+        out = g.forward(ser)
+        np.testing.assert_allclose(np.asarray(out[1]),
+                                   [[1.0, 2.0], [3.0, 4.0]])
+        np.testing.assert_array_equal(np.asarray(out[2]), [[7], [9]])
+
+    def test_parse_single_example(self):
+        from bigdl_tpu.interop.tfrecord import float_feature, make_example
+        from bigdl_tpu.proto import tf_graph_pb2 as _pb
+        ex = make_example({"x": float_feature([5.0, 6.0, 7.0])})
+        def b(gd):
+            n = gd.node.add(name="parsed", op="ParseSingleExample",
+                            input=["serialized"])
+            n.attr["dense_keys"].list.s.append(b"x")
+            n.attr["Tdense"].list.type.extend([_pb.DT_FLOAT])
+            sx = n.attr["dense_shapes"].list.shape.add()
+            sx.dim.add(size=3)
+        g = _graph(outs=["parsed:0"], ins=("serialized",), build=b)
+        got = np.asarray(g.forward(
+            np.asarray(ex.SerializeToString(), object)))
+        np.testing.assert_allclose(got, [5.0, 6.0, 7.0])
