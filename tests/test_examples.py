@@ -87,3 +87,13 @@ class TestExamples:
         from examples.dlframes_pipeline import main
         acc = main(["--max-epoch", "8"])
         assert acc > 0.85
+
+    def test_imageclassification(self):
+        from examples.imageclassification import main
+        acc = main(["--n-images", "60", "--max-epoch", "4"])
+        assert acc > 0.8
+
+    def test_tensorflow_interop(self):
+        from examples.tensorflow_interop import main
+        acc = main(["--max-epoch", "4"])
+        assert acc > 0.7
