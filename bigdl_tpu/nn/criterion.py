@@ -440,17 +440,20 @@ class ClassSimplexCriterion(Criterion):
 
     @staticmethod
     def _build_simplex(n):
+        """n unit vectors with pairwise dot -1/(n-1): the regular simplex
+        (the reference's regsimplex construction,
+        ClassSimplexCriterion.scala)."""
         import numpy as np
-        a = np.zeros((n, n), dtype=np.float32)
+        a = np.zeros((n, n), dtype=np.float64)
         a[0, 0] = 1.0
-        for k in range(1, n - 1):
-            s = float(np.dot(a[k - 1, :k], a[k - 1, :k]))
-            a[k, :k] = a[k - 1, :k]
-            a[k, k] = np.sqrt(max(0.0, 1.0 - s))
-        if n > 1:
-            c = (1.0 + np.sqrt(float(n))) / ((n - 1) ** 1.5)
-            a[n - 1] = -np.sum(a[:n - 1], axis=0) * c
-        return jnp.asarray(a)
+        for i in range(1, n):
+            for j in range(i):
+                s = float(np.dot(a[i, :j], a[j, :j]))
+                a[i, j] = (-1.0 / (n - 1) - s) / a[j, j]
+            if i < n - 1:
+                a[i, i] = np.sqrt(max(0.0, 1.0 - float(
+                    np.dot(a[i, :i], a[i, :i]))))
+        return jnp.asarray(a.astype(np.float32))
 
     def loss(self, output, target):
         t = _class_indices(target, zero_based=False)

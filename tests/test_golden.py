@@ -606,3 +606,252 @@ class TestAttentionGolden:
             torch.tensor(q), torch.tensor(k), torch.tensor(v),
             is_causal=True).numpy()
         np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+# ------------------------------------------- full criterion surface (A.2)
+class TestCriterionGoldenBreadth:
+    """Golden coverage for the rest of the reference's 38-criterion surface
+    (SURVEY.md A.2): torch builtin losses where they exist, otherwise the
+    loss computed independently with torch ops + torch.autograd."""
+
+    def test_margin_matches_torch(self):
+        t = np.where(RS.rand(6, 5) > 0.5, 1.0, -1.0).astype(np.float32)
+        _crit_pair(nn.MarginCriterion(),
+                   lambda o: torch.clamp(1.0 - o * torch.tensor(t),
+                                         min=0.0).mean(), REG_Y, t)
+
+    def test_margin_squared_matches_torch(self):
+        t = np.where(RS.rand(6, 5) > 0.5, 1.0, -1.0).astype(np.float32)
+        _crit_pair(nn.MarginCriterion(squared=True),
+                   lambda o: torch.clamp(1.0 - o * torch.tensor(t),
+                                         min=0.0).pow(2).mean(), REG_Y, t)
+
+    def test_margin_ranking_matches_torch(self):
+        x1 = RS.randn(8).astype(np.float32)
+        x2 = RS.randn(8).astype(np.float32)
+        t = np.where(RS.rand(8) > 0.5, 1.0, -1.0).astype(np.float32)
+        crit = nn.MarginRankingCriterion(margin=0.5)
+        ours = float(crit.forward(T(jnp.asarray(x1), jnp.asarray(x2)),
+                                  jnp.asarray(t)))
+        theirs = F.margin_ranking_loss(torch.tensor(x1), torch.tensor(x2),
+                                       torch.tensor(t), margin=0.5)
+        np.testing.assert_allclose(ours, float(theirs), atol=TOL, rtol=TOL)
+
+    @pytest.mark.parametrize("p", [1, 2])
+    def test_multi_margin_matches_torch(self, p):
+        t64 = torch.tensor((CLASSES1 - 1).astype(np.int64))
+        _crit_pair(nn.MultiMarginCriterion(p=p),
+                   lambda o: F.multi_margin_loss(o, t64, p=p),
+                   LOGITS, CLASSES1)
+
+    def test_multilabel_margin_matches_torch(self):
+        # ours: 1-based ids, 0-padded; torch: 0-based ids, -1-padded
+        tgt = np.array([[2, 4, 0, 0, 0], [1, 0, 0, 0, 0],
+                        [3, 5, 1, 0, 0], [2, 0, 0, 0, 0],
+                        [4, 0, 0, 0, 0], [5, 3, 0, 0, 0]], np.int32)
+        t64 = torch.tensor(tgt.astype(np.int64) - 1)
+        _crit_pair(nn.MultiLabelMarginCriterion(),
+                   lambda o: F.multilabel_margin_loss(o, t64),
+                   LOGITS, tgt.astype(np.float32))
+
+    def test_poisson_matches_torch(self):
+        o = (RS.rand(6, 5).astype(np.float32) + 0.5)
+        t = RS.poisson(2.0, size=(6, 5)).astype(np.float32)
+        _crit_pair(nn.PoissonCriterion(),
+                   lambda out: F.poisson_nll_loss(out, torch.tensor(t),
+                                                  log_input=False,
+                                                  full=False), o, t)
+
+    def test_kld_vae_matches_torch(self):
+        mean = RS.randn(4, 6).astype(np.float32)
+        logvar = RS.randn(4, 6).astype(np.float32) * 0.3
+        crit = nn.KLDCriterion()
+        ours = float(crit.forward(T(jnp.asarray(mean), jnp.asarray(logvar)),
+                                  None))
+        m, lv = torch.tensor(mean), torch.tensor(logvar)
+        theirs = (0.5 * (m * m + lv.exp() - 1.0 - lv).sum(-1)).mean()
+        np.testing.assert_allclose(ours, float(theirs), atol=TOL, rtol=TOL)
+
+    def test_gaussian_matches_torch(self):
+        mean = RS.randn(4, 6).astype(np.float32)
+        logvar = RS.randn(4, 6).astype(np.float32) * 0.3
+        tgt = RS.randn(4, 6).astype(np.float32)
+        crit = nn.GaussianCriterion()
+        ours = float(crit.forward(T(jnp.asarray(mean), jnp.asarray(logvar)),
+                                  jnp.asarray(tgt)))
+        m, lv, t = (torch.tensor(v) for v in (mean, logvar, tgt))
+        theirs = (0.5 * (lv + np.log(2 * np.pi)
+                         + (t - m) ** 2 / lv.exp())).sum()
+        np.testing.assert_allclose(ours, float(theirs), atol=1e-4, rtol=TOL)
+
+    def test_keras_kld_matches_torch(self):
+        o = PROBS / PROBS.sum(1, keepdims=True)
+        t = (RS.rand(6, 5).astype(np.float32) + 0.1)
+        t /= t.sum(1, keepdims=True)
+        _crit_pair(nn.KullbackLeiblerDivergenceCriterion(),
+                   lambda out: (torch.tensor(t).clamp(1e-7, 1.0)
+                                * (torch.tensor(t).clamp(1e-7, 1.0)
+                                   / out.clamp(1e-7, 1.0)).log()
+                                ).sum(-1).mean(), o, t)
+
+    def test_cosine_proximity_matches_torch(self):
+        _crit_pair(nn.CosineProximityCriterion(),
+                   lambda o: -F.cosine_similarity(
+                       o, torch.tensor(REG_T), dim=-1).mean(), REG_Y, REG_T)
+
+    def test_cosine_distance_matches_torch(self):
+        _crit_pair(nn.CosineDistanceCriterion(),
+                   lambda o: (1.0 - F.cosine_similarity(
+                       o, torch.tensor(REG_T), dim=-1)).mean(), REG_Y, REG_T)
+
+    def test_mape_matches_torch(self):
+        t = REG_T + np.sign(REG_T) + 0.5  # keep |target| away from 0
+        _crit_pair(nn.MeanAbsolutePercentageCriterion(),
+                   lambda o: (100.0 * ((torch.tensor(t) - o).abs()
+                                       / torch.tensor(t).abs().clamp(min=1e-7)
+                                       )).mean(), REG_Y, t)
+
+    def test_msle_matches_torch(self):
+        o = np.abs(REG_Y) + 0.1
+        t = np.abs(REG_T) + 0.1
+        _crit_pair(nn.MeanSquaredLogarithmicCriterion(),
+                   lambda out: ((out.clamp(min=1e-7) + 1.0).log()
+                                - (torch.tensor(t).clamp(min=1e-7) + 1.0)
+                                .log()).pow(2).mean(), o, t)
+
+    def test_dice_matches_torch(self):
+        o = PROBS
+        t = BIN_T
+        def torch_dice(out):
+            of = out.reshape(out.shape[0], -1)
+            tf_ = torch.tensor(t).reshape(t.shape[0], -1)
+            inter = (of * tf_).sum(1)
+            dice = (2 * inter + 1.0) / (of.sum(1) + tf_.sum(1) + 1.0)
+            return (1.0 - dice).mean()
+        _crit_pair(nn.DiceCoefficientCriterion(), torch_dice, o, t)
+
+    def test_l1_cost_matches_torch(self):
+        _crit_pair(nn.L1Cost(), lambda o: o.abs().sum(), REG_Y, REG_T)
+
+    def test_l1_penalty_matches_torch(self):
+        _crit_pair(nn.L1Penalty(0.3), lambda o: 0.3 * o.abs().sum(),
+                   REG_Y, REG_T)
+
+    def test_negative_entropy_penalty_matches_torch(self):
+        _crit_pair(nn.NegativeEntropyPenalty(beta=0.01),
+                   lambda o: 0.01 * (o.clamp(1e-12, 1.0)
+                                     * o.clamp(1e-12, 1.0).log()).sum(),
+                   PROBS, REG_T)
+
+    def test_dot_product_matches_torch(self):
+        _crit_pair(nn.DotProductCriterion(),
+                   lambda o: -(o * torch.tensor(REG_T)).sum(), REG_Y, REG_T)
+
+    def test_pg_matches_torch(self):
+        rewards = RS.randn(6, 5).astype(np.float32)
+        _crit_pair(nn.PGCriterion(),
+                   lambda o: -((o + 1e-12).log()
+                               * torch.tensor(rewards)).sum(-1).sum(),
+                   PROBS, rewards)
+
+    def test_softmax_with_criterion_matches_torch(self):
+        # NHWC logits + 1-based labels; VALID normalization with an
+        # ignore label == torch cross_entropy(ignore_index, mean)
+        logits = RS.randn(2, 3, 4, 5).astype(np.float32)
+        labels = RS.randint(1, 6, size=(2, 3, 4)).astype(np.float32)
+        labels[0, 0, 0] = 2.0
+        crit = nn.SoftmaxWithCriterion(ignore_label=2)
+        ours = float(crit.forward(jnp.asarray(logits), jnp.asarray(labels)))
+        t_logits = torch.tensor(np.moveaxis(logits, -1, 1))  # NCHW
+        t_labels = torch.tensor(labels.astype(np.int64) - 1)
+        theirs = F.cross_entropy(t_logits, t_labels, ignore_index=1)
+        np.testing.assert_allclose(ours, float(theirs), atol=TOL, rtol=TOL)
+
+    def test_time_distributed_matches_torch(self):
+        o = RS.randn(3, 4, 6).astype(np.float32)
+        t = RS.randn(3, 4, 6).astype(np.float32)
+        crit = nn.TimeDistributedCriterion(nn.MSECriterion())
+        ours = float(crit.forward(jnp.asarray(o), jnp.asarray(t)))
+        theirs = sum(F.mse_loss(torch.tensor(o[:, k]), torch.tensor(t[:, k]))
+                     for k in range(4))
+        np.testing.assert_allclose(ours, float(theirs), atol=TOL, rtol=TOL)
+
+    def test_time_distributed_mask_matches_torch(self):
+        B, S, C = 3, 5, 7
+        logits = RS.randn(B, S, C).astype(np.float32)
+        logp = np.asarray(jax.nn.log_softmax(jnp.asarray(logits), -1))
+        labels = RS.randint(0, C + 1, size=(B, S)).astype(np.float32)  # 0=pad
+        crit = nn.TimeDistributedMaskCriterion(nn.ClassNLLCriterion())
+        ours = float(crit.forward(jnp.asarray(logp), jnp.asarray(labels)))
+        t_logp = torch.tensor(logp.reshape(-1, C))
+        t_lab = torch.tensor(labels.reshape(-1).astype(np.int64) - 1)
+        theirs = F.nll_loss(t_logp, t_lab.clamp(min=0),
+                            reduction="none")
+        mask = (t_lab >= 0).float()
+        theirs = (theirs * mask).sum() / mask.sum()
+        np.testing.assert_allclose(ours, float(theirs), atol=TOL, rtol=TOL)
+
+    def test_multi_criterion_matches_torch(self):
+        crit = (nn.MultiCriterion().add(nn.MSECriterion(), 0.7)
+                .add(nn.AbsCriterion(), 0.3))
+        _crit_pair(crit,
+                   lambda o: 0.7 * F.mse_loss(o, torch.tensor(REG_T))
+                   + 0.3 * F.l1_loss(o, torch.tensor(REG_T)), REG_Y, REG_T)
+
+    def test_parallel_criterion_matches_torch(self):
+        o1, o2 = REG_Y, LOGP
+        t1 = REG_T
+        t64 = torch.tensor((CLASSES1 - 1).astype(np.int64))
+        crit = (nn.ParallelCriterion().add(nn.MSECriterion(), 0.4)
+                .add(nn.ClassNLLCriterion(), 0.6))
+        ours = float(crit.forward(T(jnp.asarray(o1), jnp.asarray(o2)),
+                                  T(jnp.asarray(t1), jnp.asarray(CLASSES1))))
+        theirs = (0.4 * F.mse_loss(torch.tensor(o1), torch.tensor(t1))
+                  + 0.6 * F.nll_loss(torch.tensor(o2), t64))
+        np.testing.assert_allclose(ours, float(theirs), atol=TOL, rtol=TOL)
+
+    def test_categorical_cross_entropy_matches_torch(self):
+        onehot = np.eye(5, dtype=np.float32)[CLASSES1 - 1]
+        _crit_pair(nn.CategoricalCrossEntropy(),
+                   lambda o: -((torch.tensor(onehot)
+                                * (F.softmax(o, -1) + 1e-8).log())
+                               .sum(-1)).mean(), LOGITS, onehot)
+
+    def test_smoothl1_with_weights_matches_torch(self):
+        o = RS.randn(4, 6).astype(np.float32)
+        t = RS.randn(4, 6).astype(np.float32)
+        inw = (RS.rand(4, 6) > 0.3).astype(np.float32)
+        outw = (RS.rand(4, 6) > 0.3).astype(np.float32)
+        sigma = 2.0
+        crit = nn.SmoothL1CriterionWithWeights(sigma=sigma, num=4)
+        ours = float(crit.forward(jnp.asarray(o),
+                                  T(jnp.asarray(t), jnp.asarray(inw),
+                                    jnp.asarray(outw))))
+        s2 = sigma * sigma
+        d = ((torch.tensor(o) - torch.tensor(t)) * torch.tensor(inw)).abs()
+        l = torch.where(d < 1.0 / s2, 0.5 * s2 * d * d, d - 0.5 / s2)
+        theirs = (l * torch.tensor(outw)).sum() / 4
+        np.testing.assert_allclose(ours, float(theirs), atol=TOL, rtol=TOL)
+
+    def test_l1_hinge_embedding_matches_torch(self):
+        x1 = RS.randn(6, 4).astype(np.float32)
+        x2 = RS.randn(6, 4).astype(np.float32)
+        t = np.where(RS.rand(6) > 0.5, 1.0, -1.0).astype(np.float32)
+        crit = nn.L1HingeEmbeddingCriterion(margin=1.5)
+        ours = float(crit.forward(T(jnp.asarray(x1), jnp.asarray(x2)),
+                                  jnp.asarray(t)))
+        d = (torch.tensor(x1) - torch.tensor(x2)).abs().sum(-1)
+        theirs = torch.where(torch.tensor(t) > 0, d,
+                             torch.clamp(1.5 - d, min=0.0)).mean()
+        np.testing.assert_allclose(ours, float(theirs), atol=TOL, rtol=TOL)
+
+    def test_class_simplex_embeds_regular_simplex(self):
+        # the n simplex vertices must be unit-norm (rows 1..n-1) and
+        # pairwise equidistant — the property the reference construction
+        # guarantees (ClassSimplexCriterion.scala)
+        crit = nn.ClassSimplexCriterion(n_classes=5)
+        s = np.asarray(crit.simplex)
+        assert s.shape == (5, 5)
+        d = np.linalg.norm(s[:, None, :] - s[None, :, :], axis=-1)
+        off = d[~np.eye(5, dtype=bool)]
+        np.testing.assert_allclose(off, off[0], rtol=1e-3)
