@@ -855,3 +855,166 @@ class TestCriterionGoldenBreadth:
         d = np.linalg.norm(s[:, None, :] - s[None, :, :], axis=-1)
         off = d[~np.eye(5, dtype=bool)]
         np.testing.assert_allclose(off, off[0], rtol=1e-3)
+
+
+# ---------------------------------------------- layer-surface breadth (A.1)
+class TestLayerGoldenBreadth:
+    """Golden coverage for the conv-variant / norm / distance layer surface
+    against torch builtins (the reference's TH.scala spec families)."""
+
+    def test_full_convolution_matches_conv_transpose2d(self):
+        m = nn.SpatialFullConvolution(3, 5, 3, 3, dw=2, dh=2, pad_w=1,
+                                      pad_h=1, adj_w=1, adj_h=1)
+        params = m.ensure_params()
+        w = np.asarray(params["weight"])  # [kh, kw, out, in]
+        b = np.asarray(params["bias"])
+        x = RS.randn(2, 7, 7, 3).astype(np.float32)
+        ours, _ = _fwd(m, x)
+        tw = torch.tensor(np.transpose(w, (3, 2, 0, 1)))  # [in, out, kh, kw]
+        theirs = F.conv_transpose2d(
+            torch.tensor(np.transpose(x, (0, 3, 1, 2))), tw,
+            torch.tensor(b), stride=2, padding=1, output_padding=1)
+        np.testing.assert_allclose(
+            ours, np.transpose(theirs.numpy(), (0, 2, 3, 1)),
+            atol=1e-4, rtol=1e-4)
+
+    def test_dilated_convolution_matches_torch(self):
+        m = nn.SpatialDilatedConvolution(3, 4, 3, 3, dilation_w=2,
+                                         dilation_h=2, pad_w=2, pad_h=2)
+        params = m.ensure_params()
+        w = np.asarray(params["weight"])  # [kh, kw, in, out]
+        b = np.asarray(params["bias"])
+        x = RS.randn(2, 9, 9, 3).astype(np.float32)
+        ours, _ = _fwd(m, x)
+        tw = torch.tensor(np.transpose(w, (3, 2, 0, 1)))
+        theirs = F.conv2d(torch.tensor(np.transpose(x, (0, 3, 1, 2))), tw,
+                          torch.tensor(b), padding=2, dilation=2)
+        np.testing.assert_allclose(
+            ours, np.transpose(theirs.numpy(), (0, 2, 3, 1)),
+            atol=1e-4, rtol=1e-4)
+
+    def test_separable_convolution_matches_torch(self):
+        m = nn.SpatialSeparableConvolution(3, 5, 2, 3, 3)
+        params = m.ensure_params()
+        dw = np.asarray(params["depth_weight"])  # [kh, kw, 1, in*mult]
+        pw = np.asarray(params["point_weight"])  # [1, 1, in*mult, out]
+        b = np.asarray(params["bias"])
+        x = RS.randn(2, 8, 8, 3).astype(np.float32)
+        ours, _ = _fwd(m, x)
+        tx = torch.tensor(np.transpose(x, (0, 3, 1, 2)))
+        tdw = torch.tensor(np.transpose(dw, (3, 2, 0, 1)))  # [in*m, 1, kh, kw]
+        y = F.conv2d(tx, tdw, groups=3)
+        tpw = torch.tensor(np.transpose(pw, (3, 2, 0, 1)))
+        y = F.conv2d(y, tpw, torch.tensor(b))
+        np.testing.assert_allclose(
+            ours, np.transpose(y.numpy(), (0, 2, 3, 1)),
+            atol=1e-4, rtol=1e-4)
+
+    def test_temporal_convolution_matches_conv1d(self):
+        m = nn.TemporalConvolution(4, 6, 3, 2)
+        params = m.ensure_params()
+        w = np.asarray(params["weight"])  # [kw, in, out]
+        b = np.asarray(params["bias"])
+        x = RS.randn(2, 9, 4).astype(np.float32)
+        ours, _ = _fwd(m, x)
+        tw = torch.tensor(np.transpose(w, (2, 1, 0)))  # [out, in, kw]
+        theirs = F.conv1d(torch.tensor(np.transpose(x, (0, 2, 1))), tw,
+                          torch.tensor(b), stride=2)
+        np.testing.assert_allclose(
+            ours, np.transpose(theirs.numpy(), (0, 2, 1)),
+            atol=1e-4, rtol=1e-4)
+
+    def test_temporal_maxpool_matches_maxpool1d(self):
+        m = nn.TemporalMaxPooling(3, 2)
+        x = RS.randn(2, 9, 4).astype(np.float32)
+        ours, _ = _fwd(m, x)
+        theirs = F.max_pool1d(torch.tensor(np.transpose(x, (0, 2, 1))),
+                              3, stride=2)
+        np.testing.assert_allclose(
+            ours, np.transpose(theirs.numpy(), (0, 2, 1)),
+            atol=TOL, rtol=TOL)
+
+    def test_volumetric_convolution_matches_conv3d(self):
+        m = nn.VolumetricConvolution(3, 4, 2, 3, 3, dt=2, dw=1, dh=1,
+                                     pad_t=1, pad_w=1, pad_h=1)
+        params = m.ensure_params()
+        w = np.asarray(params["weight"])  # [kt, kh, kw, in, out]
+        b = np.asarray(params["bias"])
+        x = RS.randn(2, 5, 7, 7, 3).astype(np.float32)
+        ours, _ = _fwd(m, x)
+        tw = torch.tensor(np.transpose(w, (4, 3, 0, 1, 2)))
+        theirs = F.conv3d(torch.tensor(np.transpose(x, (0, 4, 1, 2, 3))),
+                          tw, torch.tensor(b), stride=(2, 1, 1),
+                          padding=(1, 1, 1))
+        np.testing.assert_allclose(
+            ours, np.transpose(theirs.numpy(), (0, 2, 3, 4, 1)),
+            atol=1e-4, rtol=1e-4)
+
+    def test_bilinear_matches_torch(self):
+        m = nn.Bilinear(4, 5, 3)
+        params = m.ensure_params()
+        w = np.asarray(params["weight"])  # [out, n1, n2] — torch layout
+        b = np.asarray(params["bias"])
+        x1 = RS.randn(6, 4).astype(np.float32)
+        x2 = RS.randn(6, 5).astype(np.float32)
+        ours = np.asarray(m.forward(T(jnp.asarray(x1), jnp.asarray(x2)),
+                                    training=False))
+        theirs = F.bilinear(torch.tensor(x1), torch.tensor(x2),
+                            torch.tensor(w), torch.tensor(b))
+        np.testing.assert_allclose(ours, theirs.numpy(),
+                                   atol=1e-4, rtol=1e-4)
+
+    def test_lrn_matches_torch(self):
+        m = nn.SpatialCrossMapLRN(5, 1e-4, 0.75, 1.0)
+        x = np.abs(RS.randn(2, 6, 6, 8)).astype(np.float32)
+        ours, _ = _fwd(m, x)
+        theirs = F.local_response_norm(
+            torch.tensor(np.transpose(x, (0, 3, 1, 2))), 5, alpha=1e-4,
+            beta=0.75, k=1.0)
+        np.testing.assert_allclose(
+            ours, np.transpose(theirs.numpy(), (0, 2, 3, 1)),
+            atol=TOL, rtol=1e-4)
+
+    def test_normalize_matches_torch(self):
+        for p in (1.0, 2.0):
+            m = nn.Normalize(p)
+            x = RS.randn(5, 7).astype(np.float32)
+            ours, _ = _fwd(m, x)
+            theirs = F.normalize(torch.tensor(x), p=p, dim=-1)
+            np.testing.assert_allclose(ours, theirs.numpy(),
+                                       atol=TOL, rtol=1e-4)
+
+    def test_pairwise_distance_matches_torch(self):
+        m = nn.PairwiseDistance()
+        x1 = RS.randn(6, 5).astype(np.float32)
+        x2 = RS.randn(6, 5).astype(np.float32)
+        ours = np.asarray(m.forward(T(jnp.asarray(x1), jnp.asarray(x2)),
+                                    training=False))
+        theirs = F.pairwise_distance(torch.tensor(x1), torch.tensor(x2))
+        np.testing.assert_allclose(ours.reshape(-1), theirs.numpy(),
+                                   atol=TOL, rtol=1e-4)
+
+    def test_upsampling2d_matches_interpolate_nearest(self):
+        m = nn.UpSampling2D((2, 3))
+        x = RS.randn(2, 4, 5, 3).astype(np.float32)
+        ours, _ = _fwd(m, x)
+        theirs = F.interpolate(torch.tensor(np.transpose(x, (0, 3, 1, 2))),
+                               scale_factor=(2, 3), mode="nearest")
+        np.testing.assert_allclose(
+            ours, np.transpose(theirs.numpy(), (0, 2, 3, 1)),
+            atol=TOL, rtol=TOL)
+
+    def test_dropout_train_scaling_matches_torch_semantics(self):
+        # torch semantics: train scales kept units by 1/(1-p); eval identity
+        m = nn.Dropout(0.4)
+        x = np.ones((512, 64), np.float32)
+        params = m.ensure_params()
+        out, _ = functional_apply(m, params, jnp.asarray(x), state={},
+                                  training=True,
+                                  rng=jax.random.PRNGKey(7))
+        out = np.asarray(out)
+        kept = out[out > 0]
+        np.testing.assert_allclose(kept, 1.0 / 0.6, rtol=1e-5)
+        assert abs((out > 0).mean() - 0.6) < 0.02
+        eval_out, _ = _fwd(m, x)
+        np.testing.assert_allclose(eval_out, x)

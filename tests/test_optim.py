@@ -255,7 +255,12 @@ class TestEndToEnd:
         o.set_end_when(optim.max_iteration(60))
         trained = o.optimize()
         # loss must have dropped well below the initial ~ln(4)=1.386
-        assert o.optim_method.state["loss"] < 1.0
+        # (converges to ~0.48 after 60 iters; 0.7 keeps noise margin)
+        assert o.optim_method.state["loss"] < 0.7
+        # and the trained model must actually classify the training set
+        out = np.asarray(trained.forward(jnp.asarray(X), training=False))
+        acc = float(((out.argmax(1) + 1) == Y).mean())
+        assert acc > 0.75, acc
 
     def test_distri_matches_local(self):
         """Same seed/data => distributed step == local step numerically."""
