@@ -4,6 +4,9 @@ from bigdl_tpu.models.lenet import LeNet5, lenet_graph
 from bigdl_tpu.models.resnet import ResNet, ResNet50, basic_block, bottleneck
 from bigdl_tpu.models.inception import (Inception_v1,
                                         Inception_v1_NoAuxClassifier,
+                                        Inception_v2,
+                                        Inception_v2_NoAuxClassifier,
+                                        inception_layer_v2,
                                         inception_module)
 from bigdl_tpu.models.vgg import Vgg_16, Vgg_19, VggForCifar10
 from bigdl_tpu.models.rnn import PTBModel, SimpleRNN

@@ -1,8 +1,12 @@
-"""Inception v1 (GoogLeNet).
+"""Inception v1 (GoogLeNet) and v2 (BN-Inception).
 
 Parity: DL/models/inception/Inception_v1.scala — the branchy Concat graph
-(1x1 / 3x3reduce+3x3 / 5x5reduce+5x5 / pool+proj per module), NoAuxLoss
-variant. Channel concat rides the NHWC channel axis.
+(1x1 / 3x3reduce+3x3 / 5x5reduce+5x5 / pool+proj per module), both the
+NoAuxClassifier variant and the training form with the two auxiliary
+classifier heads (outputs concatenated on the class axis, Concat("split1"/
+"split2")); and DL/models/inception/Inception_v2.scala — BN after every
+conv, 5x5 factored into double-3x3, stride-2 reduction modules with
+pass-through pooling branch. Channel concat rides the NHWC channel axis.
 """
 
 from __future__ import annotations
@@ -65,4 +69,220 @@ def Inception_v1_NoAuxClassifier(class_num: int = 1000,
     return m
 
 
-Inception_v1 = Inception_v1_NoAuxClassifier
+def _aux_head(n_in: int, class_num: int, side: int, name: str,
+              has_dropout: bool = True) -> nn.Sequential:
+    """Auxiliary classifier (Inception_v1.scala output1/output2)."""
+    m = (nn.Sequential(name=name)
+         .add(nn.SpatialAveragePooling(5, 5, 3, 3).ceil())
+         .add(_conv(n_in, 128, 1, name=f"{name}conv"))
+         .add(nn.Reshape((128 * side * side,)))
+         .add(nn.Linear(128 * side * side, 1024, name=f"{name}fc"))
+         .add(nn.ReLU()))
+    if has_dropout:
+        m.add(nn.Dropout(0.7))
+    (m.add(nn.Linear(1024, class_num, name=f"{name}classifier"))
+      .add(nn.LogSoftMax()))
+    return m
+
+
+def Inception_v1(class_num: int = 1000,
+                 has_dropout: bool = True) -> nn.Sequential:
+    """Training form with the two auxiliary heads: output is
+    [B, 3*class_num] = concat(main, aux2, aux1) on the class axis
+    (Inception_v1.scala Inception_v1.apply, split1/split2 Concats)."""
+    feature1 = (nn.Sequential(name="feature1")
+                .add(_conv(3, 64, 7, 2, 3, name="conv1/7x7_s2"))
+                .add(nn.SpatialMaxPooling(3, 3, 2, 2).ceil())
+                .add(nn.SpatialCrossMapLRN(5, 0.0001, 0.75))
+                .add(_conv(64, 64, 1, name="conv2/3x3_reduce"))
+                .add(_conv(64, 192, 3, pad=1, name="conv2/3x3"))
+                .add(nn.SpatialCrossMapLRN(5, 0.0001, 0.75))
+                .add(nn.SpatialMaxPooling(3, 3, 2, 2).ceil())
+                .add(inception_module(192, 64, 96, 128, 16, 32, 32,
+                                      "inception_3a/"))
+                .add(inception_module(256, 128, 128, 192, 32, 96, 64,
+                                      "inception_3b/"))
+                .add(nn.SpatialMaxPooling(3, 3, 2, 2).ceil())
+                .add(inception_module(480, 192, 96, 208, 16, 48, 64,
+                                      "inception_4a/")))
+
+    output1 = _aux_head(512, class_num, 4, "loss1/", has_dropout)
+
+    feature2 = (nn.Sequential(name="feature2")
+                .add(inception_module(512, 160, 112, 224, 24, 64, 64,
+                                      "inception_4b/"))
+                .add(inception_module(512, 128, 128, 256, 24, 64, 64,
+                                      "inception_4c/"))
+                .add(inception_module(512, 112, 144, 288, 32, 64, 64,
+                                      "inception_4d/")))
+
+    output2 = _aux_head(528, class_num, 4, "loss2/", has_dropout)
+
+    output3 = (nn.Sequential(name="output3")
+               .add(inception_module(528, 256, 160, 320, 32, 128, 128,
+                                     "inception_4e/"))
+               .add(nn.SpatialMaxPooling(3, 3, 2, 2).ceil())
+               .add(inception_module(832, 256, 160, 320, 32, 128, 128,
+                                     "inception_5a/"))
+               .add(inception_module(832, 384, 192, 384, 48, 128, 128,
+                                     "inception_5b/"))
+               .add(nn.SpatialAveragePooling(7, 7, 1, 1)))
+    if has_dropout:
+        output3.add(nn.Dropout(0.4))
+    (output3.add(nn.Reshape((1024,)))
+            .add(nn.Linear(1024, class_num, name="loss3/classifier"))
+            .add(nn.LogSoftMax()))
+
+    split2 = nn.Concat(axis=1, name="split2").add(output3).add(output2)
+    main_branch = nn.Sequential().add(feature2).add(split2)
+    split1 = nn.Concat(axis=1, name="split1").add(main_branch).add(output1)
+    return (nn.Sequential(name="Inception_v1_aux")
+            .add(feature1).add(split1))
+
+
+# ---------------------------------------------------------------- v2 (BN)
+def _conv_bn(n_in, n_out, k, stride=1, pad=0, name=None):
+    """conv + BN + ReLU (Inception_Layer_v2 building block)."""
+    return (nn.Sequential()
+            .add(nn.SpatialConvolution(n_in, n_out, k, k, stride, stride,
+                                       pad_w=pad, pad_h=pad, name=name))
+            .add(nn.SpatialBatchNormalization(n_out, eps=1e-3,
+                                              name=f"{name}/bn"))
+            .add(nn.ReLU()))
+
+
+def inception_layer_v2(n_in, c1, c3, d3, pool, name=""):
+    """One BN-Inception block (Inception_v2.scala Inception_Layer_v2).
+
+    c1: 1x1 width (0 = no branch); c3: (reduce, out); d3: (reduce, out)
+    double-3x3; pool: (type, proj) with type 'avg'|'max' and proj 0 =
+    stride-2 reduction module (3x3 branches stride 2, bare max pool)."""
+    c3r, c3o = c3
+    d3r, d3o = d3
+    pool_type, pool_proj = pool
+    reduction = pool_type == "max" and pool_proj == 0
+    s = 2 if reduction else 1
+    concat = nn.Concat(axis=3, name=f"{name}output")
+    if c1:
+        concat.add(_conv_bn(n_in, c1, 1, name=f"{name}1x1"))
+    concat.add(nn.Sequential()
+               .add(_conv_bn(n_in, c3r, 1, name=f"{name}3x3_reduce"))
+               .add(_conv_bn(c3r, c3o, 3, stride=s, pad=1,
+                             name=f"{name}3x3")))
+    concat.add(nn.Sequential()
+               .add(_conv_bn(n_in, d3r, 1, name=f"{name}double3x3_reduce"))
+               .add(_conv_bn(d3r, d3o, 3, pad=1, name=f"{name}double3x3a"))
+               .add(_conv_bn(d3o, d3o, 3, stride=s, pad=1,
+                             name=f"{name}double3x3b")))
+    pool_branch = nn.Sequential()
+    if pool_type == "max":
+        if pool_proj:
+            pool_branch.add(nn.SpatialMaxPooling(3, 3, 1, 1, pad_w=1,
+                                                 pad_h=1).ceil())
+        else:
+            pool_branch.add(nn.SpatialMaxPooling(3, 3, 2, 2).ceil())
+    else:
+        pool_branch.add(nn.SpatialAveragePooling(3, 3, 1, 1, pad_w=1,
+                                                 pad_h=1).ceil())
+    if pool_proj:
+        pool_branch.add(_conv_bn(n_in, pool_proj, 1,
+                                 name=f"{name}pool_proj"))
+    concat.add(pool_branch)
+    return concat
+
+
+def _v2_stem() -> nn.Sequential:
+    return (nn.Sequential()
+            .add(_conv_bn(3, 64, 7, 2, 3, name="conv1/7x7_s2"))
+            .add(nn.SpatialMaxPooling(3, 3, 2, 2).ceil())
+            .add(_conv_bn(64, 64, 1, name="conv2/3x3_reduce"))
+            .add(_conv_bn(64, 192, 3, pad=1, name="conv2/3x3"))
+            .add(nn.SpatialMaxPooling(3, 3, 2, 2).ceil()))
+
+
+def Inception_v2_NoAuxClassifier(class_num: int = 1000) -> nn.Sequential:
+    m = _v2_stem()
+    m.name = "Inception_v2"
+    (m.add(inception_layer_v2(192, 64, (64, 64), (64, 96), ("avg", 32),
+                              "inception_3a/"))
+      .add(inception_layer_v2(256, 64, (64, 96), (64, 96), ("avg", 64),
+                              "inception_3b/"))
+      .add(inception_layer_v2(320, 0, (128, 160), (64, 96), ("max", 0),
+                              "inception_3c/"))
+      .add(inception_layer_v2(576, 224, (64, 96), (96, 128), ("avg", 128),
+                              "inception_4a/"))
+      .add(inception_layer_v2(576, 192, (96, 128), (96, 128), ("avg", 128),
+                              "inception_4b/"))
+      .add(inception_layer_v2(576, 160, (128, 160), (128, 160), ("avg", 96),
+                              "inception_4c/"))
+      .add(inception_layer_v2(576, 96, (128, 192), (160, 192), ("avg", 96),
+                              "inception_4d/"))
+      .add(inception_layer_v2(576, 0, (128, 192), (192, 256), ("max", 0),
+                              "inception_4e/"))
+      .add(inception_layer_v2(1024, 352, (192, 320), (160, 224),
+                              ("avg", 128), "inception_5a/"))
+      .add(inception_layer_v2(1024, 352, (192, 320), (192, 224),
+                              ("max", 128), "inception_5b/"))
+      .add(nn.SpatialAveragePooling(7, 7, 1, 1).ceil())
+      .add(nn.Reshape((1024,)))
+      .add(nn.Linear(1024, class_num, name="loss3/classifier"))
+      .add(nn.LogSoftMax()))
+    return m
+
+
+def _v2_aux_head(n_in, class_num, side, name):
+    """BN aux classifier (Inception_v2.scala output1/output2)."""
+    return (nn.Sequential(name=name)
+            .add(nn.SpatialAveragePooling(5, 5, 3, 3).ceil())
+            .add(_conv_bn(n_in, 128, 1, name=f"{name}conv"))
+            .add(nn.Reshape((128 * side * side,)))
+            .add(nn.Linear(128 * side * side, 1024, name=f"{name}fc"))
+            .add(nn.ReLU())
+            .add(nn.Linear(1024, class_num, name=f"{name}classifier"))
+            .add(nn.LogSoftMax()))
+
+
+def Inception_v2(class_num: int = 1000) -> nn.Sequential:
+    """Training form with both BN aux heads: [B, 3*class_num] output
+    (Inception_v2.scala Inception_v2.apply)."""
+    features1 = _v2_stem()
+    features1.name = "features1"
+    (features1
+     .add(inception_layer_v2(192, 64, (64, 64), (64, 96), ("avg", 32),
+                             "inception_3a/"))
+     .add(inception_layer_v2(256, 64, (64, 96), (64, 96), ("avg", 64),
+                             "inception_3b/"))
+     .add(inception_layer_v2(320, 0, (128, 160), (64, 96), ("max", 0),
+                             "inception_3c/")))
+
+    output1 = _v2_aux_head(576, class_num, 4, "loss1/")
+
+    features2 = (nn.Sequential(name="features2")
+                 .add(inception_layer_v2(576, 224, (64, 96), (96, 128),
+                                         ("avg", 128), "inception_4a/"))
+                 .add(inception_layer_v2(576, 192, (96, 128), (96, 128),
+                                         ("avg", 128), "inception_4b/"))
+                 .add(inception_layer_v2(576, 160, (128, 160), (128, 160),
+                                         ("avg", 96), "inception_4c/"))
+                 .add(inception_layer_v2(576, 96, (128, 192), (160, 192),
+                                         ("avg", 96), "inception_4d/"))
+                 .add(inception_layer_v2(576, 0, (128, 192), (192, 256),
+                                         ("max", 0), "inception_4e/")))
+
+    output2 = _v2_aux_head(1024, class_num, 2, "loss2/")
+
+    output3 = (nn.Sequential(name="output3")
+               .add(inception_layer_v2(1024, 352, (192, 320), (160, 224),
+                                       ("avg", 128), "inception_5a/"))
+               .add(inception_layer_v2(1024, 352, (192, 320), (192, 224),
+                                       ("max", 128), "inception_5b/"))
+               .add(nn.SpatialAveragePooling(7, 7, 1, 1).ceil())
+               .add(nn.Reshape((1024,)))
+               .add(nn.Linear(1024, class_num, name="loss3/classifier"))
+               .add(nn.LogSoftMax()))
+
+    split2 = nn.Concat(axis=1, name="split2").add(output3).add(output2)
+    main_branch = nn.Sequential().add(features2).add(split2)
+    split1 = nn.Concat(axis=1, name="split1").add(main_branch).add(output1)
+    return (nn.Sequential(name="Inception_v2_aux")
+            .add(features1).add(split1))

@@ -85,6 +85,11 @@ class SpatialAveragePooling(Module):
         self.divide = divide
         self.data_format = data_format
 
+    def ceil(self):
+        """Fluent ceil-mode toggle (reference .ceil(), also on max pool)."""
+        self.ceil_mode = True
+        return self
+
     def apply(self, params, input, ctx):
         x = input
         if self.data_format == "NCHW":

@@ -39,7 +39,8 @@ class TestShapes:
         assert y.shape == (2, 10)
 
     def test_inception_v1(self):
-        m = Inception_v1(1000)
+        from bigdl_tpu.models import Inception_v1_NoAuxClassifier
+        m = Inception_v1_NoAuxClassifier(1000)
         p = m.init(KEY)
         n = param_count(p)
         # GoogLeNet no-aux ~ 6.6M params (caffe bvlc_googlenet: 6,998,552
@@ -47,6 +48,28 @@ class TestShapes:
         assert 5_000_000 < n < 8_000_000, n
         y = m.forward(jnp.ones((1, 224, 224, 3)), training=False)
         assert y.shape == (1, 1000)
+
+    def test_inception_v1_aux(self):
+        m = Inception_v1(1000)
+        n = param_count(m.init(KEY))
+        # bvlc_googlenet with both aux heads: 6,998,552 params — the two
+        # aux heads add ~3.2M (fc 2048->1024 dominates each)
+        assert 9_000_000 < n < 15_000_000, n
+        y = m.forward(jnp.ones((1, 224, 224, 3)), training=False)
+        assert y.shape == (1, 3000)  # concat(main, aux2, aux1)
+
+    def test_inception_v2(self):
+        from bigdl_tpu.models import (Inception_v2,
+                                      Inception_v2_NoAuxClassifier)
+        m = Inception_v2_NoAuxClassifier(1000)
+        n = param_count(m.init(KEY))
+        # BN-Inception backbone+fc ~ 11.3M (torchvision bninception ~11.3M)
+        assert 9_000_000 < n < 14_000_000, n
+        y = m.forward(jnp.ones((1, 224, 224, 3)), training=False)
+        assert y.shape == (1, 1000)
+        y = Inception_v2(1000).forward(jnp.ones((1, 224, 224, 3)),
+                                       training=False)
+        assert y.shape == (1, 3000)
 
     def test_vgg16(self):
         m = Vgg_16(1000)
