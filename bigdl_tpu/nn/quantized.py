@@ -163,6 +163,13 @@ class QuantizedSpatialDilatedConvolution(QuantizedSpatialConvolution):
     dilation is already a first-class arg on the base class."""
 
 
+def _iter_tree(module):
+    """Yield `module` and every descendant."""
+    yield module
+    for child in getattr(module, "children", ()) or ():
+        yield from _iter_tree(child)
+
+
 class Quantizer:
     """Walk a trained model and swap supported layers for int8 versions
     (reference Quantizer.scala, user surface `module.quantize()`)."""
@@ -171,7 +178,29 @@ class Quantizer:
 
     @staticmethod
     def quantize(module: Module) -> Module:
+        """Returns a NEW quantized module; the caller's fp32 model is left
+        intact (the reference's `Module.quantize` clones before converting,
+        Quantizer.scala — and an in-place swap would silently corrupt any
+        model that keeps training after quantized serving)."""
+        import copy
+        import sys
+
         from bigdl_tpu.nn.containers import Container
+        module.ensure_params()
+        memo = {}
+        n_modules = sum(1 for _ in _iter_tree(module))
+        for m in _iter_tree(module):
+            cache = getattr(m, "_predictor_cache", None)
+            if cache is not None:  # jitted executables — don't copy
+                memo[id(cache)] = None
+        # deepcopy recurses Node.prev chains of Graph models; deep graphs
+        # exceed the default recursion limit
+        prev_limit = sys.getrecursionlimit()
+        sys.setrecursionlimit(max(prev_limit, 10 * n_modules + 1000))
+        try:
+            module = copy.deepcopy(module, memo)
+        finally:
+            sys.setrecursionlimit(prev_limit)
         params = module.ensure_params()
         q = Quantizer._convert(module, params)
         if q is not None:

@@ -97,3 +97,8 @@ class TestExamples:
         from examples.tensorflow_interop import main
         acc = main(["--max-epoch", "4"])
         assert acc > 0.7
+
+    def test_quantized_inference(self):
+        from examples.quantized_inference import main
+        acc = main(["--max-epoch", "4"])
+        assert acc > 0.8

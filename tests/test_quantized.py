@@ -120,3 +120,29 @@ class TestQuantizer:
         loaded = ModuleSerializer.load(path)
         got = np.asarray(loaded.forward(x))
         np.testing.assert_array_equal(want, got)
+
+
+def test_quantize_leaves_original_intact():
+    """Quantizer.quantize must return a NEW model: quantizing for serving
+    and then continuing to train the original is a supported flow (the
+    reference clones before converting)."""
+    import jax.numpy as jnp
+    import numpy as np
+    import bigdl_tpu.nn as nn
+    from bigdl_tpu.nn.quantized import Quantizer
+
+    m = (nn.Sequential().add(nn.Linear(8, 16)).add(nn.ReLU())
+         .add(nn.Sequential().add(nn.Linear(16, 4))))
+    m.ensure_params()
+    before_types = [type(c).__name__ for c in m.children]
+    x = jnp.asarray(np.random.RandomState(0).randn(4, 8).astype(np.float32))
+    want = np.asarray(m.forward(x, training=False))
+
+    q = Quantizer.quantize(m)
+    assert q is not m
+    assert [type(c).__name__ for c in m.children] == before_types
+    assert type(m.children[0]).__name__ == "Linear"
+    assert type(q.children[0]).__name__ == "QuantizedLinear"
+    # original still produces identical fp32 outputs
+    np.testing.assert_array_equal(np.asarray(m.forward(x, training=False)),
+                                  want)
