@@ -3,3 +3,15 @@ from bigdl_tpu.dataset.dataset import DataSet, DistributedDataSet, LocalDataSet
 from bigdl_tpu.dataset.transformer import (SampleToMiniBatch, Transformer,
                                            chain)
 from bigdl_tpu.dataset import image, text
+from bigdl_tpu.dataset.image import (BGRImgCropper, BGRImgNormalizer,
+                                     BGRImgPixelNormalizer, BGRImgRdmCropper,
+                                     BGRImgToBatch, BGRImgToSample,
+                                     BytesToBGRImg, BytesToGreyImg,
+                                     ColorJitter, GreyImgCropper,
+                                     GreyImgNormalizer, GreyImgToBatch,
+                                     GreyImgToSample, HFlip, LabeledBGRImage,
+                                     LabeledGreyImage, Lighting,
+                                     local_image_files)
+from bigdl_tpu.dataset.text import (Dictionary, LabeledSentenceToSample,
+                                    SentenceBiPadding, SentenceSplitter,
+                                    SentenceTokenizer, TextToLabeledSentence)

@@ -260,3 +260,67 @@ def local_image_files(path: str, exts=(".jpg", ".jpeg", ".png", ".bmp")):
             if f.lower().endswith(exts):
                 out.append((os.path.join(path, c, f), float(i + 1)))
     return out
+
+
+class ColorJitter(Transformer):
+    """(ColorJitter.scala) brightness/contrast/saturation jitter applied in
+    random order. Blend math matches the reference: each op blends the
+    image with a companion (zeros / grayscale-mean fill / grayscale) at
+    alpha = 1 + U(-v, v), v = 0.4."""
+
+    def __init__(self, brightness: float = 0.4, contrast: float = 0.4,
+                 saturation: float = 0.4, seed: Optional[int] = None):
+        self.v = {"b": brightness, "c": contrast, "s": saturation}
+        self.rs = np.random.RandomState(seed)
+
+    @staticmethod
+    def _grayscale(img: np.ndarray) -> np.ndarray:
+        g = (img[..., 0] * 0.299 + img[..., 1] * 0.587
+             + img[..., 2] * 0.114)
+        return np.repeat(g[..., None], 3, axis=-1)
+
+    def _blend(self, img, other, variance):
+        alpha = 1.0 + self.rs.uniform(-variance, variance)
+        return img * alpha + (1.0 - alpha) * other
+
+    def _jitter(self, img: np.ndarray) -> np.ndarray:
+        for op in self.rs.permutation(["b", "c", "s"]):
+            if op == "b":
+                img = self._blend(img, np.zeros_like(img), self.v["b"])
+            elif op == "c":
+                gs = self._grayscale(img)
+                img = self._blend(img, np.full_like(img, gs.mean()),
+                                  self.v["c"])
+            else:
+                img = self._blend(img, self._grayscale(img), self.v["s"])
+        return img.astype(np.float32)
+
+    def apply(self, prev: Iterator) -> Iterator:
+        for img in prev:
+            img.content = self._jitter(img.content)
+            yield img
+
+
+class Lighting(Transformer):
+    """(Lighting.scala) AlexNet fancy-PCA lighting noise: per image draw
+    alpha ~ U(0, 0.1) per eigen-channel and add
+    rgb[c] = sum_j eigvec[c, j] * alpha[j] * eigval[j] to channel c."""
+
+    ALPHASTD = 0.1
+    EIGVAL = np.asarray([0.2175, 0.0188, 0.0045], np.float32)
+    EIGVEC = np.asarray([[-0.5675, 0.7192, 0.4009],
+                         [-0.5808, -0.0045, -0.8140],
+                         [-0.5836, -0.6948, 0.4203]], np.float32)
+
+    def __init__(self, seed: Optional[int] = None):
+        self.rs = np.random.RandomState(seed)
+
+    def apply(self, prev: Iterator) -> Iterator:
+        for img in prev:
+            alpha = self.rs.uniform(0, self.ALPHASTD, size=3).astype(
+                np.float32)
+            rgb = (self.EIGVEC * alpha[None, :] * self.EIGVAL[None, :]
+                   ).sum(axis=1)
+            img.content = (img.content + rgb[None, None, :]).astype(
+                np.float32)
+            yield img

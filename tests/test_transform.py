@@ -327,3 +327,39 @@ class TestImageRecords:
             str(tmp_path / "d-*")))))
         assert len(batches) == 2
         assert batches[0].get_input().shape == (3, 8, 8, 3)
+
+
+class TestClassicImageJitter:
+    """dataset/image ColorJitter + Lighting (DL/dataset/image parity)."""
+
+    def test_color_jitter_brightness_only_scales(self):
+        import numpy as np
+        from bigdl_tpu.dataset import ColorJitter, LabeledBGRImage
+        img = LabeledBGRImage(np.full((4, 4, 3), 0.5, np.float32))
+        t = ColorJitter(brightness=0.4, contrast=0.0, saturation=0.0,
+                        seed=3)
+        out = list(t.apply(iter([img])))[0].content
+        # contrast/saturation blends are identity at v=0; brightness scales
+        # uniformly by one alpha in [0.6, 1.4]
+        ratio = out / 0.5
+        assert np.allclose(ratio, ratio[0, 0, 0], atol=1e-6)
+        assert 0.6 - 1e-6 <= ratio[0, 0, 0] <= 1.4 + 1e-6
+
+    def test_color_jitter_deterministic_with_seed(self):
+        import numpy as np
+        from bigdl_tpu.dataset import ColorJitter, LabeledBGRImage
+        x = np.random.RandomState(0).rand(6, 6, 3).astype(np.float32)
+        a = list(ColorJitter(seed=7).apply(iter([LabeledBGRImage(x.copy())])))
+        b = list(ColorJitter(seed=7).apply(iter([LabeledBGRImage(x.copy())])))
+        np.testing.assert_array_equal(a[0].content, b[0].content)
+
+    def test_lighting_adds_constant_rgb_shift(self):
+        import numpy as np
+        from bigdl_tpu.dataset import LabeledBGRImage, Lighting
+        x = np.random.RandomState(1).rand(5, 5, 3).astype(np.float32)
+        out = list(Lighting(seed=2).apply(iter([LabeledBGRImage(x.copy())])))
+        shift = out[0].content - x
+        # the same per-channel shift at every pixel, bounded by
+        # alphastd * max|eigvec*eigval| contributions
+        assert np.ptp(shift.reshape(-1, 3), axis=0).max() < 1e-6
+        assert np.abs(shift).max() < 0.1
