@@ -172,12 +172,48 @@ def bench_lenet(batch_size: int = 512, warmup: int = 3, iters: int = 20,
                                  warmup, iters, resident=resident)
 
 
+def _accel_responsive(timeout_s: float = 150.0) -> bool:
+    """Probe the accelerator in a SUBPROCESS with a hard timeout.
+
+    A tunneled TPU backend can hang (not raise) at the first device touch
+    when the tunnel is unhealthy; probing in-process would hang the whole
+    bench and the round would record nothing. The probe pays the first
+    compile (~20-40s), hence the generous timeout."""
+    import os
+    import subprocess
+    import sys as _sys
+    code = ("import jax, jax.numpy as jnp;"
+            "x = jnp.ones((256, 256));"
+            "(x @ x).block_until_ready();"
+            "print(jax.devices()[0].platform)")
+    try:
+        r = subprocess.run([_sys.executable, "-c", code], timeout=timeout_s,
+                           capture_output=True, text=True,
+                           env=dict(os.environ))
+        return r.returncode == 0 and "cpu" not in r.stdout
+    except subprocess.TimeoutExpired:
+        return False
+
+
 def main():
-    import jax
     logging.getLogger("bigdl_tpu.optim").setLevel(logging.WARNING)
+    accel_ok = _accel_responsive()
+    if not accel_ok:
+        # dead/absent accelerator: pin to CPU BEFORE the first backend
+        # touch so the fallback bench cannot hang on the tunnel
+        import os
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        import jax
+        try:
+            jax.config.update("jax_platforms", "cpu")
+        except Exception:
+            pass
+        print("accelerator unresponsive; falling back to CPU LeNet bench",
+              file=sys.stderr)
+    import jax
     dev = jax.devices()[0]
     n_dev = jax.device_count()
-    on_accel = dev.platform not in ("cpu",)
+    on_accel = accel_ok and dev.platform not in ("cpu",)
     batch_size = 128
     try:
         if not on_accel:
