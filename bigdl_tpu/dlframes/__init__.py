@@ -1,6 +1,11 @@
 from bigdl_tpu.dlframes.dl_estimator import (DLClassifier, DLClassifierModel,
                                              DLEstimator, DLModel)
 from bigdl_tpu.dlframes.dl_image import DLImageReader, DLImageTransformer
+from bigdl_tpu.dlframes.row_transformer import (ColsToNumeric, ColToTensor,
+                                               RowTransformer,
+                                               RowTransformSchema)
 
-__all__ = ["DLEstimator", "DLModel", "DLClassifier", "DLClassifierModel",
+__all__ = ["RowTransformer", "RowTransformSchema", "ColToTensor",
+           "ColsToNumeric",
+           "DLEstimator", "DLModel", "DLClassifier", "DLClassifierModel",
            "DLImageReader", "DLImageTransformer"]

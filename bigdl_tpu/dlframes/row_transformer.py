@@ -1,0 +1,165 @@
+"""Row -> Table feature extraction (datamining RowTransformer).
+
+Parity: `DL/dataset/datamining/RowTransformer.scala` — a container of
+`RowTransformSchema`s, each selecting columns (by field name, else by
+index, else all) and emitting one tensor; the transformer maps a row to a
+`Table` keyed by each schema's `schemaKey`. Rows here are pandas Series,
+dicts, or plain sequences (with `columns` supplied), playing the Spark
+`Row` role in this framework's pandas-based dlframes (declared design
+delta: no Spark).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Iterable, Iterator, List, Optional, Sequence
+
+import numpy as np
+
+from bigdl_tpu.utils.table import Table
+
+
+class RowTransformSchema:
+    """One transforming job: selected columns -> one tensor
+    (RowTransformer.scala RowTransformSchema)."""
+
+    schema_key: str = ""
+    indices: Sequence[int] = ()
+    field_names: Sequence[str] = ()
+
+    def transform(self, values: Sequence[Any],
+                  fields: Sequence[str]) -> np.ndarray:
+        raise NotImplementedError
+
+
+class ColToTensor(RowTransformSchema):
+    """Single column -> size-1 tensor (RowTransformer.scala ColToTensor)."""
+
+    def __init__(self, schema_key: str, field: Optional[str] = None,
+                 index: Optional[int] = None):
+        self.schema_key = schema_key
+        self.field_names = [field] if field is not None else []
+        self.indices = [index] if index is not None else []
+
+    def transform(self, values, fields):
+        v = values[0]
+        if isinstance(v, (str, bytes)):
+            return np.asarray([v], object)
+        return np.asarray([v], np.float32)
+
+
+class ColsToNumeric(RowTransformSchema):
+    """Selected (default: all) numeric columns -> one 1-D tensor
+    (RowTransformer.scala ColsToNumeric)."""
+
+    def __init__(self, schema_key: str,
+                 fields: Sequence[str] = (),
+                 indices: Sequence[int] = ()):
+        self.schema_key = schema_key
+        self.field_names = list(fields)
+        self.indices = list(indices)
+
+    def transform(self, values, fields):
+        return np.asarray([float(v) for v in values], np.float32)
+
+
+class RowTransformer:
+    """Map rows to `Table`s of tensors via a set of schemas.
+
+    Keys of the output Table are the schemas' `schema_key`s; duplicated
+    keys are rejected like the reference (`Found replicated schemaKey`).
+    """
+
+    def __init__(self, schemas: Sequence[RowTransformSchema],
+                 row_size: Optional[int] = None):
+        self.schemas: List[RowTransformSchema] = []
+        seen = set()
+        for s in schemas:
+            if s.schema_key in seen:
+                raise ValueError(f"Found replicated schemaKey: "
+                                 f"{s.schema_key}")
+            seen.add(s.schema_key)
+            if not s.field_names and row_size is not None:
+                if not all(0 <= i < row_size for i in s.indices):
+                    raise ValueError(
+                        f"At least one of indices are out of bound: "
+                        f"{list(s.indices)}")
+            self.schemas.append(s)
+        self.row_size = row_size
+
+    # -- row plumbing --
+    @staticmethod
+    def _fields_and_values(row, columns):
+        if isinstance(row, dict):
+            return list(row.keys()), list(row.values())
+        if isinstance(row, (tuple, list, np.ndarray)):
+            vals = list(row)
+            cols = list(columns) if columns is not None else list(
+                range(len(vals)))
+            return cols, vals
+        # pandas Series (or anything with named index + values arrays)
+        return list(row.index), list(row.values)
+
+    def transform_row(self, row, columns=None) -> Table:
+        fields, values = self._fields_and_values(row, columns)
+        by_name = {f: v for f, v in zip(fields, values)}
+        out = Table()
+        for s in self.schemas:
+            if s.field_names:
+                sel_f = list(s.field_names)
+                missing = [f for f in sel_f if f not in by_name]
+                if missing:
+                    raise KeyError(f"row has no fields {missing}; "
+                                   f"available: {fields}")
+                sel_v = [by_name[f] for f in sel_f]
+            elif s.indices:
+                sel_f = [fields[i] for i in s.indices]
+                sel_v = [values[i] for i in s.indices]
+            else:  # all columns
+                sel_f, sel_v = fields, values
+            out[s.schema_key] = s.transform(sel_v, sel_f)
+        return out
+
+    def apply(self, prev: Iterable, columns=None) -> Iterator[Table]:
+        for row in prev:
+            yield self.transform_row(row, columns)
+
+    def __call__(self, prev, columns=None):
+        return self.apply(prev, columns)
+
+    def apply_frame(self, df) -> List[Table]:
+        """Transform every row of a pandas DataFrame."""
+        return [self.transform_row(row) for _, row in df.iterrows()]
+
+    # -- factory helpers (RowTransformer.scala object methods) --
+    @classmethod
+    def atomic(cls, fields: Sequence[str] = (),
+               indices: Sequence[int] = (),
+               row_size: Optional[int] = None) -> "RowTransformer":
+        """Each selected column becomes its own size-1 tensor keyed by the
+        field name (or index)."""
+        schemas: List[RowTransformSchema] = []
+        for f in fields:
+            schemas.append(ColToTensor(str(f), field=f))
+        for i in indices:
+            schemas.append(ColToTensor(str(i), index=i))
+        return cls(schemas, row_size)
+
+    @classmethod
+    def numeric(cls, numeric_fields=None,
+                schema_key: str = "all") -> "RowTransformer":
+        """All columns into one tensor (`schema_key`), or a dict
+        {key: [fields...]} producing one tensor per key."""
+        if numeric_fields is None:
+            return cls([ColsToNumeric(schema_key)])
+        return cls([ColsToNumeric(k, fields=v)
+                    for k, v in numeric_fields.items()])
+
+    @classmethod
+    def atomic_with_numeric(cls, atomic_fields: Sequence[str],
+                            numeric_fields: Dict[str, Sequence[str]]
+                            ) -> "RowTransformer":
+        schemas: List[RowTransformSchema] = [
+            ColToTensor(str(f), field=f) for f in atomic_fields]
+        schemas += [ColsToNumeric(k, fields=v)
+                    for k, v in numeric_fields.items()]
+        return cls(schemas)

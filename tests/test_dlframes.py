@@ -89,3 +89,51 @@ class TestDLImage:
         assert out.iloc[0]["output"]["width"] == 5
         # original column untouched
         assert out.iloc[0]["image"]["height"] == 12
+
+
+class TestRowTransformer:
+    """DL/dataset/datamining/RowTransformer.scala parity over pandas rows."""
+
+    def _df(self):
+        import pandas as pd
+        return pd.DataFrame({"a": [1.0, 2.0], "b": [3.0, 4.0],
+                             "tag": ["x", "y"]})
+
+    def test_numeric_all(self):
+        import numpy as np
+        from bigdl_tpu.dlframes.row_transformer import RowTransformer
+        t = RowTransformer.numeric()
+        out = t.transform_row({"a": 1.0, "b": 2.5})
+        np.testing.assert_allclose(out["all"], [1.0, 2.5])
+
+    def test_numeric_grouped_and_atomic(self):
+        import numpy as np
+        from bigdl_tpu.dlframes.row_transformer import RowTransformer
+        t = RowTransformer.atomic_with_numeric(
+            ["tag"], {"feats": ["a", "b"]})
+        rows = t.apply_frame(self._df())
+        assert len(rows) == 2
+        np.testing.assert_allclose(rows[1]["feats"], [2.0, 4.0])
+        assert rows[0]["tag"][0] == "x"
+
+    def test_atomic_by_index(self):
+        import numpy as np
+        from bigdl_tpu.dlframes.row_transformer import RowTransformer
+        t = RowTransformer.atomic(indices=[0, 2], row_size=3)
+        out = t.transform_row((7.0, 8.0, 9.0))
+        np.testing.assert_allclose(out["0"], [7.0])
+        np.testing.assert_allclose(out["2"], [9.0])
+
+    def test_duplicate_key_rejected(self):
+        import pytest
+        from bigdl_tpu.dlframes.row_transformer import (ColsToNumeric,
+                                                        RowTransformer)
+        with pytest.raises(ValueError, match="replicated schemaKey"):
+            RowTransformer([ColsToNumeric("k"), ColsToNumeric("k")])
+
+    def test_index_bound_check(self):
+        import pytest
+        from bigdl_tpu.dlframes.row_transformer import (ColsToNumeric,
+                                                        RowTransformer)
+        with pytest.raises(ValueError, match="out of bound"):
+            RowTransformer([ColsToNumeric("k", indices=[5])], row_size=3)
