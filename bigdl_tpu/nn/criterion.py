@@ -13,6 +13,7 @@ from typing import Optional, Sequence
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from bigdl_tpu.utils.table import Table
 
@@ -27,8 +28,31 @@ class Criterion:
     def loss(self, output, target):
         raise NotImplementedError
 
+    # criterions whose target is a class-index / structured tensor rather
+    # than an elementwise companion of the output; they opt out of shape
+    # alignment
+    _target_is_elementwise = True
+
+    def _align_target(self, output, target):
+        """Reshape a same-size target to the output's shape.
+
+        A [B,1] output against a [B] target would silently broadcast to
+        [B,B] in elementwise losses (mean of a meaningless matrix); torch
+        errors on this — we align when the total element counts match and
+        leave everything else to the subclass."""
+        if (self._target_is_elementwise
+                and hasattr(output, "shape") and hasattr(target, "shape")
+                and not isinstance(target, Table)
+                and not isinstance(output, Table)
+                and getattr(target, "ndim", None) is not None
+                and output.shape != target.shape
+                and int(np.prod(output.shape)) ==
+                int(np.prod(target.shape))):
+            return jnp.reshape(target, output.shape)
+        return target
+
     def apply(self, output, target):
-        return self.loss(output, target)
+        return self.loss(output, self._align_target(output, target))
 
     def forward(self, output, target):
         return self.apply(output, target)
@@ -49,6 +73,7 @@ def _class_indices(target, zero_based):
 class ClassNLLCriterion(Criterion):
     """NLL over log-probabilities (pair with LogSoftMax), 1-based targets
     (DL/nn/ClassNLLCriterion.scala). `weights` = per-class rescaling."""
+    _target_is_elementwise = False
 
     def __init__(self, weights=None, size_average: bool = True,
                  logProbAsInput: bool = True, zero_based: bool = False):
@@ -72,6 +97,7 @@ class ClassNLLCriterion(Criterion):
 class CrossEntropyCriterion(Criterion):
     """Softmax + NLL fused (DL/nn/CrossEntropyCriterion.scala); input =
     unnormalized logits."""
+    _target_is_elementwise = False
 
     def __init__(self, weights=None, size_average: bool = True, zero_based: bool = False):
         super().__init__(size_average)
@@ -174,6 +200,7 @@ class MarginRankingCriterion(Criterion):
 class MultiLabelMarginCriterion(Criterion):
     """Multi-class multi-label hinge (DL/nn/MultiLabelMarginCriterion.scala).
     target rows: 1-based label ids, zero-padded."""
+    _target_is_elementwise = False
 
     def loss(self, output, target):
         t = target.astype(jnp.int32) - 1  # [B, C], -1 = pad
@@ -205,6 +232,7 @@ class MultiLabelSoftMarginCriterion(Criterion):
 
 class MultiMarginCriterion(Criterion):
     """Multi-class hinge (DL/nn/MultiMarginCriterion.scala)."""
+    _target_is_elementwise = False
 
     def __init__(self, p: int = 1, weights=None, margin: float = 1.0,
                  size_average: bool = True, zero_based: bool = False):
@@ -367,6 +395,7 @@ class SoftMarginCriterion(Criterion):
 class SoftmaxWithCriterion(Criterion):
     """Caffe-style fused softmax loss with ignore_label
     (DL/nn/SoftmaxWithCriterion.scala); input NHWC logits, target [B,H,W]."""
+    _target_is_elementwise = False
 
     def __init__(self, ignore_label: Optional[int] = None,
                  normalize_mode: str = "VALID", zero_based: bool = False):
@@ -431,6 +460,7 @@ class PGCriterion(Criterion):
 class ClassSimplexCriterion(Criterion):
     """MSE against simplex-embedded class targets
     (DL/nn/ClassSimplexCriterion.scala)."""
+    _target_is_elementwise = False
 
     def __init__(self, n_classes: int):
         super().__init__()
@@ -525,6 +555,7 @@ class TimeDistributedMaskCriterion(Criterion):
     """Masked per-timestep NLL (padding-aware), parity with
     DL/nn/TimeDistributedMaskCriterion.scala. Flattens [B,T] and relies on
     the inner criterion's padding handling via target id 0 => masked."""
+    _target_is_elementwise = False
 
     def __init__(self, critrn: Criterion, padding_value: int = 0):
         super().__init__()
@@ -565,6 +596,7 @@ class CategoricalCrossEntropy(Criterion):
     """Cross entropy against one-hot (or probability) targets over
     softmax-normalized input (DL/nn/CategoricalCrossEntropy.scala — the
     Keras-parity criterion; target is a distribution, not a class index)."""
+    _target_is_elementwise = False
 
     def __init__(self, eps: float = 1e-8):
         super().__init__()

@@ -73,9 +73,18 @@ class Top1Accuracy(ValidationMethod):
         self.zero_based = zero_based
 
     def apply(self, output, target):
-        pred = jnp.argmax(output, axis=-1)
+        out = jnp.asarray(output)
         t = jnp.asarray(target)
-        if t.ndim == jnp.ndim(output) and t.shape[-1] > 1:
+        if out.ndim >= 1 and out.shape[-1] == 1:
+            # single sigmoid unit: threshold at 0.5 and compare to the RAW
+            # 0/1 target — the reference's binary branch
+            # (ValidationMethod.scala:187-188), no 1-based shift
+            pred = (out.reshape((-1,)) >= 0.5).astype(jnp.int32)
+            t = t.astype(jnp.int32).reshape((-1,))
+            correct = jnp.sum((pred == t).astype(jnp.float32))
+            return AccuracyResult(float(correct), t.shape[0])
+        pred = jnp.argmax(out, axis=-1)
+        if t.ndim == jnp.ndim(out) and t.shape[-1] > 1:
             # one-hot / probability targets (Keras categorical labels)
             t = jnp.argmax(t, axis=-1).reshape((-1,))
         else:

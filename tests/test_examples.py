@@ -102,3 +102,8 @@ class TestExamples:
         from examples.quantized_inference import main
         acc = main(["--max-epoch", "4"])
         assert acc > 0.8
+
+    def test_keras_imdb_cnn_lstm(self):
+        from examples.keras_imdb_cnn_lstm import main
+        acc = main(["--n", "300", "--nb-epoch", "6"])
+        assert acc > 0.85  # reaches ~0.95; margin for rng drift

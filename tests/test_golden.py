@@ -1018,3 +1018,34 @@ class TestLayerGoldenBreadth:
         assert abs((out > 0).mean() - 0.6) < 0.02
         eval_out, _ = _fwd(m, x)
         np.testing.assert_allclose(eval_out, x)
+
+
+class TestCriterionTargetAlignment:
+    """[B,1] output vs [B] target must not silently broadcast to [B,B]
+    (torch errors on this; we align shapes when element counts match)."""
+
+    def test_bce_column_output_matches_flat_target(self):
+        o = np.asarray([[0.9], [0.1], [0.8], [0.2]], np.float32)
+        t = np.asarray([1.0, 0.0, 1.0, 0.0], np.float32)
+        ours = float(nn.BCECriterion().forward(jnp.asarray(o),
+                                               jnp.asarray(t)))
+        theirs = F.binary_cross_entropy(torch.tensor(o.reshape(-1)),
+                                        torch.tensor(t))
+        np.testing.assert_allclose(ours, float(theirs), atol=TOL, rtol=TOL)
+
+    def test_mse_column_output_matches_flat_target(self):
+        o = RS.randn(6, 1).astype(np.float32)
+        t = RS.randn(6).astype(np.float32)
+        ours = float(nn.MSECriterion().forward(jnp.asarray(o),
+                                               jnp.asarray(t)))
+        theirs = F.mse_loss(torch.tensor(o.reshape(-1)), torch.tensor(t))
+        np.testing.assert_allclose(ours, float(theirs), atol=TOL, rtol=TOL)
+
+    def test_binary_top1_accuracy_thresholds_sigmoid_unit(self):
+        from bigdl_tpu.optim.validation import Top1Accuracy
+        out = jnp.asarray([[0.9], [0.2], [0.6], [0.4]])
+        tgt = jnp.asarray([1.0, 0.0, 0.0, 0.0])
+        res = Top1Accuracy().apply(out, tgt)
+        v, n = res.result()[0], res.result()[1] if isinstance(
+            res.result(), tuple) else None
+        assert abs(float(v) - 0.75) < 1e-6  # 3 of 4 correct
