@@ -447,3 +447,30 @@ class TestDistriPredictor:
             lambda s: lrs.append(o.optim_method.current_lr()[0]))
         o.optimize()
         assert lrs[-1] < lrs[0], lrs  # 0.1/(1+0.5*neval) decays
+
+
+class TestXorConvergence:
+    """The reference's canonical DistriOptimizerSpec toy: 4-point XOR via
+    MSE regression over a 2-layer MLP converges in local and distributed
+    modes (TEST/optim/DistriOptimizerSpec.scala)."""
+
+    def _xor(self):
+        X = np.asarray([[0, 0], [0, 1], [1, 0], [1, 1]], np.float32)
+        Y = np.asarray([[0.0], [1.0], [1.0], [0.0]], np.float32)
+        # replicate so batches exist
+        return np.tile(X, (64, 1)), np.tile(Y, (64, 1))
+
+    @pytest.mark.parametrize("local", [True, False],
+                             ids=["local", "distri"])
+    def test_xor_mse_converges(self, local):
+        X, Y = self._xor()
+        model = (nn.Sequential().add(nn.Linear(2, 8)).add(nn.Tanh())
+                 .add(nn.Linear(8, 1)).add(nn.Sigmoid()))
+        o = optim.Optimizer(model, (X, Y), nn.MSECriterion(),
+                            batch_size=32, local=local)
+        o.set_optim_method(optim.Adam(learning_rate=0.05))
+        o.set_end_when(optim.max_iteration(120))
+        trained = o.optimize()
+        pred = np.asarray(trained.forward(jnp.asarray(X[:4]),
+                                          training=False)).reshape(-1)
+        np.testing.assert_allclose(pred, [0, 1, 1, 0], atol=0.15)
