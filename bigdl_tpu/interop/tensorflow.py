@@ -826,7 +826,9 @@ class TensorflowSaver:
 
     @staticmethod
     def to_graph_def(model: Module, input_name: str = "input") -> pb.GraphDef:
-        from bigdl_tpu.nn.containers import Sequential
+        from bigdl_tpu.nn.containers import Graph, Sequential
+        if isinstance(model, Graph):
+            return TensorflowSaver._graph_to_graph_def(model, input_name)
         gd = pb.GraphDef()
         ph = gd.node.add(name=input_name, op="Placeholder")
         ph.attr["dtype"].type = pb.DT_FLOAT
@@ -844,6 +846,61 @@ class TensorflowSaver:
         for i, (m, mp) in enumerate(modules):
             prev = TensorflowSaver._emit(gd, m, mp, prev,
                                          f"layer{i}_{m.name}")
+        return gd
+
+    @staticmethod
+    def _graph_to_graph_def(model, input_name: str) -> pb.GraphDef:
+        """Export a branchy `nn.Graph` (reference TensorflowSaver.scala
+        saves Graph models): inputs become Placeholders, each node emits
+        at its node key, and the multi-input table layers map to their TF
+        ops (JoinTable -> ConcatV2, CAddTable -> AddN, CMulTable -> Mul,
+        CSubTable -> Sub)."""
+        import bigdl_tpu.nn as nn
+        gd = pb.GraphDef()
+        params = model.ensure_params()
+        out_ref: Dict[int, str] = {}  # node id -> emitted op name
+        n_inputs = len(model.input_nodes)
+        for i, inode in enumerate(model.input_nodes):
+            name = input_name if n_inputs == 1 else f"{input_name}_{i}"
+            ph = gd.node.add(name=name, op="Placeholder")
+            ph.attr["dtype"].type = pb.DT_FLOAT
+            out_ref[inode.id] = name
+        for node in model.exec_order:
+            if node.id in out_ref:  # an input node
+                continue
+            m = node.module
+            prevs = [out_ref[p.id] for p in node.prev]
+            base = node.key
+            mp = params.get(node.key, {})
+            if isinstance(m, nn.JoinTable):
+                axis = m.axis if m.axis >= 0 else None
+                if axis is None:
+                    raise ValueError(
+                        f"TensorflowSaver: JoinTable with negative axis "
+                        f"({m.axis}) is not exportable")
+                ax = TensorflowSaver._const(
+                    gd, base + "/axis", np.asarray(axis, np.int32))
+                gd.node.add(name=base, op="ConcatV2", input=prevs + [ax])
+                out_ref[node.id] = base
+                continue
+            if isinstance(m, nn.CAddTable):
+                gd.node.add(name=base, op="AddN", input=prevs)
+                out_ref[node.id] = base
+                continue
+            if isinstance(m, nn.CMulTable):
+                gd.node.add(name=base, op="Mul", input=prevs)
+                out_ref[node.id] = base
+                continue
+            if isinstance(m, nn.CSubTable):
+                gd.node.add(name=base, op="Sub", input=prevs)
+                out_ref[node.id] = base
+                continue
+            if len(prevs) != 1:
+                raise ValueError(
+                    f"TensorflowSaver: multi-input layer "
+                    f"{type(m).__name__} at {base} has no TF mapping")
+            out_ref[node.id] = TensorflowSaver._emit(gd, m, mp, prevs[0],
+                                                     base)
         return gd
 
     @staticmethod

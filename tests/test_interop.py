@@ -646,3 +646,58 @@ class TestKerasFunctional:
         np.testing.assert_allclose(
             np.asarray(model.forward(jnp.asarray(x), training=False)),
             x @ W + b, rtol=1e-5, atol=1e-6)
+
+
+class TestGraphExport:
+    """TensorflowSaver over branchy nn.Graph models (the reference's
+    TensorflowSaver.scala saves Graph, not just Sequential)."""
+
+    def test_branchy_graph_round_trip(self, tmp_path):
+        import jax.numpy as jnp
+        import numpy as np
+        import bigdl_tpu.nn as nn
+        from bigdl_tpu.interop.tensorflow import (TensorflowLoader,
+                                                  TensorflowSaver)
+
+        inp = nn.InputNode(name="x")
+        a = nn.Linear(6, 4).inputs(inp)
+        ra = nn.ReLU().inputs(a)
+        b = nn.Linear(6, 4).inputs(inp)
+        j = nn.JoinTable(axis=1).inputs(ra, b)
+        add = nn.CAddTable().inputs(j, j)
+        out = nn.Linear(8, 3).inputs(add)
+        g = nn.Graph([inp], [out])
+        g.ensure_params()
+        x = jnp.asarray(np.random.RandomState(0).randn(5, 6)
+                        .astype(np.float32))
+        want = np.asarray(g.forward(x, training=False))
+        p = str(tmp_path / "g.pb")
+        TensorflowSaver.save(g, p, input_name="x")
+        imported = TensorflowLoader.load(p, ["x"], [out.key])
+        got = np.asarray(imported.forward(x))
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+    def test_multi_input_graph_export(self, tmp_path):
+        import jax.numpy as jnp
+        import numpy as np
+        import bigdl_tpu.nn as nn
+        from bigdl_tpu.interop.tensorflow import (TensorflowLoader,
+                                                  TensorflowSaver)
+        from bigdl_tpu.utils.table import Table
+
+        i1 = nn.InputNode(name="a")
+        i2 = nn.InputNode(name="b")
+        h1 = nn.Linear(4, 3).inputs(i1)
+        h2 = nn.Linear(4, 3).inputs(i2)
+        s = nn.CMulTable().inputs(h1, h2)
+        g = nn.Graph([i1, i2], [s])
+        g.ensure_params()
+        rs = np.random.RandomState(1)
+        xa = jnp.asarray(rs.randn(3, 4).astype(np.float32))
+        xb = jnp.asarray(rs.randn(3, 4).astype(np.float32))
+        want = np.asarray(g.forward(Table(xa, xb), training=False))
+        p = str(tmp_path / "g2.pb")
+        TensorflowSaver.save(g, p, input_name="in")
+        imported = TensorflowLoader.load(p, ["in_0", "in_1"], [s.key])
+        got = np.asarray(imported.forward([xa, xb]))
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
