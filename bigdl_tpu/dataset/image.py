@@ -153,13 +153,19 @@ class BGRImgNormalizer(Transformer):
     from a dataset when given one."""
 
     def __init__(self, mean, std=None):
-        if std is None and not (np.isscalar(mean) or isinstance(mean, (tuple, list))):
-            stacked = np.stack([i.content for i in mean])
-            self.mean = stacked.mean(axis=(0, 1, 2))
-            self.std = stacked.std(axis=(0, 1, 2))
-        else:
-            self.mean = np.asarray(mean, np.float32)
-            self.std = np.asarray(std, np.float32)
+        if std is None and not np.isscalar(mean):
+            # a dataset (any iterable of images, list included): compute
+            # per-channel stats from it, like the reference's
+            # BGRImgNormalizer(dataset) constructor
+            items = list(mean)
+            if items and hasattr(items[0], "content"):
+                stacked = np.stack([i.content for i in items])
+                self.mean = stacked.mean(axis=(0, 1, 2))
+                self.std = stacked.std(axis=(0, 1, 2))
+                return
+            mean = items  # per-channel values with std omitted below
+        self.mean = np.asarray(mean, np.float32)
+        self.std = np.asarray(1.0 if std is None else std, np.float32)
 
     def apply(self, it):
         for img in it:

@@ -1,0 +1,105 @@
+"""VGG on CIFAR-10-style data through the classic BGR pipeline.
+
+Parity: `DL/models/vgg/Train.scala` — trains VggForCifar10 on CIFAR-10
+with the BytesToBGRImg -> BGRImgNormalizer (+HFlip augmentation) pipeline.
+Here the same flow on synthetic CIFAR-shaped data (class = dominant color
+patch), driven through the classic `dataset.image` transformers
+(`BytesToBGRImg`, `BGRImgNormalizer`, `HFlip`, `ColorJitter`) and the
+local optimizer. `--width-mult` shrinks the conv widths so the smoke test
+stays fast on CPU; the default is the full VggForCifar10.
+"""
+
+from __future__ import annotations
+
+import os as _os
+import sys as _sys
+_sys.path.insert(0, _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__))))
+
+import argparse
+
+import numpy as np
+
+
+def synthetic_cifar_bytes(rs, n, n_class=10):
+    """Raw uint8 records [H*W*3] whose class sets a colored quadrant."""
+    recs = []
+    for i in range(n):
+        c = rs.randint(0, n_class)
+        img = rs.randint(0, 120, size=(32, 32, 3)).astype(np.uint8)
+        qy, qx = (c % 4) // 2, (c % 4) % 2
+        chan = c % 3
+        img[16 * qy:16 * qy + 16, 16 * qx:16 * qx + 16, chan] += 120
+        # second marker disambiguates classes sharing quadrant/channel
+        if c >= 4:
+            img[8:24, 8:24, (chan + 1) % 3] += 80
+        recs.append((img.tobytes(), float(c + 1)))
+    return recs
+
+
+def small_vgg(n_class: int, width_mult: float = 1.0):
+    """VggForCifar10 at reduced width for small hosts."""
+    if width_mult >= 1.0:
+        from bigdl_tpu.models.vgg import VggForCifar10
+        return VggForCifar10(n_class)
+    import bigdl_tpu.nn as nn
+    w = lambda c: max(8, int(c * width_mult))
+    m = nn.Sequential(name="vgg_small")
+    n_in = 3
+    for block, convs in ((w(64), 1), (w(128), 1), (w(256), 2)):
+        for _ in range(convs):
+            m.add(nn.SpatialConvolution(n_in, block, 3, 3, pad_w=1,
+                                        pad_h=1))
+            m.add(nn.SpatialBatchNormalization(block))
+            m.add(nn.ReLU())
+            n_in = block
+        m.add(nn.SpatialMaxPooling(2, 2))
+    m.add(nn.Reshape((n_in * 4 * 4,)))
+    m.add(nn.Linear(n_in * 4 * 4, w(512)))
+    m.add(nn.ReLU())
+    m.add(nn.Linear(w(512), n_class))
+    m.add(nn.LogSoftMax())
+    return m
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--n", type=int, default=512)
+    p.add_argument("--classes", type=int, default=10)
+    p.add_argument("--batch-size", type=int, default=64)
+    p.add_argument("--max-epoch", type=int, default=8)
+    p.add_argument("--width-mult", type=float, default=1.0)
+    args = p.parse_args(argv)
+
+    import jax.numpy as jnp
+    import bigdl_tpu.nn as nn
+    import bigdl_tpu.optim as optim
+    from bigdl_tpu.dataset import (BGRImgNormalizer, BytesToBGRImg,
+                                   ColorJitter, HFlip)
+
+    rs = np.random.RandomState(9)
+    recs = synthetic_cifar_bytes(rs, args.n, args.classes)
+
+    # classic chain: bytes -> BGR float image -> normalize -> augment
+    imgs = list(BytesToBGRImg(resize_w=32, resize_h=32).apply(iter(recs)))
+    norm = BGRImgNormalizer(imgs)
+    imgs = list(ColorJitter(0.1, 0.1, 0.1, seed=4).apply(
+        HFlip(0.5, seed=4).apply(norm.apply(iter(imgs)))))
+
+    X = np.stack([im.content for im in imgs]).astype(np.float32)
+    Y = np.asarray([im.label for im in imgs], np.int32)
+
+    model = small_vgg(args.classes, args.width_mult)
+    o = optim.Optimizer(model, (X, Y), nn.ClassNLLCriterion(),
+                        batch_size=args.batch_size, local=True)
+    o.set_optim_method(optim.Adam(learning_rate=2e-3))
+    o.set_end_when(optim.max_epoch(args.max_epoch))
+    o.optimize()
+
+    out = np.asarray(model.forward(jnp.asarray(X), training=False))
+    acc = float(((out.argmax(1) + 1) == Y).mean())
+    print(f"vgg cifar10 train accuracy: {acc:.3f}")
+    return acc
+
+
+if __name__ == "__main__":
+    main()

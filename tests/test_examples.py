@@ -107,3 +107,9 @@ class TestExamples:
         from examples.keras_imdb_cnn_lstm import main
         acc = main(["--n", "300", "--nb-epoch", "6"])
         assert acc > 0.85  # reaches ~0.95; margin for rng drift
+
+    def test_vgg_cifar10(self):
+        from examples.vgg_cifar10 import main
+        acc = main(["--n", "192", "--classes", "6", "--max-epoch", "4",
+                    "--width-mult", "0.25"])
+        assert acc > 0.8
