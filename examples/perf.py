@@ -6,8 +6,10 @@ reference's in-repo perf harness: train the chosen zoo model on synthetic
 data and report the same "Throughput is X records/second" line the training
 loop logs (DistriOptimizer.scala:405-410).
 
-Models: lenet | inception_v1 | vgg16 | vgg19 | resnet50 | ptb.
---distributed shards the step over the full device mesh (all local chips).
+Models: lenet | inception_v1 | inception_v2 | vgg16 | vgg19 | resnet50 |
+ptb — the reference driver's choices (inception_v1/v2, vgg16/19) plus the
+baseline-config models. --distributed shards the step over the full
+device mesh (all local chips).
 """
 
 from __future__ import annotations
@@ -33,6 +35,9 @@ def build(model_name: str, class_num: int = 1000):
         return LeNet5(10), (28, 28), 10
     if model_name == "inception_v1":
         return Inception_v1_NoAuxClassifier(class_num), (224, 224, 3), class_num
+    if model_name == "inception_v2":
+        from bigdl_tpu.models.inception import Inception_v2_NoAuxClassifier
+        return Inception_v2_NoAuxClassifier(class_num), (224, 224, 3), class_num
     if model_name == "vgg16":
         return Vgg_16(class_num), (224, 224, 3), class_num
     if model_name == "vgg19":
