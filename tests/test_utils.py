@@ -103,3 +103,55 @@ class TestProfiling:
             pass
         assert tp.counts["computing time"] == 2
         assert "computing time" in tp.summary()
+
+
+class TestUserErrorProbes:
+    """Misuse paths must fail loudly with actionable messages (and the
+    core contracts must hold): dropout-without-rng, graph cycle, Table
+    through jit, PRNG determinism."""
+
+    def test_dropout_training_without_rng_names_the_fix(self):
+        import jax.numpy as jnp
+        import numpy as np
+        import pytest
+        import bigdl_tpu.nn as nn
+        m = nn.Dropout(0.5)
+        m.ensure_params()
+        with pytest.raises(Exception, match="rng"):
+            m.forward(jnp.ones((4, 4)), training=True)
+
+    def test_graph_cycle_detected(self):
+        import pytest
+        import bigdl_tpu.nn as nn
+        inp = nn.InputNode()
+        a = nn.Identity().inputs(inp)
+        b = nn.Identity().inputs(a)
+        a_node = b.prev[0]
+        a_node.prev.append(b)  # close a cycle
+        with pytest.raises(Exception, match="[Cc]ycle"):
+            nn.Graph([inp], [b])
+
+    def test_table_flows_through_jit(self):
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+        from bigdl_tpu.utils.table import Table
+
+        @jax.jit
+        def f(t):
+            return Table(t[1] + t[2], t[1] * t[2])
+
+        out = f(Table(jnp.asarray([1.0, 2.0]), jnp.asarray([3.0, 4.0])))
+        np.testing.assert_allclose(np.asarray(out[1]), [4.0, 6.0])
+        np.testing.assert_allclose(np.asarray(out[2]), [3.0, 8.0])
+
+    def test_same_key_identical_init(self):
+        import jax
+        import numpy as np
+        import bigdl_tpu.nn as nn
+        m1 = nn.Linear(8, 4)
+        m2 = nn.Linear(8, 4)
+        p1 = m1.init(jax.random.PRNGKey(42))
+        p2 = m2.init(jax.random.PRNGKey(42))
+        np.testing.assert_array_equal(np.asarray(p1["weight"]),
+                                      np.asarray(p2["weight"]))
