@@ -246,6 +246,19 @@ class DistriOptimizer(BaseOptimizer):
                     float(np.mean([v for v in lr if v]) if any(lr) else 0.0)
                     if isinstance(lr, tuple) else lr, it)
                 self.train_summary.add_scalar("Throughput", throughput, it)
+                # Parameters histograms only behind an explicit trigger —
+                # they pull every sharded weight to host
+                # (AbstractOptimizer.scala:47-92)
+                trig = getattr(self.train_summary, "get_summary_trigger",
+                               lambda _n: None)("Parameters")
+                if trig is not None and trig(driver_state):
+                    host = jax.device_get(params)
+                    flat = jax.tree_util.tree_flatten_with_path(host)[0]
+                    for path, leaf in flat:
+                        tag = "/".join(
+                            str(getattr(p, "key", getattr(p, "idx", p)))
+                            for p in path)
+                        self.train_summary.add_histogram(tag, leaf, it)
 
             if driver_state["recordsProcessedThisEpoch"] >= epoch_size:
                 driver_state["epoch"] += 1

@@ -149,3 +149,42 @@ class TestSummaries:
         thr = ts.read_scalar("Throughput")
         ts.close()
         assert len(loss) == 4 and len(thr) == 4
+
+
+def test_distri_parameters_histograms_on_trigger(tmp_path):
+    """DistriOptimizer writes per-layer Parameters histograms when the
+    TrainSummary trigger fires (reference setSummaryTrigger flow)."""
+    import numpy as np
+    import bigdl_tpu.nn as nn
+    import bigdl_tpu.optim as optim
+    from bigdl_tpu.visualization import TrainSummary
+    from bigdl_tpu.visualization.summary import FileReader
+
+    rs = np.random.RandomState(0)
+    X = rs.randn(64, 6).astype(np.float32)
+    Y = (rs.randint(0, 2, size=64) + 1).astype(np.int32)
+    model = (nn.Sequential().add(nn.Linear(6, 4)).add(nn.ReLU())
+             .add(nn.Linear(4, 2)).add(nn.LogSoftMax()))
+    o = optim.Optimizer(model, (X, Y), nn.ClassNLLCriterion(),
+                        batch_size=32, local=False)
+    o.set_optim_method(optim.SGD(learning_rate=0.1))
+    o.set_end_when(optim.max_iteration(4))
+    ts = TrainSummary(str(tmp_path), "app")
+    ts.set_summary_trigger("Parameters", optim.several_iteration(2))
+    o.set_train_summary(ts)
+    o.optimize()
+    ts.close()
+    events = FileReader.list_events(ts.log_dir)
+    assert events
+    from bigdl_tpu.native import NativeTFRecordReader
+    from bigdl_tpu.proto import tb_event_pb2
+    histo_tags = set()
+    for path in events:
+        with NativeTFRecordReader(path) as reader:
+            for record in reader:
+                ev = tb_event_pb2.Event.FromString(record)
+                for v in ev.summary.value:
+                    if v.HasField("histo"):
+                        histo_tags.add(v.tag)
+    # one histogram per parameter leaf (2 Linears x weight+bias)
+    assert len(histo_tags) >= 4, histo_tags
