@@ -1049,3 +1049,41 @@ class TestCriterionTargetAlignment:
         v, n = res.result()[0], res.result()[1] if isinstance(
             res.result(), tuple) else None
         assert abs(float(v) - 0.75) < 1e-6  # 3 of 4 correct
+
+
+class TestPoolingEdgeGolden:
+    """Pooling edge semantics vs torch: ceil mode and pad counting are the
+    classic off-by-one sources (reference pooling specs cover both)."""
+
+    def test_maxpool_ceil_mode_matches_torch(self):
+        m = nn.SpatialMaxPooling(3, 3, 2, 2).ceil()
+        x = RS.randn(2, 7, 7, 3).astype(np.float32)
+        ours, _ = _fwd(m, x)
+        theirs = F.max_pool2d(torch.tensor(np.transpose(x, (0, 3, 1, 2))),
+                              3, stride=2, ceil_mode=True)
+        np.testing.assert_allclose(
+            ours, np.transpose(theirs.numpy(), (0, 2, 3, 1)),
+            atol=TOL, rtol=TOL)
+
+    @pytest.mark.parametrize("include_pad", [True, False])
+    def test_avgpool_pad_counting_matches_torch(self, include_pad):
+        m = nn.SpatialAveragePooling(3, 3, 2, 2, pad_w=1, pad_h=1,
+                                     count_include_pad=include_pad)
+        x = RS.randn(2, 8, 8, 3).astype(np.float32)
+        ours, _ = _fwd(m, x)
+        theirs = F.avg_pool2d(torch.tensor(np.transpose(x, (0, 3, 1, 2))),
+                              3, stride=2, padding=1,
+                              count_include_pad=include_pad)
+        np.testing.assert_allclose(
+            ours, np.transpose(theirs.numpy(), (0, 2, 3, 1)),
+            atol=TOL, rtol=1e-4)
+
+    def test_avgpool_ceil_matches_torch(self):
+        m = nn.SpatialAveragePooling(3, 3, 2, 2).ceil()
+        x = RS.randn(2, 7, 7, 3).astype(np.float32)
+        ours, _ = _fwd(m, x)
+        theirs = F.avg_pool2d(torch.tensor(np.transpose(x, (0, 3, 1, 2))),
+                              3, stride=2, ceil_mode=True)
+        np.testing.assert_allclose(
+            ours, np.transpose(theirs.numpy(), (0, 2, 3, 1)),
+            atol=TOL, rtol=1e-4)
