@@ -171,3 +171,40 @@ class TestShardedCheckpoint:
         # big weight leaf restored SHARDED over the model axis
         w = restored["0_Linear"]["weight"]
         assert not w.sharding.is_fully_replicated
+
+    def test_restore_onto_different_topology(self, tmp_path):
+        """Elastic resume: a checkpoint written from a 4x2 mesh restores
+        onto an 8x1 mesh and onto a smaller 2-device mesh, resharding on
+        read (the reference's counterpart: checkpoints resume across
+        cluster sizes)."""
+        import jax
+        from jax.sharding import NamedSharding
+        from bigdl_tpu.parallel.mesh import build_mesh
+        from bigdl_tpu.parallel.sharding import infer_param_specs
+        from bigdl_tpu.serialization.sharded_checkpoint import (
+            restore_sharded, save_sharded)
+
+        mesh = build_mesh(data=4, model=2)
+        m = nn.Sequential().add(nn.Linear(512, 512)).add(nn.ReLU()) \
+            .add(nn.Linear(512, 8))
+        params = m.ensure_params()
+        specs = infer_param_specs(params, mesh)
+        sharded = jax.tree_util.tree_map(
+            lambda leaf, spec: jax.device_put(leaf,
+                                              NamedSharding(mesh, spec)),
+            params, specs)
+        path = str(tmp_path / "ckpt")
+        save_sharded(path, sharded)
+
+        for new_mesh in (build_mesh(data=8, model=1),
+                         build_mesh(data=1, model=2,
+                                    devices=jax.devices()[:2])):
+            new_specs = infer_param_specs(params, new_mesh)
+            restored = restore_sharded(path, params, mesh=new_mesh,
+                                       specs=new_specs)
+            jax.tree_util.tree_map(
+                lambda a, b: np.testing.assert_array_equal(
+                    np.asarray(a), np.asarray(b)), sharded, restored)
+            w = restored["0_Linear"]["weight"]
+            assert set(w.sharding.mesh.axis_names) == \
+                set(new_mesh.axis_names)
