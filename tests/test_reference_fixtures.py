@@ -82,3 +82,30 @@ class TestCaffeFixture:
         with pytest.raises(ValueError, match="Dummy"):
             CaffeLoader.load(f"{_REF}/caffe/test.prototxt",
                              f"{_REF}/caffe/test.caffemodel")
+
+
+class TestTorchT7Fixtures:
+    """The reference's own .t7 tensor fixtures (test/resources/torch):
+    era-typical Torch7 serialized images must load as [3,224,224] float
+    tensors, and round-trip through our writer."""
+
+    def test_t7_image_tensors_load(self):
+        from bigdl_tpu.interop.torch_file import TorchFile
+        d = os.path.join(_REF, "torch")
+        t7s = sorted(f for f in os.listdir(d) if f.endswith(".t7"))
+        assert t7s, "no .t7 fixtures in the reference checkout"
+        for f in t7s[:3]:
+            arr = TorchFile.load(os.path.join(d, f))
+            assert isinstance(arr, np.ndarray)
+            assert arr.shape == (3, 224, 224), (f, arr.shape)
+            assert np.isfinite(arr).all()
+
+    def test_t7_round_trip_through_writer(self, tmp_path):
+        from bigdl_tpu.interop.torch_file import TorchFile
+        d = os.path.join(_REF, "torch")
+        f = sorted(f for f in os.listdir(d) if f.endswith(".t7"))[0]
+        arr = TorchFile.load(os.path.join(d, f))
+        out = str(tmp_path / "re.t7")
+        TorchFile.save(arr, out)
+        again = TorchFile.load(out)
+        np.testing.assert_array_equal(arr, again)
