@@ -25,8 +25,14 @@ TRAIN_STD = 0.3081078 * 255  # reference lenet normalization constants
 
 
 def _open(path: str):
+    """Open an idx file, gzipped or raw (sniffed by magic — the
+    reference's fixtures ship raw, the download mirrors ship .gz)."""
     if os.path.exists(path):
-        return gzip.open(path, "rb")
+        with open(path, "rb") as probe:
+            magic = probe.read(2)
+        if magic == b"\x1f\x8b":
+            return gzip.open(path, "rb")
+        return open(path, "rb")
     raw = path[:-3]
     if path.endswith(".gz") and os.path.exists(raw):
         return open(raw, "rb")

@@ -109,3 +109,35 @@ class TestTorchT7Fixtures:
         TorchFile.save(arr, out)
         again = TorchFile.load(out)
         np.testing.assert_array_equal(arr, again)
+
+
+class TestImageFixtures:
+    """The reference's mnist idx label file, cifar PNG folders, and
+    imagenet JPEGs load through our readers."""
+
+    def test_mnist_idx_labels_load(self):
+        from bigdl_tpu.dataset.mnist import extract_labels
+        path = os.path.join(_REF, "mnist", "t10k-labels.idx1-ubyte")
+        labels = extract_labels(path)
+        assert labels.ndim == 1 and len(labels) > 0
+        assert set(np.unique(labels)) <= set(range(10))
+
+    def test_cifar_png_folders_load_as_image_frame(self):
+        from bigdl_tpu.transform.vision.image import ImageFrame
+        frame = ImageFrame.read(os.path.join(_REF, "cifar"),
+                                with_label=True)
+        feats = list(frame)
+        assert len(feats) >= 2
+        labels = {f.label for f in feats}
+        assert len(labels) == 2  # airplane, deer
+        for f in feats:
+            assert f.image.ndim == 3 and f.image.shape[2] == 3
+
+    def test_imagenet_jpegs_load(self):
+        from bigdl_tpu.transform.vision.image import ImageFeature
+        d = os.path.join(_REF, "imagenet", "n02110063")
+        jpgs = [f for f in os.listdir(d) if f.lower().endswith(".jpeg")]
+        assert jpgs
+        feat = ImageFeature.read(os.path.join(d, jpgs[0]))
+        assert feat.image.ndim == 3
+        assert feat.height() > 10 and feat.width() > 10
