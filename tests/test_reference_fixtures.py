@@ -141,3 +141,24 @@ class TestImageFixtures:
         feat = ImageFeature.read(os.path.join(d, jpgs[0]))
         assert feat.image.ndim == 3
         assert feat.height() > 10 and feat.width() > 10
+
+    def test_pascal_jpeg_through_detection_transforms(self):
+        """The reference's pascal image through the ROI-style resize +
+        normalize chain (the detection pipeline front half)."""
+        from bigdl_tpu.transform.vision import (ChannelNormalize, MatToTensor,
+                                                Resize)
+        from bigdl_tpu.transform.vision.image import ImageFeature
+        feat = ImageFeature.read(os.path.join(_REF, "pascal", "000025.jpg"))
+        chain = (Resize(300, 300)
+                 >> ChannelNormalize(123.0, 117.0, 104.0)
+                 >> MatToTensor())
+        out = chain(feat)
+        t = out["floats"] if "floats" in out else out.image
+        assert t.shape[0:2] == (300, 300)
+
+    def test_grey_and_gray_images_load(self):
+        from bigdl_tpu.transform.vision.image import ImageFeature
+        g1 = ImageFeature.read(os.path.join(_REF, "grey", "grey.JPEG"))
+        g2 = ImageFeature.read(os.path.join(_REF, "gray", "gray.bmp"))
+        for f in (g1, g2):
+            assert f.image.ndim == 3  # grey decodes to 3-channel BGR
