@@ -263,3 +263,29 @@ class TestCompileFit:
         s = m.summary()
         assert "Total params: " in s
         assert str(8 * 4 + 4 + 4 * 2 + 2) in s
+
+
+class TestMergeModes:
+    """All Merge modes vs direct numpy math (Merge.scala mode table)."""
+
+    @pytest.mark.parametrize("mode,ref", [
+        ("sum", lambda a, b: a + b),
+        ("mul", lambda a, b: a * b),
+        ("max", lambda a, b: np.maximum(a, b)),
+        ("ave", lambda a, b: (a + b) / 2.0),
+        ("dot", lambda a, b: np.sum(a * b, -1, keepdims=True)),
+        ("concat", lambda a, b: np.concatenate([a, b], -1)),
+    ])
+    def test_merge_mode(self, mode, ref):
+        import bigdl_tpu.keras as keras
+        from bigdl_tpu.utils.table import Table
+        rs = np.random.RandomState(0)
+        a = rs.randn(3, 4).astype(np.float32)
+        b = rs.randn(3, 4).astype(np.float32)
+        import jax.numpy as jnp
+        m = keras.Merge(mode=mode, input_shape=[(4,), (4,)])
+        out = np.asarray(m.forward(Table(jnp.asarray(a), jnp.asarray(b)),
+                                   training=False))
+        want = ref(a, b)
+        np.testing.assert_allclose(out.reshape(want.shape), want,
+                                   rtol=1e-5, atol=1e-6)
