@@ -208,3 +208,23 @@ class TestShardedCheckpoint:
             w = restored["0_Linear"]["weight"]
             assert set(w.sharding.mesh.axis_names) == \
                 set(new_mesh.axis_names)
+
+
+class TestFormatCompatibility:
+    """A COMMITTED model file must keep loading in later builds — the
+    reference pins its serializer format the same way
+    (test/resources/serializer golden files). If the format must change,
+    regenerate the fixture in the same commit and say why."""
+
+    def test_golden_model_file_loads_and_matches(self):
+        import os
+        import jax.numpy as jnp
+        from bigdl_tpu.serialization import ModuleSerializer
+        res = os.path.join(os.path.dirname(__file__), "resources")
+        m = ModuleSerializer.load(
+            os.path.join(res, "golden_model_v1.bigdl"))
+        x = np.linspace(-1, 1, 2 * 8 * 8 * 3).reshape(2, 8, 8, 3) \
+            .astype(np.float32)
+        out = np.asarray(m.forward(jnp.asarray(x), training=False))
+        want = np.load(os.path.join(res, "golden_model_v1_out.npy"))
+        np.testing.assert_allclose(out, want, rtol=1e-5, atol=1e-6)
