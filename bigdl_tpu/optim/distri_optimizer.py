@@ -68,6 +68,8 @@ class DistriOptimizer(BaseOptimizer):
         self.retry_interval_s = retry_interval_s
         self._step = None
         self._param_shardings = None
+        self._pristine_params = None
+        self._pristine_state = None
 
     # ------------------------------------------------------------------ #
     @property
@@ -150,12 +152,23 @@ class DistriOptimizer(BaseOptimizer):
                     restore_optim_method(self.optim_method, oblob)
                     # resume Adam moments / SGD velocity, not just counters
                     self._resume_slots = oblob.get("slots")
+                elif self._pristine_params is not None:
+                    # crashed before the first checkpoint: the jitted step
+                    # DONATED the model's device arrays, so they are dead —
+                    # restart from the pristine host snapshot instead of
+                    # failing again with "Array has been deleted"
+                    self.model.set_params(self._pristine_params)
+                    self.model._state = self._pristine_state
                 time.sleep(self.retry_interval_s)
 
     def _optimize_impl(self) -> Module:
         mesh = self.mesh
         params = self.model.ensure_params()
         model_state = self.model._state
+        # host snapshot for pre-first-checkpoint crash recovery (the step
+        # donates the placed arrays, so a failed attempt kills them)
+        self._pristine_params = jax.device_get(params)
+        self._pristine_state = jax.device_get(model_state)
         params, model_state = self._place(params, model_state, None)
         resume_slots = getattr(self, "_resume_slots", None)
         if resume_slots is not None:

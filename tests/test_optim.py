@@ -474,3 +474,58 @@ class TestXorConvergence:
         pred = np.asarray(trained.forward(jnp.asarray(X[:4]),
                                           training=False)).reshape(-1)
         np.testing.assert_allclose(pred, [0, 1, 1, 0], atol=0.15)
+
+
+class TestFailureRecovery:
+    """Fault injection for the retry-from-checkpoint path (SURVEY §5.3,
+    reference counterpart: driver re-submission from the latest snapshot).
+    A mid-training crash must resume from the newest checkpoint and
+    complete to the end trigger."""
+
+    def test_crash_resumes_from_checkpoint(self, tmp_path):
+        X = np.random.RandomState(0).randn(128, 6).astype(np.float32)
+        Y = (np.random.RandomState(1).randint(0, 2, size=128) + 1) \
+            .astype(np.int32)
+        model = (nn.Sequential().add(nn.Linear(6, 8)).add(nn.ReLU())
+                 .add(nn.Linear(8, 2)).add(nn.LogSoftMax()))
+        o = optim.Optimizer(model, (X, Y), nn.ClassNLLCriterion(),
+                            batch_size=32, local=False)
+        o.set_optim_method(optim.Adam(learning_rate=1e-2))
+        o.set_end_when(optim.max_iteration(10))
+        o.set_checkpoint(str(tmp_path / "ckpt"), optim.several_iteration(2))
+        o.retry_interval_s = 0.01
+
+        crashed = {"done": False}
+
+        def hook(state):
+            if state["neval"] == 5 and not crashed["done"]:
+                crashed["done"] = True
+                raise RuntimeError("injected fault at iteration 5")
+
+        o.set_iteration_hook(hook)
+        trained = o.optimize()
+        assert crashed["done"], "fault was never injected"
+        # completed to the end trigger after the retry
+        assert o.optim_method.state["neval"] >= 10
+        out = np.asarray(trained.forward(jnp.asarray(X), training=False))
+        assert np.isfinite(out).all()
+
+    def test_retries_exhausted_reraises(self, tmp_path):
+        X = np.random.RandomState(0).randn(64, 4).astype(np.float32)
+        Y = (np.random.RandomState(1).randint(0, 2, size=64) + 1) \
+            .astype(np.int32)
+        model = nn.Sequential().add(nn.Linear(4, 2)).add(nn.LogSoftMax())
+        o = optim.Optimizer(model, (X, Y), nn.ClassNLLCriterion(),
+                            batch_size=32, local=False)
+        o.set_optim_method(optim.SGD(learning_rate=0.1))
+        o.set_end_when(optim.max_iteration(6))
+        o.set_checkpoint(str(tmp_path / "ckpt"), optim.several_iteration(2))
+        o.retry_times = 2
+        o.retry_interval_s = 0.01
+
+        def hook(state):  # permanent fault
+            raise RuntimeError("persistent failure")
+
+        o.set_iteration_hook(hook)
+        with pytest.raises(RuntimeError, match="persistent failure"):
+            o.optimize()
