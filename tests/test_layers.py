@@ -374,3 +374,34 @@ class TestReviewRegressions:
         y = m.forward(jnp.array([[1, 2, 3]]))
         assert float(jnp.sum(jnp.abs(y[0, 1]))) == 0.0
         assert float(jnp.sum(jnp.abs(y[0, 0]))) > 0.0
+
+
+class TestDataFormatParity:
+    """NCHW data_format must equal the NHWC path on transposed input
+    (reference layers accept both formats)."""
+
+    @pytest.mark.parametrize("mk", [
+        lambda df: nn.SpatialConvolution(3, 4, 3, 3, pad_w=1, pad_h=1,
+                                         data_format=df),
+        lambda df: nn.SpatialFullConvolution(3, 4, 3, 3, data_format=df),
+        lambda df: nn.SpatialSeparableConvolution(3, 6, 2, 3, 3,
+                                                  data_format=df),
+        lambda df: nn.SpatialMaxPooling(2, 2, 2, 2, data_format=df),
+        lambda df: nn.SpatialAveragePooling(2, 2, 2, 2, data_format=df),
+        lambda df: nn.SpatialBatchNormalization(3, data_format=df),
+    ], ids=["conv", "deconv", "sepconv", "maxpool", "avgpool", "bn"])
+    def test_nchw_matches_nhwc(self, mk):
+        rs = np.random.RandomState(0)
+        x = rs.randn(2, 8, 8, 3).astype(np.float32)
+        xc = np.transpose(x, (0, 3, 1, 2))
+        m1 = mk("NHWC")
+        p = m1.init(jax.random.PRNGKey(0))
+        m1.set_params(p)
+        m1._state = m1.state_init()
+        m2 = mk("NCHW")
+        m2.set_params(p)
+        m2._state = m2.state_init()
+        o1 = np.asarray(m1.forward(jnp.asarray(x), training=False))
+        o2 = np.asarray(m2.forward(jnp.asarray(xc), training=False))
+        np.testing.assert_allclose(np.transpose(o2, (0, 2, 3, 1)), o1,
+                                   rtol=1e-4, atol=1e-5)
