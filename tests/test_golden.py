@@ -1087,3 +1087,33 @@ class TestPoolingEdgeGolden:
         np.testing.assert_allclose(
             ours, np.transpose(theirs.numpy(), (0, 2, 3, 1)),
             atol=TOL, rtol=1e-4)
+
+
+class TestBidirectionalGolden:
+    """BiRecurrent(LSTMCell) vs torch.nn.LSTM(bidirectional=True): the
+    concat merge of forward and time-reversed passes must match torch's
+    bidirectional output ordering [fwd | bwd]."""
+
+    def test_bilstm_matches_torch(self):
+        B, T, I, H = 3, 5, 4, 6
+        m = nn.BiRecurrent(nn.LSTMCell(I, H), merge="concat")
+        # BiRecurrent's inner Recurrents default to return_sequences
+        m.fwd.return_sequences = True
+        m.bwd.return_sequences = True
+        params = m.init(jax.random.PRNGKey(0))
+        x = np.random.RandomState(0).randn(B, T, I).astype(np.float32)
+        got = np.asarray(functional_apply(m, params, jnp.asarray(x))[0])
+
+        tl = torch.nn.LSTM(I, H, batch_first=True, bidirectional=True)
+        with torch.no_grad():
+            for tag, side in (("l0", "fwd"), ("l0_reverse", "bwd")):
+                cp = params[side]["cell"]
+                getattr(tl, f"weight_ih_{tag}").copy_(
+                    torch.tensor(np.asarray(cp["wi"]).T))
+                getattr(tl, f"weight_hh_{tag}").copy_(
+                    torch.tensor(np.asarray(cp["wh"]).T))
+                getattr(tl, f"bias_ih_{tag}").copy_(
+                    torch.tensor(np.asarray(cp["bias"])))
+                getattr(tl, f"bias_hh_{tag}").zero_()
+        want = tl(torch.tensor(x))[0].detach().numpy()
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
