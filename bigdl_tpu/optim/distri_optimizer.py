@@ -104,16 +104,45 @@ class DistriOptimizer(BaseOptimizer):
         optim = self.optim_method
         clip = self._clip_grads_expr
         precision_scope = self._precision_scope
+        accum = int(getattr(self, "grad_accum_steps", 1) or 1)
 
-        def step(params, opt_state, model_state, x, y, lr, rng):
+        def loss_and_grads(params, model_state, x, y, rng):
             def loss_fn(p):
                 with precision_scope():
                     out, new_ms = functional_apply(model, p, x,
                                                    state=model_state,
                                                    training=True, rng=rng)
                     return criterion.apply(out, y), new_ms
+            return jax.value_and_grad(loss_fn, has_aux=True)(params)
 
-            (loss, new_ms), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+        def step(params, opt_state, model_state, x, y, lr, rng):
+            if accum > 1:
+                # gradient accumulation: split the batch into `accum`
+                # micro-batches and lax.scan the grad computation, so peak
+                # activation memory shrinks by ~accum while the weight
+                # update sees the FULL batch gradient (mean over micros).
+                def micro(xy):
+                    return jnp.reshape(
+                        xy, (accum, xy.shape[0] // accum) + xy.shape[1:])
+
+                def body(carry, mb):
+                    g_acc, l_acc, ms = carry
+                    mx, my, mrng = mb
+                    (l, new_ms), g = loss_and_grads(params, ms, mx, my,
+                                                    mrng)
+                    g_acc = jax.tree_util.tree_map(jnp.add, g_acc, g)
+                    return (g_acc, l_acc + l, new_ms), None
+
+                zeros = jax.tree_util.tree_map(jnp.zeros_like, params)
+                rngs = jax.random.split(rng, accum)
+                (g_sum, l_sum, new_ms), _ = jax.lax.scan(
+                    body, (zeros, 0.0, model_state),
+                    (micro(x), micro(y), rngs))
+                grads = jax.tree_util.tree_map(lambda g: g / accum, g_sum)
+                loss = l_sum / accum
+            else:
+                (loss, new_ms), grads = loss_and_grads(params, model_state,
+                                                       x, y, rng)
             grads = clip(grads)
             new_params, new_opt = optim.update(grads, opt_state, params, lr)
             return new_params, new_opt, new_ms, loss

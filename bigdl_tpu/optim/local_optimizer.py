@@ -65,8 +65,19 @@ class BaseOptimizer:
         self.rng = jax.random.PRNGKey(0)
         self.matmul_precision: Optional[str] = None
         self.iteration_hook: Optional[Callable[[Dict], None]] = None
+        self.grad_accum_steps: int = 1
 
     # fluent setters (Optimizer.scala:93-452)
+    def set_gradient_accumulation(self, steps: int):
+        """Split each batch into `steps` micro-batches inside the jitted
+        step (lax.scan), accumulating gradients before one weight update —
+        peak activation memory drops ~steps-fold for the same effective
+        batch (beyond-parity TPU feature; batch size must divide evenly)."""
+        if steps < 1:
+            raise ValueError(f"steps must be >= 1, got {steps}")
+        self.grad_accum_steps = int(steps)
+        return self
+
     def set_optim_method(self, method: OptimMethod):
         self.optim_method = method
         return self

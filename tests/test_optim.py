@@ -529,3 +529,42 @@ class TestFailureRecovery:
         o.set_iteration_hook(hook)
         with pytest.raises(RuntimeError, match="persistent failure"):
             o.optimize()
+
+
+class TestGradientAccumulation:
+    """set_gradient_accumulation(n): n micro-batches inside the jitted
+    step must produce EXACTLY the full-batch update for mean losses
+    (BN-free model), while the loop/logging contract is unchanged."""
+
+    def _run(self, accum):
+        rs = np.random.RandomState(0)
+        X = rs.randn(128, 6).astype(np.float32)
+        Y = (rs.randint(0, 2, size=128) + 1).astype(np.int32)
+        model = (nn.Sequential().add(nn.Linear(6, 8)).add(nn.Tanh())
+                 .add(nn.Linear(8, 2)).add(nn.LogSoftMax()))
+        # identical init across runs
+        model._params = model.init(jax.random.PRNGKey(5))
+        o = optim.Optimizer(model, (X, Y), nn.ClassNLLCriterion(),
+                            batch_size=32, local=False)
+        o.set_optim_method(optim.SGD(learning_rate=0.1))
+        o.set_end_when(optim.max_iteration(4))
+        if accum > 1:
+            o.set_gradient_accumulation(accum)
+        trained = o.optimize()
+        return jax.device_get(trained.ensure_params())
+
+    def test_accumulated_matches_full_batch(self):
+        p1 = self._run(1)
+        p4 = self._run(4)
+        jax.tree_util.tree_map(
+            lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-5,
+                                                    atol=1e-6), p1, p4)
+
+    def test_rejects_bad_steps(self):
+        model = nn.Sequential().add(nn.Linear(2, 2))
+        o = optim.Optimizer(model, (np.zeros((4, 2), np.float32),
+                                    np.ones(4, np.int32)),
+                            nn.ClassNLLCriterion(), batch_size=4,
+                            local=False)
+        with pytest.raises(ValueError, match="steps"):
+            o.set_gradient_accumulation(0)
