@@ -4,6 +4,7 @@ from bigdl_tpu.nn.module import (Activity, ApplyContext, Module, Node,
                                  functional_apply, merge_state, param_count,
                                  topo_sort)
 from bigdl_tpu.nn.containers import (Bottle, CAddTable, CAveTable, CDivTable,
+                                     Remat,
                                      CMaxTable, CMinTable, CMulTable, CSubTable,
                                      Concat, ConcatTable, Container, Echo,
                                      BifurcateSplitTable, FlattenTable, Graph, Identity, Input,

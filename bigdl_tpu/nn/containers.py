@@ -138,6 +138,45 @@ class Bottle(Container):
         return jnp.reshape(y, lead + y.shape[1:])
 
 
+class Remat(Container):
+    """Rematerialize the wrapped module under autodiff (jax.checkpoint).
+
+    Beyond-parity TPU feature (SURVEY.md §7 design brief: "use
+    jax.checkpoint to trade FLOPs for memory"): activations inside the
+    wrapped subtree are recomputed during the backward pass instead of
+    being stored, cutting peak HBM for deep blocks (wrap ResNet stages /
+    transformer blocks). Forward math, BN state propagation, and rng
+    threading are unchanged — the wrapper builds a pure inner function
+    (params, x, rng, state) -> (out, new_state) so XLA can recompute it.
+    """
+
+    def __init__(self, module: Module, name=None):
+        super().__init__(name)
+        self.add(module)
+
+    def apply(self, params, input, ctx):
+        key = self._child_keys[0]
+        child = self.children[0]
+        base_path = ctx.path + (key,)
+        state_in = {k: v for k, v in ctx.state.items()
+                    if k[:len(base_path)] == base_path}
+        # derive the subtree rng OUTSIDE the checkpointed fn so it is a
+        # plain input (deterministic, replayable on recompute)
+        sub_rng = ctx.make_rng() if ctx._rng is not None else None
+        training = ctx.training
+
+        def inner(p, x, rng, state):
+            sub = ApplyContext(training=training, rng=rng, state=state)
+            sub._path = list(base_path)
+            out = child.apply(p, x, sub)
+            return out, sub.new_state
+
+        out, new_state = jax.checkpoint(inner)(
+            params[key], input, sub_rng, state_in)
+        ctx.new_state.update(new_state)
+        return out
+
+
 # ---------------------------------------------------------------------- #
 # element-wise table reducers (CAddTable family)
 # ---------------------------------------------------------------------- #

@@ -169,6 +169,7 @@ SPECS = {
     "Concat": (lambda: nn.Concat(1).add(nn.Linear(4, 2))
                .add(nn.Linear(4, 3)), MAT),
     "Bottle": (lambda: nn.Bottle(nn.Linear(4, 2)), SEQ),
+    "Remat": (lambda: nn.Remat(nn.Linear(4, 2)), MAT),
     "TimeDistributed": (lambda: nn.TimeDistributed(nn.Linear(4, 2)), SEQ),
 
     # recurrent
