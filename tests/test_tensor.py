@@ -293,3 +293,31 @@ class TestTensorMathBreadth:
         RNG.setSeed(42)
         v = Tensor(jnp.zeros((1,))).uniform(2.0, 4.0)
         assert 2.0 <= v < 4.0
+
+
+class TestConv2ScipyOracle:
+    """tensor.conv2/xcorr2 vs scipy.signal (torch conv2 semantics:
+    'V' = valid, 'F' = full; conv2 flips the kernel, xcorr2 does not)."""
+
+    def _pair(self):
+        rs = np.random.RandomState(0)
+        return (rs.randn(7, 8).astype(np.float32),
+                rs.randn(3, 3).astype(np.float32))
+
+    @pytest.mark.parametrize("mode,vf", [("valid", "V"), ("full", "F")])
+    def test_conv2_matches_scipy(self, mode, vf):
+        from scipy.signal import convolve2d
+        from bigdl_tpu.tensor import Tensor
+        a, k = self._pair()
+        got = np.asarray(Tensor(a).conv2(Tensor(k), vf).to_numpy())
+        want = convolve2d(a, k, mode=mode)
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+    @pytest.mark.parametrize("mode,vf", [("valid", "V"), ("full", "F")])
+    def test_xcorr2_matches_scipy(self, mode, vf):
+        from scipy.signal import correlate2d
+        from bigdl_tpu.tensor import Tensor
+        a, k = self._pair()
+        got = np.asarray(Tensor(a).xcorr2(Tensor(k), vf).to_numpy())
+        want = correlate2d(a, k, mode=mode)
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
