@@ -26,7 +26,15 @@ def _get_column(df, name: str) -> np.ndarray:
         col = list(df[name])
     else:
         raise TypeError(f"unsupported frame type {type(df)}")
-    return np.asarray([np.asarray(v, np.float32) for v in col])
+    def to_arr(v):
+        # DLImageReader/DLImageTransformer columns hold image STRUCTS
+        # (origin/height/width/nChannels/data) — consume the data field,
+        # like the reference's DLModel does with the image schema
+        if isinstance(v, dict) and "data" in v:
+            v = v["data"]
+        return np.asarray(v, np.float32)
+
+    return np.asarray([to_arr(v) for v in col])
 
 
 def _with_column(df, name: str, values: List):

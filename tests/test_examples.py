@@ -113,3 +113,8 @@ class TestExamples:
         acc = main(["--n", "192", "--classes", "6", "--max-epoch", "4",
                     "--width-mult", "0.25"])
         assert acc > 0.8
+
+    def test_dlframes_image_pipeline(self):
+        from examples.dlframes_image_pipeline import main
+        acc = main(["--n-per-class", "25", "--max-epoch", "4"])
+        assert acc > 0.8
