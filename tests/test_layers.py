@@ -460,3 +460,26 @@ class TestRemat:
         a, _ = functional_apply(m, p, x, state={}, training=True, rng=r)
         b, _ = functional_apply(m, p, x, state={}, training=True, rng=r)
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+class TestRecurrentDecoderUnroll:
+    """RecurrentDecoder's lax.scan must equal a manual feed-output-back
+    unroll of the same cell (RecurrentDecoder.scala contract)."""
+
+    def test_matches_manual_unroll(self):
+        from bigdl_tpu.nn.module import ApplyContext
+        cell = nn.LSTMCell(5, 5)
+        dec = nn.RecurrentDecoder(cell, output_length=4)
+        params = dec.init(jax.random.PRNGKey(0))
+        x0 = jnp.asarray(np.random.RandomState(0).randn(3, 5)
+                         .astype(np.float32))
+        got = np.asarray(dec.forward(x0, training=False))
+
+        state = cell.zero_state_for(x0)
+        x, outs = x0, []
+        for _ in range(4):
+            x, state = cell.step(params["cell"], x, state,
+                                 ApplyContext())
+            outs.append(np.asarray(x))
+        want = np.stack(outs, axis=1)
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
