@@ -10,6 +10,8 @@ import pytest
 sys.path.insert(0, "/root/repo")
 
 
+pytestmark = pytest.mark.slow  # full-size models / e2e training
+
 class TestExamples:
     def test_lenet_local(self):
         from examples.lenet_local import main

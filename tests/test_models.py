@@ -12,6 +12,8 @@ from bigdl_tpu.models import (Autoencoder, Inception_v1, LeNet5, PTBModel,
 from bigdl_tpu.nn.module import functional_apply, param_count
 from bigdl_tpu.utils.table import T
 
+pytestmark = pytest.mark.slow  # full-size models / e2e training
+
 KEY = jax.random.PRNGKey(0)
 
 
