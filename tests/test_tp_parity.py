@@ -1,0 +1,98 @@
+"""Tensor-parallel training parity: numerics, not just liveness.
+
+The claim at bigdl_tpu/optim/distri_optimizer.py:53 is that adding a
+'model' mesh axis changes only WHERE tensors live, not WHAT is computed —
+XLA's SPMD partitioner inserts the collectives and the math is identical.
+This test proves it numerically: the same model / data / seed trained on a
+pure-dp (data=8, model=1) mesh and a dp x tp (data=4, model=2) mesh must
+converge to the same parameters.
+
+Reference contrast: the reference has only synchronous data parallelism
+(SURVEY.md §2), so no such test exists there; this is the correctness
+certificate for the beyond-parity TP feature.
+"""
+
+import jax
+import numpy as np
+import pytest
+
+import bigdl_tpu.nn as nn
+import bigdl_tpu.optim as optim
+from bigdl_tpu.optim.distri_optimizer import DistriOptimizer
+from bigdl_tpu.optim.optimizer import _as_batched_dataset
+from bigdl_tpu.parallel.mesh import build_mesh
+from bigdl_tpu.parallel.sharding import ShardingRules, infer_param_specs
+
+
+def _model():
+    # both runs construct a fresh instance; ensure_params() inits from
+    # PRNGKey(0) so the starting weights are bit-identical
+    return (nn.Sequential(name="tp_parity")
+            .add(nn.SpatialConvolution(3, 8, 3, 3, pad_w=1, pad_h=1))
+            .add(nn.SpatialBatchNormalization(8))
+            .add(nn.ReLU())
+            .add(nn.Reshape((8 * 8 * 8,)))
+            .add(nn.Linear(8 * 8 * 8, 256))   # sharded over 'model' axis
+            .add(nn.ReLU())
+            .add(nn.Dropout(0.2))             # exercises the rng path
+            .add(nn.Linear(256, 4))
+            .add(nn.LogSoftMax()))
+
+
+def _train(data_ax, model_ax, X, Y, iters=4):
+    model = _model()
+    mesh = build_mesh(data=data_ax, model=model_ax,
+                      devices=jax.devices()[:data_ax * model_ax])
+    o = DistriOptimizer(
+        model, _as_batched_dataset((X, Y), len(X), True),
+        nn.ClassNLLCriterion(), mesh=mesh,
+        sharding_rules=ShardingRules(min_shard_dim=128))
+    o.set_optim_method(optim.SGD(learning_rate=0.05, momentum=0.9))
+    o.set_end_when(optim.max_iteration(iters))
+    o.optimize()
+    return model, mesh, o
+
+
+class TestTensorParallelParity:
+    @pytest.fixture(scope="class")
+    def runs(self):
+        rs = np.random.RandomState(0)
+        X = rs.rand(16, 8, 8, 3).astype(np.float32)
+        Y = (rs.randint(0, 4, size=16) + 1).astype(np.int32)
+        m_dp, _, _ = _train(8, 1, X, Y)
+        m_tp, mesh_tp, o_tp = _train(4, 2, X, Y)
+        return m_dp, m_tp, mesh_tp, o_tp
+
+    def test_tp_actually_shards(self, runs):
+        """Guard against vacuous parity: the dp x tp run must place at
+        least one parameter split over the 'model' axis."""
+        _, m_tp, mesh_tp, o_tp = runs
+        specs = infer_param_specs(m_tp.ensure_params(), mesh_tp,
+                                  ShardingRules(min_shard_dim=128))
+        flat = jax.tree_util.tree_leaves(
+            jax.tree_util.tree_map(lambda s: "model" in str(s), specs,
+                                   is_leaf=lambda s: hasattr(s, "index")))
+        assert any(flat), "no parameter was tensor-parallel sharded"
+
+    def test_final_params_match(self, runs):
+        m_dp, m_tp, _, _ = runs
+        p_dp = jax.device_get(m_dp.ensure_params())
+        p_tp = jax.device_get(m_tp.ensure_params())
+        flat_dp, tree_dp = jax.tree_util.tree_flatten_with_path(p_dp)
+        flat_tp, tree_tp = jax.tree_util.tree_flatten_with_path(p_tp)
+        assert str(tree_dp) == str(tree_tp)
+        for (path, a), (_, b) in zip(flat_dp, flat_tp):
+            name = "/".join(str(getattr(p, "key", getattr(p, "idx", p)))
+                            for p in path)
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=2e-4, atol=1e-5,
+                err_msg=f"param {name} diverged between dp and dp x tp")
+
+    def test_bn_state_matches(self, runs):
+        m_dp, m_tp, _, _ = runs
+        s_dp = jax.device_get(m_dp._state)
+        s_tp = jax.device_get(m_tp._state)
+        for a, b in zip(jax.tree_util.tree_leaves(s_dp),
+                        jax.tree_util.tree_leaves(s_tp)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=2e-4, atol=1e-5)
