@@ -833,6 +833,298 @@ class Tensor:
         from bigdl_tpu.tensor.sparse import SparseTensor
         return SparseTensor.from_dense(self)
 
+    # ------------------------------------------------- surface-parity tail
+    # (reference Tensor.scala / TensorMath.scala long tail; each cites its
+    # counterpart. Breeze/MLlib conversions are excluded by design — see
+    # docs/PARITY.md.)
+    def apply(self, index):
+        """1-based read — `t(i)` in Scala (Tensor.scala `def apply`).
+
+        int -> select(1, i) view (scalar for 1-D); sequence of ints -> the
+        element at that multi-index."""
+        if isinstance(index, (list, tuple)):
+            return self.valueAt(*index)
+        return self[index]
+
+    def update(self, index, value):
+        """1-based write — `t(i) = v` in Scala (Tensor.scala `def update`)."""
+        if isinstance(index, (list, tuple)):
+            self.setValue(*index, value)
+        else:
+            self[index] = value
+        return self
+
+    def value(self):
+        """The single element of a 1-element tensor (Tensor.value)."""
+        if self.nElement() != 1:
+            raise ValueError(f"value() on tensor with {self.nElement()} elements")
+        return self._storage.array[self._offset].item()
+
+    def isEmpty(self) -> bool:
+        return self.nElement() == 0
+
+    def isScalar(self) -> bool:
+        return self.dim() == 0 and self.nElement() == 1
+
+    def isTensor(self) -> bool:
+        """Activity trait (AbstractModule I/O can be Tensor or Table)."""
+        return True
+
+    def isTable(self) -> bool:
+        return False
+
+    def toTable(self):
+        raise ValueError("Tensor cannot be cast to Table (Tensor.toTable)")
+
+    def getType(self) -> str:
+        """TensorDataType name (Tensor.getType)."""
+        return TensorNumeric.name_of(self.dtype)
+
+    def getTensorType(self) -> str:
+        return "DenseType"
+
+    def getTensorNumeric(self):
+        return TensorNumeric
+
+    def emptyInstance(self) -> "Tensor":
+        return Tensor(dtype=TensorNumeric.name_of(self.dtype))
+
+    def cast(self, cast_tensor: "Tensor") -> "Tensor":
+        """Copy self into `cast_tensor`, converting to its dtype
+        (Tensor.cast)."""
+        cast_tensor.resize(*self._size) if self._size else None
+        cast_tensor._write(self.to_jax().astype(cast_tensor.dtype))
+        return cast_tensor
+
+    def forceFill(self, v) -> "Tensor":
+        return self.fill(v)
+
+    def expandAs(self, template: "Tensor") -> "Tensor":
+        return self.expand(*template.size())
+
+    def shallowClone(self) -> "Tensor":
+        """New metadata over the SAME storage (Tensor.shallowClone)."""
+        return Tensor._from_view(self._storage, self._offset, self._size,
+                                 self._stride)
+
+    def squeezeNewTensor(self) -> "Tensor":
+        """Squeezed view sharing storage (Tensor.squeezeNewTensor)."""
+        keep = [(n, st) for n, st in zip(self._size, self._stride) if n != 1]
+        return Tensor._from_view(self._storage, self._offset,
+                                 tuple(n for n, _ in keep),
+                                 tuple(st for _, st in keep))
+
+    def unfold(self, dim: int, size: int, step: int) -> "Tensor":
+        """Strided sliding-window view (Tensor.unfold): dim's length becomes
+        the window count and a trailing dim of `size` is appended."""
+        d = dim - 1
+        n = self._size[d]
+        if size > n:
+            raise ValueError(f"unfold size {size} > dim length {n}")
+        windows = (n - size) // step + 1
+        new_size = list(self._size)
+        new_size[d] = windows
+        new_size.append(size)
+        new_stride = list(self._stride)
+        new_stride[d] = self._stride[d] * step
+        new_stride.append(self._stride[d])
+        return Tensor._from_view(self._storage, self._offset,
+                                 tuple(new_size), tuple(new_stride))
+
+    def split(self, size: int, dim: Optional[int] = None):
+        """split(size, dim): narrowed chunks of `size` along dim (last may be
+        smaller); split(dim): size-1 selections (DenseTensor.split:764-785).
+        All returned tensors are views sharing this storage."""
+        if dim is None:  # single-arg form: arg is the dim
+            d = size
+            return [self.select(d, i) for i in range(1, self.size(d) + 1)]
+        out, start, n = [], 1, self.size(dim)
+        while start <= n:
+            cur = min(size, n - start + 1)
+            out.append(self.narrow(dim, start, cur))
+            start += cur
+        return out
+
+    def toArray(self):
+        """Flat host array of this view's elements (Tensor.toArray)."""
+        return self.to_numpy().reshape(-1)
+
+    def notEqualValue(self, value) -> bool:
+        return bool(jnp.any(self.to_jax() != value))
+
+    def numNonZeroByRow(self):
+        """Per-row non-zero counts (Tensor.numNonZeroByRow; 2-D)."""
+        arr = self.to_jax()
+        if arr.ndim == 1:
+            arr = arr[None, :]
+        return [int(c) for c in jnp.sum(arr != 0, axis=tuple(
+            range(1, arr.ndim)))]
+
+    def map(self, other: "Tensor", func) -> "Tensor":
+        """self[i] = func(self[i], other[i]) elementwise (Tensor.map).
+
+        `func` is a host scalar function; this is the Torch-parity escape
+        hatch, not a jit path — vectorized ops belong in jnp."""
+        a = self.to_numpy().reshape(-1)
+        b = other.to_numpy().reshape(-1)
+        return self._write(np.array([func(x, y) for x, y in zip(a, b)],
+                                    dtype=a.dtype).reshape(self._size))
+
+    def applyFun(self, other: "Tensor", func) -> "Tensor":
+        """self[i] = func(other[i]) (TensorMath.applyFun); resizes self."""
+        self.resize(*other.size())
+        b = other.to_numpy().reshape(-1)
+        return self._write(np.array([func(y) for y in b]).astype(
+            np.dtype(self.dtype.name) if hasattr(self.dtype, "name")
+            else np.float32).reshape(self._size))
+
+    def zipWith(self, t1: "Tensor", t2: "Tensor", func) -> "Tensor":
+        """self[i] = func(t1[i], t2[i]) (TensorMath.zipWith); resizes self."""
+        self.resize(*t1.size())
+        a = t1.to_numpy().reshape(-1)
+        b = t2.to_numpy().reshape(-1)
+        return self._write(np.array([func(x, y) for x, y in zip(a, b)])
+                           .reshape(self._size))
+
+    def diff(self, other: "Tensor", count: int = 1,
+             reverse: bool = False) -> bool:
+        """True if tensors differ; logs up to `count` differing positions
+        (DenseTensor.diff:1644)."""
+        if self.dim() != other.dim() or self._size != other._size:
+            print(f"size mismatch: {self._size} vs {other._size}")
+            return True
+        a = self.to_numpy().reshape(-1)
+        b = other.to_numpy().reshape(-1)
+        where = np.nonzero(a != b)[0]
+        if len(where) == 0:
+            return False
+        show = where[-count:] if reverse else where[:count]
+        for i in show:
+            print(f"difference at offset {int(i)}: {a[i]} vs {b[i]}")
+        return True
+
+    def toQuantizedTensor(self):
+        from bigdl_tpu.tensor.quantized import QuantizedTensor
+        return QuantizedTensor.from_float(self.to_jax())
+
+    def save(self, path: str, over_write: bool = False) -> "Tensor":
+        """Persist to `path` (Tensor.save); companion `Tensor.load`."""
+        import os as _os
+        if _os.path.exists(path) and not over_write:
+            raise FileExistsError(f"{path} exists and over_write is False")
+        with open(path, "wb") as f:
+            np.save(f, self.to_numpy(), allow_pickle=False)
+        return self
+
+    @staticmethod
+    def load(path: str) -> "Tensor":
+        with open(path, "rb") as f:
+            return Tensor(np.load(f, allow_pickle=False))
+
+    def set(self, *args, storageOffset: int = 1, sizes=None, strides=None):
+        """Torch `set` overloads (Tensor.set): no args -> empty; (tensor) ->
+        alias its storage; (storage, offset, sizes, strides) -> repoint."""
+        if not args:
+            return self.set_()
+        if isinstance(args[0], Tensor):
+            return self.set_(args[0])
+        storage = args[0]
+        if len(args) > 1:
+            storageOffset = args[1]
+        if len(args) > 2:
+            sizes = args[2]
+        if len(args) > 3:
+            strides = args[3]
+        self._storage = storage
+        self._offset = int(storageOffset) - 1
+        if sizes is None:
+            sizes = (len(storage) - self._offset,)
+        self._size = tuple(int(s) for s in sizes)
+        self._stride = tuple(int(s) for s in strides) if strides is not None \
+            else _contiguous_strides(self._size)
+        self._cache = None
+        return self
+
+    # companion-object factories (Tensor.scala object Tensor)
+    @staticmethod
+    def ones(*sizes, dtype="float") -> "Tensor":
+        return Tensor(jnp.ones(sizes, TensorNumeric.dtype(dtype)))
+
+    @staticmethod
+    def scalar(value) -> "Tensor":
+        """0-dim tensor holding one value (Tensor.scalar)."""
+        t = Tensor.__new__(Tensor)
+        t._storage = Storage(jnp.asarray([value], jnp.float32))
+        t._offset = 0
+        t._size = ()
+        t._stride = ()
+        t._cache = None
+        return t
+
+    @staticmethod
+    def randperm(n: int) -> "Tensor":
+        """Random permutation of 1..n (Tensor.randperm), drawn from the
+        host RandomGenerator so tests can seed it."""
+        from bigdl_tpu.utils.random_generator import RNG
+        return Tensor((RNG.permutation(n) + 1).astype(np.float32))
+
+    @staticmethod
+    def gaussian1D(size: int = 3, sigma: float = 0.25, amplitude: int = 1,
+                   normalize: bool = False, mean: float = 0.5,
+                   tensor: Optional["Tensor"] = None) -> "Tensor":
+        """1-D gaussian kernel (DenseTensor.gaussian1D:2654)."""
+        gauss = tensor if tensor is not None else Tensor(size)
+        n = gauss.nElement()
+        center = mean * n + 0.5
+        i = jnp.arange(1, n + 1, dtype=jnp.float32)
+        vals = amplitude * jnp.exp(-(((i - center) / (sigma * size)) ** 2) / 2)
+        if normalize:
+            vals = vals / jnp.sum(vals)
+        gauss._write(vals.astype(gauss.dtype).reshape(gauss.size()))
+        return gauss
+
+    @staticmethod
+    def unique(tensor: "Tensor"):
+        """(distinct values in first-occurrence order, 0-based index of each
+        input element in that distinct list) — Tensor.unique:1346."""
+        arr = tensor.to_numpy().reshape(-1)
+        _, first, inverse = np.unique(arr, return_index=True,
+                                      return_inverse=True)
+        order = np.argsort(first)           # restore first-occurrence order
+        rank = np.empty_like(order)
+        rank[order] = np.arange(len(order))
+        return (Tensor(arr[np.sort(first)]),
+                Tensor(rank[inverse].astype(np.int32), dtype="int"))
+
+    @staticmethod
+    def dense(sparse, res: Optional["Tensor"] = None) -> "Tensor":
+        """SparseTensor -> dense (Tensor.dense)."""
+        d = Tensor(np.asarray(sparse.to_jax_dense()
+                              if hasattr(sparse, "to_jax_dense")
+                              else sparse.to_dense()))
+        if res is not None:
+            res.resize(*d.size())
+            res.copy(d)
+            return res
+        return d
+
+    @staticmethod
+    def sparse(*args):
+        """Tensor.sparse overloads: (denseTensor) or
+        (indices, values, shape) — returns a SparseTensor."""
+        from bigdl_tpu.tensor.sparse import SparseTensor
+        if len(args) == 1:
+            return SparseTensor.from_dense(args[0])
+        indices, values, shape = args[:3]
+        vals = values.to_numpy() if isinstance(values, Tensor) else \
+            np.asarray(values)
+        return SparseTensor(np.asarray(indices), vals, tuple(shape))
+
+    @staticmethod
+    def sparseConcat(tensors, dim: int = 2):
+        from bigdl_tpu.tensor.sparse import SparseTensor
+        return SparseTensor.concat(tensors, dim=dim)
+
     # -------------------------------------------------------------- dunder
     def __len__(self):
         return self._size[0] if self._size else 0
