@@ -119,6 +119,15 @@ class DataSet:
         return LocalDataSet(items)
 
     @staticmethod
+    def from_source(source, host_index: Optional[int] = None,
+                    num_hosts: Optional[int] = None) -> LocalDataSet:
+        """This host's shard of an external `DataSource` (partitioned
+        store — e.g. a Spark RDD via `SparkRDDSource`); see
+        bigdl_tpu/dataset/datasource.py for the contract."""
+        from bigdl_tpu.dataset.datasource import from_data_source
+        return from_data_source(source, host_index, num_hosts)
+
+    @staticmethod
     def from_arrays(features: np.ndarray, labels: Optional[np.ndarray] = None) -> LocalDataSet:
         items = [Sample(features[i], labels[i] if labels is not None else None)
                  for i in range(len(features))]

@@ -1,0 +1,165 @@
+"""External data-plane contract: feeding the mesh from a partitioned store.
+
+Parity: the reference is a "library on a data plane" — training data lives
+in Spark RDDs/DataFrames and `DLEstimator.internalFit`
+(DL/dlframes/DLEstimator.scala:270) converts DataFrame -> RDD[Sample] ->
+Optimizer, while `ZippedPartitionsWithLocalityRDD`
+(spark/spark-version/2.0/.../ZippedPartitionsWithLocalityRDD.scala:47) pins
+each data partition to the host holding the model replica. In the TPU build
+the JVM data plane is replaced by a minimal *protocol*: any partitioned
+host-side source can feed the mesh by exposing its partition count and a
+per-partition iterator. Each jax process (host) pulls the partitions it
+owns — a static, deterministic partition->host assignment, the locality
+analogue — and feeds them to the per-host `DistributedDataSet` exactly as
+`tests/test_multihost.py` feeds explicit shards.
+
+Three ways to plug in, in increasing coupling:
+
+1. Implement `DataSource` (two methods) and call `DataSet.from_source`.
+2. Wrap a live pyspark RDD with `SparkRDDSource` — uses only the public
+   RDD API (`getNumPartitions`, `mapPartitionsWithIndex`, `collect`), so
+   it works against any pyspark version without importing pyspark here.
+3. Wrap a Spark DataFrame with `SparkDataFrameSource(df, feature_col,
+   label_col)` — the `DLEstimator.internalFit` role: rows become Samples.
+
+pyspark is NOT a dependency: adapters hold the user's object and call
+documented methods on it (duck typing), so the module imports cleanly on
+hosts without Spark and the contract is testable with any object speaking
+the same protocol.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Iterable, Iterator, List, Optional
+
+import numpy as np
+
+from bigdl_tpu.dataset.dataset import DistributedDataSet, LocalDataSet
+from bigdl_tpu.dataset.sample import Sample
+
+
+class DataSource:
+    """The pluggable data-plane contract (duck-typed; subclassing optional).
+
+    A source is a partitioned collection of Sample-convertible items::
+
+        num_partitions() -> int        # total partitions, all hosts
+        partition(i)     -> Iterable   # items of partition i
+
+    Items may be `Sample`s, `(feature, label)` pairs, or bare arrays.
+    Partition i is owned by host `i % num_hosts` — static assignment, the
+    TPU-side analogue of the reference's locality-aware zip keeping data
+    and model co-resident (ZippedPartitionsWithLocalityRDD.scala:47).
+    """
+
+    def num_partitions(self) -> int:
+        raise NotImplementedError
+
+    def partition(self, index: int) -> Iterable:
+        raise NotImplementedError
+
+    def owned_items(self, host_index: int, num_hosts: int) -> Iterable:
+        """All items of the partitions host `host_index` owns. Default:
+        iterate the owned partitions; sources with a cheaper bulk path
+        (one Spark job instead of one per partition) override this."""
+        for i in range(self.num_partitions()):
+            if i % num_hosts == host_index:
+                yield from self.partition(i)
+
+
+def _to_sample(item) -> Sample:
+    if isinstance(item, Sample):
+        return item
+    if isinstance(item, tuple) and len(item) == 2:
+        return Sample(np.asarray(item[0]), np.asarray(item[1]))
+    return Sample(np.asarray(item))
+
+
+def from_data_source(source, host_index: Optional[int] = None,
+                     num_hosts: Optional[int] = None,
+                     to_sample: Callable = _to_sample) -> LocalDataSet:
+    """Materialize this host's shard of `source` as a dataset.
+
+    Host h pulls partitions {i : i % num_hosts == h}. With one host this
+    degenerates to reading every partition locally, mirroring how the
+    reference runs 'distributed' code on local[N] Spark (SURVEY.md §4.4).
+    """
+    if host_index is None or num_hosts is None:
+        import jax
+        host_index = jax.process_index() if host_index is None else host_index
+        num_hosts = jax.process_count() if num_hosts is None else num_hosts
+    # bulk path when the source offers one (a single Spark job); plain
+    # two-method protocol sources fall back to the per-partition loop
+    bulk = getattr(source, "owned_items", None)
+    it = bulk(host_index, num_hosts) if bulk is not None else \
+        DataSource.owned_items(source, host_index, num_hosts)
+    items: List[Sample] = [to_sample(x) for x in it]
+    ds = LocalDataSet(items)
+    # global-progress accounting for epoch triggers (same fields
+    # DistributedDataSet carries); global size is unknowable without a
+    # count job, so estimate from this host's shard — exact when
+    # partitions are balanced
+    ds.host_index, ds.num_hosts = host_index, num_hosts
+    ds.global_size = len(items) * num_hosts if num_hosts > 1 else len(items)
+    return ds
+
+
+class SparkRDDSource(DataSource):
+    """Adapter: pyspark `RDD[Sample-convertible]` -> DataSource.
+
+    Touches only the stable public RDD surface — `getNumPartitions()` and
+    one `mapPartitionsWithIndex(...).collect()` per owned partition — so
+    each host runs small Spark jobs that ship ONLY its own partitions,
+    the pull-based mirror of the reference's push-based locality zip.
+    """
+
+    def __init__(self, rdd):
+        self.rdd = rdd
+
+    def num_partitions(self) -> int:
+        return self.rdd.getNumPartitions()
+
+    def partition(self, index: int) -> Iterable:
+        def keep(i, it):
+            return it if i == index else iter(())
+        return self.rdd.mapPartitionsWithIndex(keep).collect()
+
+    def owned_items(self, host_index: int, num_hosts: int) -> Iterable:
+        # ONE job shipping every owned partition — evaluating the RDD
+        # lineage once, not once per partition
+        def keep(i, it):
+            return it if i % num_hosts == host_index else iter(())
+        return self.rdd.mapPartitionsWithIndex(keep).collect()
+
+
+class SparkDataFrameSource(SparkRDDSource):
+    """Adapter: Spark DataFrame + column names -> DataSource of Samples.
+
+    The `DLEstimator.internalFit` conversion (DLEstimator.scala:270):
+    each row's feature/label columns become one Sample. Works on any
+    object with `.rdd` whose rows are mappings (pyspark Row supports
+    `row[name]`); feature_size reshapes flat columns the way the
+    reference's `featureSize` param does.
+    """
+
+    def __init__(self, df, feature_col: str = "features",
+                 label_col: Optional[str] = "label",
+                 feature_size: Optional[tuple] = None):
+        super().__init__(df.rdd)
+        self.feature_col, self.label_col = feature_col, label_col
+        self.feature_size = tuple(feature_size) if feature_size else None
+
+    def _row_to_sample(self, row) -> Sample:
+        feat = np.asarray(row[self.feature_col], np.float32)
+        if self.feature_size:
+            feat = feat.reshape(self.feature_size)
+        if self.label_col is None:
+            return Sample(feat)
+        return Sample(feat, np.asarray(row[self.label_col]))
+
+    def partition(self, index: int) -> Iterable:
+        return (self._row_to_sample(r) for r in super().partition(index))
+
+    def owned_items(self, host_index: int, num_hosts: int) -> Iterable:
+        return (self._row_to_sample(r)
+                for r in super().owned_items(host_index, num_hosts))
