@@ -106,16 +106,31 @@ class DistriOptimizer(BaseOptimizer):
         precision_scope = self._precision_scope
         accum = int(getattr(self, "grad_accum_steps", 1) or 1)
 
+        mixed = self._mixed_bf16
+        cast = self._cast_floats
+
         def loss_and_grads(params, model_state, x, y, rng):
             def loss_fn(p):
                 with precision_scope():
-                    out, new_ms = functional_apply(model, p, x,
+                    # mixed precision: bf16 compute, f32 masters — the cast
+                    # sits INSIDE value_and_grad so its adjoint upcasts the
+                    # gradients back to f32 before clip/update
+                    xc = cast(x, jnp.bfloat16) if mixed else x
+                    if mixed:
+                        p = cast(p, jnp.bfloat16)
+                    out, new_ms = functional_apply(model, p, xc,
                                                    state=model_state,
                                                    training=True, rng=rng)
+                    if mixed:
+                        out = cast(out, jnp.float32)
                     return criterion.apply(out, y), new_ms
             return jax.value_and_grad(loss_fn, has_aux=True)(params)
 
         def step(params, opt_state, model_state, x, y, lr, rng):
+            # rng chain lives ON DEVICE: split inside the jitted step and
+            # return the successor, so the host never dispatches a separate
+            # split per iteration (a measurable cost on a tunneled chip)
+            rng, step_rng = jax.random.split(rng)
             if accum > 1:
                 # gradient accumulation: split the batch into `accum`
                 # micro-batches and lax.scan the grad computation, so peak
@@ -134,7 +149,7 @@ class DistriOptimizer(BaseOptimizer):
                     return (g_acc, l_acc + l, new_ms), None
 
                 zeros = jax.tree_util.tree_map(jnp.zeros_like, params)
-                rngs = jax.random.split(rng, accum)
+                rngs = jax.random.split(step_rng, accum)
                 (g_sum, l_sum, new_ms), _ = jax.lax.scan(
                     body, (zeros, 0.0, model_state),
                     (micro(x), micro(y), rngs))
@@ -142,14 +157,15 @@ class DistriOptimizer(BaseOptimizer):
                 loss = l_sum / accum
             else:
                 (loss, new_ms), grads = loss_and_grads(params, model_state,
-                                                       x, y, rng)
+                                                       x, y, step_rng)
             grads = clip(grads)
             new_params, new_opt = optim.update(grads, opt_state, params, lr)
-            return new_params, new_opt, new_ms, loss
+            return new_params, new_opt, new_ms, loss, rng
 
         # jit with sharding propagated from the placed inputs; XLA SPMD
-        # partitions the computation and inserts the ICI collectives
-        return jax.jit(step, donate_argnums=(0, 1))
+        # partitions the computation and inserts the ICI collectives;
+        # donated: params, optimizer slots, and the rng chain
+        return jax.jit(step, donate_argnums=(0, 1, 6))
 
     # ------------------------------------------------------------------ #
     def optimize(self) -> Module:
@@ -250,39 +266,62 @@ class DistriOptimizer(BaseOptimizer):
                 y = place_any(y)
             return batch, x, y
 
+        sync_every = max(1, int(getattr(self, "sync_interval", 1)))
+        window_records = 0
+        window_iters = 0
+        window_t0 = time.perf_counter()
+        loss_val = float("nan")  # last synced loss
+        loss = None  # device array of the most recent step's loss
+        # device-resident rng chain, advanced inside the donated step; a
+        # COPY so self.rng survives donation and the retry path can seed a
+        # fresh chain after a failed attempt killed the in-flight buffers
+        rng_dev = jnp.asarray(self.rng) + 0
         pending = fetch_and_place()
         while pending is not None and not self.end_trigger(driver_state):
             batch, x, y = pending
             lr = self.optim_method.current_lr()
-            self.rng, step_rng = jax.random.split(self.rng)
-            it_t0 = time.perf_counter_ns()
-            params, opt_state, new_ms, loss = step(
-                params, opt_state, model_state, x, y, lr, step_rng)
+            params, opt_state, new_ms, loss, rng_dev = step(
+                params, opt_state, model_state, x, y, lr, rng_dev)
             # prefetch while the dispatched step runs on-device (deliberate
             # one-batch lookahead: the final prefetch of an optimize() call
             # is discarded — one batch of host work per run buys the
             # fetch/H2D overlap on every iteration)
             pending = fetch_and_place()
-            loss = float(loss)  # sync: waits for the step to finish
-            self.metrics.add("computing time average",
-                             time.perf_counter_ns() - it_t0)
+            do_sync = (driver_state["neval"] + 1) % sync_every == 0
+            if do_sync:
+                # waits for the step; donation chains steps, so this means
+                # every dispatched step up to here has completed
+                loss_val = float(loss)
             model_state = merge_state(model_state, new_ms)
 
             n = batch.size() * num_hosts  # global records this step
             driver_state["neval"] += 1
             driver_state["recordsProcessedThisEpoch"] += n
-            driver_state["loss"] = loss
-            t = self.metrics.get("computing time average") / 1e9
-            throughput = n / max(t, 1e-9)
-            logger.info(
-                f"[Epoch {driver_state['epoch'] + 1} "
-                f"{driver_state['recordsProcessedThisEpoch']}/{epoch_size}]"
-                f"[Iteration {driver_state['neval']}] Training cost {loss}. "
-                f"Throughput is {throughput} records/second. "
-                f"({n_dev} devices)")
-            if self.train_summary is not None:
+            driver_state["loss"] = loss_val
+            window_records += n
+            window_iters += 1
+            if do_sync:
+                # throughput + per-iteration compute time over the sync
+                # window: exact wall time between device-drained points,
+                # valid for any sync_interval (per iteration when 1,
+                # reference semantics). Recording the metric ONLY here
+                # keeps "computing time average" a true per-step figure —
+                # per-dispatch timing would be meaningless under async.
+                now = time.perf_counter()
+                throughput = window_records / max(now - window_t0, 1e-9)
+                self.metrics.add("computing time average",
+                                 (now - window_t0) / window_iters * 1e9)
+                window_records, window_iters, window_t0 = 0, 0, now
+                logger.info(
+                    f"[Epoch {driver_state['epoch'] + 1} "
+                    f"{driver_state['recordsProcessedThisEpoch']}/"
+                    f"{epoch_size}]"
+                    f"[Iteration {driver_state['neval']}] Training cost "
+                    f"{loss_val}. Throughput is {throughput} "
+                    f"records/second. ({n_dev} devices)")
+            if do_sync and self.train_summary is not None:
                 it = driver_state["neval"]
-                self.train_summary.add_scalar("Loss", loss, it)
+                self.train_summary.add_scalar("Loss", loss_val, it)
                 self.train_summary.add_scalar(
                     "LearningRate",
                     float(np.mean([v for v in lr if v]) if any(lr) else 0.0)
@@ -316,6 +355,14 @@ class DistriOptimizer(BaseOptimizer):
             if self.iteration_hook is not None:
                 self.iteration_hook(driver_state)
 
+        if sync_every > 1 and loss is not None and \
+                driver_state["neval"] % sync_every != 0:
+            # the loop ended between syncs: surface the true final loss
+            driver_state["loss"] = loss_val = float(loss)
+        # persist the advanced rng chain so a subsequent optimize() call
+        # (resume / train-more) continues the dropout/noise stream instead
+        # of replaying it (LocalOptimizer advances self.rng the same way)
+        self.rng = jax.device_get(rng_dev)
         # gather back to host (reference getModel:646 pulls partitions)
         self.model.set_params(jax.device_get(params))
         self.model._state = jax.device_get(model_state)

@@ -262,6 +262,29 @@ class TestEndToEnd:
         acc = float(((out.argmax(1) + 1) == Y).mean())
         assert acc > 0.75, acc
 
+    def test_mixed_bf16_with_async_sync_interval(self):
+        """set_compute_precision('bfloat16') (true mixed precision: bf16
+        compute, f32 masters + BN stats) combined with set_sync_interval(4)
+        (async dispatch, loss fetched every 4th step) still converges and
+        reports the final loss."""
+        X, Y = self._mnist_like(256)
+        model = LeNet5(4)
+        o = optim.Optimizer(model, (X, Y), nn.ClassNLLCriterion(),
+                            batch_size=64, local=False)
+        o.set_optim_method(optim.Adam(learning_rate=3e-3))
+        o.set_compute_precision("bfloat16")
+        o.set_sync_interval(4)
+        o.set_end_when(optim.max_iteration(62))  # NOT a sync multiple
+        trained = o.optimize()
+        # final loss surfaced even though iter 62 is between syncs
+        assert o.optim_method.state["loss"] < 0.8
+        out = np.asarray(trained.forward(jnp.asarray(X), training=False))
+        acc = float(((out.argmax(1) + 1) == Y).mean())
+        assert acc > 0.75, acc
+        # masters stayed f32 (mixed precision never narrows the params)
+        for leaf in jax.tree_util.tree_leaves(trained.ensure_params()):
+            assert leaf.dtype == jnp.float32
+
     def test_distri_matches_local(self):
         """Same seed/data => distributed step == local step numerically."""
         X, Y = self._mnist_like(64)
