@@ -11,4 +11,5 @@ from bigdl_tpu.models.inception import (Inception_v1,
 from bigdl_tpu.models.vgg import Vgg_16, Vgg_19, VggForCifar10
 from bigdl_tpu.models.rnn import PTBModel, SimpleRNN
 from bigdl_tpu.models.autoencoder import Autoencoder
+from bigdl_tpu.models.transformer import TransformerLM
 from bigdl_tpu.models.widedeep import WideAndDeep

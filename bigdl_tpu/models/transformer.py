@@ -1,0 +1,64 @@
+"""Decoder-only transformer language model (long-context flagship).
+
+Beyond-parity model: the reference's sequence modeling stops at recurrent
+nets (DL/models/rnn/SimpleRNN.scala, PTB LSTM — SURVEY.md §5.7 "no
+attention layer of any kind exists in the tree"). This model exists to
+exercise the long-context stack end-to-end: Pallas flash attention
+(ops/attention_kernel.py), RoPE, pre-norm blocks, and — through
+`parallel/sequence.py` — ring/Ulysses sequence parallelism over a mesh
+axis. Causal LM over 1-based token ids, LogSoftMax output feeding
+TimeDistributedCriterion(ClassNLLCriterion) like PTBModel.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from bigdl_tpu.nn.attention import TransformerBlock
+from bigdl_tpu.nn.module import Module
+from bigdl_tpu.nn.initialization import Xavier
+
+
+class TransformerLM(Module):
+    """[B, T] int tokens (1-based) -> [B, T, vocab] log-probs."""
+
+    def __init__(self, vocab_size: int, embed_dim: int = 256,
+                 n_layer: int = 4, n_head: int = 4, mlp_ratio: int = 4,
+                 max_len: Optional[int] = None, use_flash: bool = True,
+                 dropout: float = 0.0, name=None):
+        super().__init__(name)
+        self.vocab, self.e = vocab_size, embed_dim
+        self.max_len = max_len  # optional sequence-length cap (RoPE is
+        # length-free, so this is a guard, not a table size)
+        self.blocks = [
+            TransformerBlock(embed_dim, n_head, mlp_ratio=mlp_ratio,
+                             causal=True, use_rope=True,
+                             use_flash=use_flash, dropout=dropout)
+            for _ in range(n_layer)
+        ]
+        self.n_layer = n_layer
+
+    def init(self, rng):
+        keys = jax.random.split(rng, self.n_layer + 2)
+        xav = Xavier()
+        p = {"embed": jax.random.normal(keys[0],
+                                        (self.vocab, self.e)) * 0.02,
+             "head": xav(keys[1], (self.e, self.vocab))}
+        for i, blk in enumerate(self.blocks):
+            p[f"block{i}"] = blk.init(keys[i + 2])
+        return p
+
+    def apply(self, params, input, ctx):
+        if self.max_len is not None and input.shape[1] > self.max_len:
+            raise ValueError(
+                f"sequence length {input.shape[1]} exceeds max_len "
+                f"{self.max_len}")
+        # 1-based token ids (reference label convention)
+        x = params["embed"][input.astype(jnp.int32) - 1]
+        for i, blk in enumerate(self.blocks):
+            x = blk.apply(params[f"block{i}"], x, ctx)
+        logits = x @ params["head"]
+        return jax.nn.log_softmax(logits, axis=-1)

@@ -46,6 +46,10 @@ def build(model_name: str, class_num: int = 1000):
         return ResNet50(class_num), (224, 224, 3), class_num
     if model_name == "ptb":
         return PTBModel(10001, 200, 10001), (20,), 10001
+    if model_name == "transformer":
+        from bigdl_tpu.models.transformer import TransformerLM
+        return (TransformerLM(10001, embed_dim=512, n_layer=4, n_head=8),
+                (128,), 10001)
     raise ValueError(f"unknown model {model_name}")
 
 
@@ -68,7 +72,7 @@ def main(argv=None):
 
     model, in_shape, n_class = build(args.model, args.class_num)
     rng = np.random.RandomState(0)
-    if args.model == "ptb":
+    if args.model in ("ptb", "transformer"):
         x_np = rng.randint(1, 10000, (args.batch_size,) + in_shape).astype(
             np.float32)
         y_np = rng.randint(1, 10000, (args.batch_size,) + in_shape).astype(

@@ -97,6 +97,22 @@ class TestShapes:
         m = Autoencoder(32)
         assert m.forward(jnp.ones((2, 28, 28))).shape == (2, 784)
 
+    def test_transformer_lm(self):
+        from bigdl_tpu.models import TransformerLM
+        m = TransformerLM(50, embed_dim=32, n_layer=2, n_head=2,
+                          use_flash=False)
+        x = jnp.asarray(np.random.RandomState(0).randint(1, 51, (2, 12)))
+        y = m.forward(x)
+        assert y.shape == (2, 12, 50)
+        # log-probs normalize
+        np.testing.assert_allclose(np.asarray(jnp.exp(y).sum(-1)), 1.0,
+                                   rtol=1e-4)
+        # causality: future tokens cannot influence earlier positions
+        x2 = x.at[:, 8:].set(1)
+        y2 = m.forward(x2)
+        np.testing.assert_allclose(np.asarray(y[:, :8]),
+                                   np.asarray(y2[:, :8]), atol=1e-5)
+
     def test_wide_and_deep(self):
         m = WideAndDeep(2, wide_dim=100, embed_vocabs=(10, 10), embed_dim=4,
                         cont_dim=3)
