@@ -6,9 +6,12 @@ Parity: the reference's python Keras converter (PY/keras/converter.py —
 models on this framework's Keras-style API (bigdl_tpu.keras), then loads
 weights from the Keras hdf5 checkpoint via h5py.
 
-Supports the tf dim-ordering; Theano-ordered models raise with a clear
-message (the reference converts both, but th-ordering is legacy even for
-the reference's era).
+Supports both dim-orderings (PY/keras/converter.py parity): "tf" maps
+directly; "th" (Theano, channels-first) models are converted to this
+framework's NHWC layout — input shapes (C, H, W) -> (H, W, C), conv
+kernels (nb_filter, stack, row, col) -> (row, col, stack, nb_filter), and
+the Dense layer following a Flatten gets its rows permuted from the
+channels-first flatten order to the channels-last one.
 """
 
 from __future__ import annotations
@@ -35,10 +38,29 @@ def load_keras(json_path: Optional[str] = None,
             config = json.loads(f.attrs["model_config"])
     else:
         raise ValueError("need json_path or hdf5_path")
+    th = _detect_th(config)
     model = DefinitionLoader.from_config(config)
     if hdf5_path is not None:
-        WeightLoader.load_weights(model, hdf5_path)
+        WeightLoader.load_weights(model, hdf5_path, th=th)
     return model
+
+
+def _detect_th(node) -> bool:
+    """True if any layer config declares Theano dim_ordering."""
+    if isinstance(node, dict):
+        if node.get("dim_ordering") == "th":
+            return True
+        return any(_detect_th(v) for v in node.values())
+    if isinstance(node, list):
+        return any(_detect_th(v) for v in node)
+    return False
+
+
+def _th_shape(shape):
+    """(C, H, W) -> (H, W, C) / (C, L) -> (L, C); rank-1 unchanged."""
+    if shape is None or len(shape) < 2:
+        return shape
+    return tuple(shape[1:]) + (shape[0],)
 
 
 class DefinitionLoader:
@@ -63,11 +85,14 @@ class DefinitionLoader:
     def _functional(cfg: Dict[str, Any]):
         """Functional-API graph json: layers + inbound_nodes wiring
         (reference DefinitionLoader handles Model the same way)."""
+        th = _detect_th(cfg)
         tensors: Dict[str, Any] = {}  # layer name -> output KTensor
         for lc in cfg["layers"]:
             name = lc.get("name") or lc["config"].get("name")
             if lc["class_name"] == "InputLayer":
                 shape = tuple(lc["config"]["batch_input_shape"][1:])
+                if th:
+                    shape = _th_shape(shape)
                 tensors[name] = K.input_tensor(shape, name=name)
                 continue
             layer = DefinitionLoader._layer(lc)
@@ -86,12 +111,19 @@ class DefinitionLoader:
         cls = lc["class_name"]
         cfg = dict(lc.get("config", {}))
         name = cfg.get("name")
-        if cfg.get("dim_ordering") == "th":
-            raise ValueError(
-                "Theano dim_ordering models are unsupported; re-save with "
-                "tf ordering")
+        th = cfg.get("dim_ordering") == "th"
         in_shape = cfg.get("batch_input_shape")
         input_shape = tuple(in_shape[1:]) if in_shape else None
+        if th:
+            # channels-first model: build it channels-last; WeightLoader
+            # converts the kernels to match
+            input_shape = _th_shape(input_shape)
+            if cls == "Merge" and cfg.get("concat_axis") == 1:
+                cfg["concat_axis"] = -1  # axis 1 = channels in th
+            if cls == "Reshape":
+                raise ValueError(
+                    "Reshape inside a th-ordered model is ambiguous "
+                    "(target is channels-first); re-save with tf ordering")
         act = cfg.get("activation")
         if cls == "Dense":
             return K.Dense(cfg["output_dim"], activation=_act(act),
@@ -190,7 +222,7 @@ class WeightLoader:
     order (the converter's layer list mirrors the json order)."""
 
     @staticmethod
-    def load_weights(model, hdf5_path: str):
+    def load_weights(model, hdf5_path: str, th: bool = False):
         import h5py
         with h5py.File(hdf5_path, "r") as f:
             g = f["model_weights"] if "model_weights" in f else f
@@ -203,10 +235,10 @@ class WeightLoader:
                           for n in lg.attrs.get("weight_names", [])]
                 if wnames:
                     weights[lname] = [np.asarray(lg[w]) for w in wnames]
-        WeightLoader._apply(model, weights)
+        WeightLoader._apply(model, weights, th=th)
 
     @staticmethod
-    def _apply(model, weights: Dict[str, List[np.ndarray]]):
+    def _apply(model, weights: Dict[str, List[np.ndarray]], th: bool = False):
         params = model.ensure_params()
         # keras Sequential wraps an inner nn.Sequential (`_seq`); functional
         # Models wrap an nn.Graph — both expose (key, KerasLayer) pairs
@@ -218,10 +250,23 @@ class WeightLoader:
             pairs = [(n.key, n.module) for n in model.labor.exec_order]
         else:
             pairs = list(zip(model._child_keys, model.children))
+        # th conversion: remember the most recent Flatten's 3-D input shape
+        # ACROSS weightless layers (Dropout/Activation commonly sit between
+        # Flatten and the classifier Dense); any weighted layer consumes or
+        # invalidates it
+        flatten_shape = None
         for key, layer in pairs:
+            cls = type(layer).__name__
             w = weights.get(layer.name)
             if not w:
+                if cls == "Flatten" and \
+                        getattr(layer, "built_input_shape", None) is not None \
+                        and len(layer.built_input_shape) == 3:
+                    flatten_shape = layer.built_input_shape
                 continue
+            if th:
+                w = WeightLoader._th_convert(layer, flatten_shape, list(w))
+            flatten_shape = None
             params[key] = WeightLoader._map_layer(layer, params.get(key, {}),
                                                   w)
             if type(layer).__name__ == "BatchNormalization" and len(w) >= 4:
@@ -233,6 +278,27 @@ class WeightLoader:
                             "mean": jnp.asarray(w[2].reshape(-1)),
                             "var": jnp.asarray(w[3].reshape(-1))}
         model.set_params(params)
+
+    @staticmethod
+    def _th_convert(layer, flatten_shape, w: List[np.ndarray]):
+        """Rewrite channels-first (Theano) weight arrays for the NHWC model
+        the DefinitionLoader built (reference converter's th branch).
+        `flatten_shape` = the (H, W, C) input of the most recent Flatten,
+        if a Flatten precedes this layer with no weighted layer between."""
+        cls = type(layer).__name__
+        if cls == "Convolution2D":
+            # keras1 th kernel (nb_filter, stack, row, col) -> tf layout
+            # (row, col, stack, nb_filter)
+            w[0] = np.transpose(w[0], (2, 3, 1, 0))
+        elif cls == "Dense" and flatten_shape is not None:
+            # the th model flattened (C, H, W); ours flattens (H, W, C) —
+            # permute the Dense rows so each input feature lands on the
+            # weight row trained for it
+            h, wd, c = flatten_shape
+            perm = (np.arange(c * h * wd).reshape(c, h, wd)
+                    .transpose(1, 2, 0).ravel())
+            w[0] = w[0][perm, :]
+        return w
 
     @staticmethod
     def _map_layer(layer, p, w: List[np.ndarray]):

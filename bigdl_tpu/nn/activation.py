@@ -28,6 +28,16 @@ class _Elementwise(Module):
 
 
 class ReLU(_Elementwise):
+    """max(x, 0) (DL/nn/ReLU.scala; `ip` accepted for API parity — XLA
+    fusion replaces in-place).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from bigdl_tpu.nn import ReLU
+        >>> ReLU().forward(jnp.asarray([-1.0, 2.0])).tolist()
+        [0.0, 2.0]
+    """
+
     def __init__(self, ip: bool = False, name=None):
         super().__init__(name)
 

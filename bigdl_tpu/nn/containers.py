@@ -69,6 +69,19 @@ class Container(Module):
 
 
 class Sequential(Container):
+    """Feed-forward chain of children (DL/nn/Sequential.scala).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from bigdl_tpu.nn import Sequential, Linear, ReLU, LogSoftMax
+        >>> m = Sequential().add(Linear(4, 8)).add(ReLU()).add(Linear(8, 3))
+        >>> out = m.add(LogSoftMax()).forward(jnp.ones((2, 4)))
+        >>> out.shape
+        (2, 3)
+        >>> bool(jnp.allclose(jnp.exp(out).sum(1), 1.0, atol=1e-5))
+        True
+    """
+
     def apply(self, params, input, ctx):
         x = input
         for i in range(len(self.children)):

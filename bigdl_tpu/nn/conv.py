@@ -45,6 +45,13 @@ class SpatialConvolution(Module):
 
     `n_group` maps to feature_group_count (grouped conv as in the reference's
     group path). Weight init default = reference Xavier-for-conv.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from bigdl_tpu.nn import SpatialConvolution
+        >>> conv = SpatialConvolution(3, 8, 3, 3, pad_w=1, pad_h=1)
+        >>> conv.forward(jnp.ones((2, 16, 16, 3))).shape
+        (2, 16, 16, 8)
     """
 
     def __init__(self, n_input_plane: int, n_output_plane: int,

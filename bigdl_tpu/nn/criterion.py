@@ -72,7 +72,16 @@ def _class_indices(target, zero_based):
 
 class ClassNLLCriterion(Criterion):
     """NLL over log-probabilities (pair with LogSoftMax), 1-based targets
-    (DL/nn/ClassNLLCriterion.scala). `weights` = per-class rescaling."""
+    (DL/nn/ClassNLLCriterion.scala). `weights` = per-class rescaling.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from bigdl_tpu.nn import ClassNLLCriterion
+        >>> logp = jnp.log(jnp.asarray([[0.7, 0.2, 0.1]]))
+        >>> crit = ClassNLLCriterion()
+        >>> round(float(crit(logp, jnp.asarray([1]))), 4)  # -log(0.7)
+        0.3567
+    """
     _target_is_elementwise = False
 
     def __init__(self, weights=None, size_average: bool = True,

@@ -17,7 +17,16 @@ from bigdl_tpu.utils.table import Table
 
 class Dropout(Module):
     """Keep-prob scaling at train time (inverted dropout), identity at eval.
-    `init_p` is the DROP probability like the reference (default 0.5)."""
+    `init_p` is the DROP probability like the reference (default 0.5).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from bigdl_tpu.nn import Dropout
+        >>> layer = Dropout(0.5)
+        >>> x = jnp.ones((2, 4))
+        >>> bool((layer.forward(x, training=False) == x).all())  # eval: identity
+        True
+    """
 
     def __init__(self, init_p: float = 0.5, inplace: bool = False,
                  scale: bool = True, name=None):

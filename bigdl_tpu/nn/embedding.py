@@ -21,7 +21,14 @@ from bigdl_tpu.utils.table import Table
 
 class LookupTable(Module):
     """Embedding lookup; ids are 1-based like the reference (padding_value=0
-    maps to a zero row when one_based=True)."""
+    maps to a zero row when one_based=True).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from bigdl_tpu.nn import LookupTable
+        >>> LookupTable(10, 6).forward(jnp.asarray([[1, 2, 3]])).shape
+        (1, 3, 6)
+    """
 
     def __init__(self, n_index: int, n_output: int, padding_value: float = 0,
                  max_norm: float = float("inf"), norm_type: float = 2.0,

@@ -24,6 +24,12 @@ class Linear(Module):
     Reference stores weight [out, in] (DL/nn/Linear.scala); we keep [in, out]
     so the MXU consumes it directly. `weight_init` default matches the
     reference's sqrt(1/fanIn) uniform reset().
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from bigdl_tpu.nn import Linear
+        >>> Linear(4, 3).forward(jnp.ones((2, 4))).shape
+        (2, 3)
     """
 
     def __init__(self, input_size: int, output_size: int, with_bias: bool = True,

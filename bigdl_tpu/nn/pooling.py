@@ -32,7 +32,14 @@ def _pool_pad(pad_h, pad_w, ceil_mode, ih, iw, kh, kw, sh, sw):
 
 
 class SpatialMaxPooling(Module):
-    """(DL/nn/SpatialMaxPooling.scala); NHWC."""
+    """(DL/nn/SpatialMaxPooling.scala); NHWC.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from bigdl_tpu.nn import SpatialMaxPooling
+        >>> SpatialMaxPooling(2, 2).forward(jnp.ones((1, 8, 8, 3))).shape
+        (1, 4, 4, 3)
+    """
 
     def __init__(self, kw: int, kh: int, dw: Optional[int] = None, dh: Optional[int] = None,
                  pad_w: int = 0, pad_h: int = 0, ceil_mode: bool = False,

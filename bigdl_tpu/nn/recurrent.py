@@ -288,7 +288,15 @@ class ConvLSTMPeephole(Cell):
 
 class Recurrent(Module):
     """Run a Cell over [B, T, ...] via lax.scan (reference Recurrent.scala
-    unrolls a while-loop; scan gives one traced body + XLA pipelining)."""
+    unrolls a while-loop; scan gives one traced body + XLA pipelining).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from bigdl_tpu.nn import Recurrent, LSTMCell
+        >>> rnn = Recurrent(LSTMCell(4, 8))
+        >>> rnn.forward(jnp.ones((2, 5, 4))).shape  # [B, T, hidden]
+        (2, 5, 8)
+    """
 
     def __init__(self, cell: Cell, return_sequences: bool = True,
                  reverse: bool = False, name=None):

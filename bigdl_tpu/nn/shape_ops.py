@@ -19,7 +19,14 @@ from bigdl_tpu.utils.table import T, Table
 
 
 class Reshape(Module):
-    """Reshape non-batch dims (batch_mode=None mimics reference auto)."""
+    """Reshape non-batch dims (batch_mode=None mimics reference auto).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from bigdl_tpu.nn import Reshape
+        >>> Reshape((3, 4)).forward(jnp.ones((2, 12))).shape
+        (2, 3, 4)
+    """
 
     def __init__(self, size: Sequence[int], batch_mode: Optional[bool] = True, name=None):
         super().__init__(name)

@@ -892,7 +892,7 @@ class Tensor:
     def cast(self, cast_tensor: "Tensor") -> "Tensor":
         """Copy self into `cast_tensor`, converting to its dtype
         (Tensor.cast)."""
-        cast_tensor.resize(*self._size) if self._size else None
+        cast_tensor.resize(*self._size)  # resize() handles 0-dim (n=1)
         cast_tensor._write(self.to_jax().astype(cast_tensor.dtype))
         return cast_tensor
 
@@ -1048,7 +1048,7 @@ class Tensor:
     # companion-object factories (Tensor.scala object Tensor)
     @staticmethod
     def ones(*sizes, dtype="float") -> "Tensor":
-        return Tensor(jnp.ones(sizes, TensorNumeric.dtype(dtype)))
+        return ones(*sizes, dtype=dtype)  # module-level factory
 
     @staticmethod
     def scalar(value) -> "Tensor":
@@ -1116,9 +1116,9 @@ class Tensor:
         if len(args) == 1:
             return SparseTensor.from_dense(args[0])
         indices, values, shape = args[:3]
-        vals = values.to_numpy() if isinstance(values, Tensor) else \
-            np.asarray(values)
-        return SparseTensor(np.asarray(indices), vals, tuple(shape))
+        to_np = lambda v: v.to_numpy() if isinstance(v, Tensor) \
+            else np.asarray(v)
+        return SparseTensor(to_np(indices), to_np(values), tuple(shape))
 
     @staticmethod
     def sparseConcat(tensors, dim: int = 2):

@@ -1,0 +1,33 @@
+#!/usr/bin/env bash
+# CI gate (the reference's .github/ + make-dist.sh role, SURVEY.md C40).
+#
+# Stages:
+#   1. editable install (pure-python package; native lib builds on demand)
+#   2. native host-runtime build (optional — ctypes loader falls back to
+#      pure python when no toolchain is present)
+#   3. full non-slow suite on an 8-virtual-device CPU mesh (the same trick
+#      the reference uses: local[N] Spark emulating an N-node cluster,
+#      SURVEY.md §4.4)
+#   4. multi-chip dry-run: jit + execute the flagship training step over a
+#      dp x tp mesh, with dp-vs-dp*tp parameter-parity assertions
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+python -m pip install -e . --quiet
+
+if command -v g++ >/dev/null 2>&1; then
+  make -C native
+fi
+
+export JAX_PLATFORMS=cpu
+export XLA_FLAGS="--xla_force_host_platform_device_count=8 ${XLA_FLAGS:-}"
+
+python -m pytest tests/ -q -m "not slow"
+
+python -c "
+import jax; jax.config.update('jax_platforms', 'cpu')
+import __graft_entry__ as g
+g.dryrun_multichip(8)
+"
+
+echo "CI gate passed"

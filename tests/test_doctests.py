@@ -1,0 +1,33 @@
+"""Executable layer doc examples (the reference doctests every Python
+layer docstring: pyspark/test/dev/run-tests:35-40 runs pytest
+--doctest-modules over PY/). Here: every nn module that carries
+`Example:` doctest blocks is executed; adding an example to a docstring
+automatically puts it under test."""
+
+import doctest
+import importlib
+import pkgutil
+
+import pytest
+
+import bigdl_tpu.nn
+
+
+def _modules_with_doctests():
+    names = []
+    for info in pkgutil.iter_modules(bigdl_tpu.nn.__path__,
+                                     prefix="bigdl_tpu.nn."):
+        mod = importlib.import_module(info.name)
+        finder = doctest.DocTestFinder(exclude_empty=True)
+        if any(t.examples for t in finder.find(mod)):
+            names.append(info.name)
+    return names
+
+
+@pytest.mark.parametrize("modname", _modules_with_doctests())
+def test_module_doctests(modname):
+    mod = importlib.import_module(modname)
+    results = doctest.testmod(mod, verbose=False,
+                              optionflags=doctest.NORMALIZE_WHITESPACE)
+    assert results.attempted > 0, f"{modname}: collected no examples"
+    assert results.failed == 0, f"{modname}: {results.failed} failed"

@@ -336,6 +336,58 @@ class TestKerasConverter:
         got = np.asarray(model.forward(jnp.asarray(x), training=False))
         np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
 
+    def test_th_dim_ordering_convnet(self, tmp_path):
+        """Theano channels-first import (PY/keras/converter.py converts
+        both orderings): conv kernels transposed to NHWC and the
+        Flatten->Dense rows permuted; oracle = torch channels-first."""
+        h5py = pytest.importorskip("h5py")
+        torch = pytest.importorskip("torch")
+        cfg = {
+            "class_name": "Sequential",
+            "config": [
+                {"class_name": "Convolution2D", "config": {
+                    "name": "c1", "nb_filter": 4, "nb_row": 3, "nb_col": 3,
+                    "activation": "relu", "border_mode": "valid",
+                    "dim_ordering": "th",
+                    "batch_input_shape": [None, 2, 8, 8], "bias": True}},
+                {"class_name": "MaxPooling2D", "config": {
+                    "name": "p1", "pool_size": [2, 2],
+                    "dim_ordering": "th"}},
+                {"class_name": "Flatten", "config": {"name": "fl"}},
+                # weightless layer BETWEEN Flatten and Dense: the row
+                # permutation must still apply (regression: tracking only
+                # the immediately-previous layer missed this)
+                {"class_name": "Dropout", "config": {"name": "dr", "p": 0.5}},
+                {"class_name": "Dense", "config": {
+                    "name": "d1", "output_dim": 5, "bias": True}},
+            ],
+        }
+        jpath = tmp_path / "th.json"
+        jpath.write_text(json.dumps(cfg))
+        rng = np.random.RandomState(7)
+        Wc = rng.randn(4, 2, 3, 3).astype(np.float32)  # th conv layout
+        bc = rng.randn(4).astype(np.float32)
+        Wd = rng.randn(36, 5).astype(np.float32)  # rows in C,H,W order
+        bd = rng.randn(5).astype(np.float32)
+        _write_keras_h5(h5py, str(tmp_path / "th.h5"), [
+            ("c1", [("c1_W", Wc), ("c1_b", bc)]),
+            ("p1", []), ("fl", []), ("dr", []),
+            ("d1", [("d1_W", Wd), ("d1_b", bd)]),
+        ])
+        model = load_keras(str(jpath), str(tmp_path / "th.h5"))
+
+        x_chw = rng.randn(3, 2, 8, 8).astype(np.float32)
+        with torch.no_grad():
+            t = torch.nn.functional.conv2d(
+                torch.from_numpy(x_chw), torch.from_numpy(Wc),
+                torch.from_numpy(bc)).relu()
+            t = torch.nn.functional.max_pool2d(t, 2)
+            want = (t.flatten(1) @ torch.from_numpy(Wd)
+                    + torch.from_numpy(bd)).numpy()
+        x_hwc = np.transpose(x_chw, (0, 2, 3, 1))
+        got = np.asarray(model.forward(jnp.asarray(x_hwc), training=False))
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
 
 class TestReviewRegressions:
     def test_caffe_flatten_layer(self, tmp_path):
