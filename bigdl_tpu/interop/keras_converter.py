@@ -45,20 +45,39 @@ def load_keras(json_path: Optional[str] = None,
     return model
 
 
-def _detect_th(node) -> bool:
-    """True if any layer config declares Theano dim_ordering."""
+def _orderings(node, acc=None) -> set:
+    """Collect every declared dim_ordering value in the config tree."""
+    if acc is None:
+        acc = set()
     if isinstance(node, dict):
-        if node.get("dim_ordering") == "th":
-            return True
-        return any(_detect_th(v) for v in node.values())
-    if isinstance(node, list):
-        return any(_detect_th(v) for v in node)
-    return False
+        if "dim_ordering" in node:
+            acc.add(node["dim_ordering"])
+        for v in node.values():
+            _orderings(v, acc)
+    elif isinstance(node, list):
+        for v in node:
+            _orderings(v, acc)
+    return acc
+
+
+def _detect_th(node) -> bool:
+    """True if the model declares Theano dim_ordering. Keras 1 sets the
+    ordering model-globally from the backend config, so conversion is
+    model-global too; a model MIXING th and tf layers (legal but
+    pathological) is rejected rather than half-converted."""
+    seen = _orderings(node)
+    if "th" in seen and "tf" in seen:
+        raise ValueError(
+            "model mixes th and tf dim_ordering layers; per-layer mixed "
+            "ordering import is unsupported — re-save with one ordering")
+    return "th" in seen
 
 
 def _th_shape(shape):
-    """(C, H, W) -> (H, W, C) / (C, L) -> (L, C); rank-1 unchanged."""
-    if shape is None or len(shape) < 2:
+    """(C, H, W) -> (H, W, C). Only rank-3 (image) shapes rotate: a rank-2
+    shape is ambiguous between (C, L) conv1d input and (T, F) sequence
+    input, and rotating a sequence input would silently transpose it."""
+    if shape is None or len(shape) != 3:
         return shape
     return tuple(shape[1:]) + (shape[0],)
 
@@ -67,13 +86,14 @@ class DefinitionLoader:
     @staticmethod
     def from_config(config: Dict[str, Any]):
         cls = config["class_name"]
+        th = _detect_th(config)
         if cls == "Sequential":
             model = K.Sequential()
             layer_list = config["config"]
             if isinstance(layer_list, dict):  # keras2-style nesting
                 layer_list = layer_list.get("layers", [])
             for lc in layer_list:
-                layer = DefinitionLoader._layer(lc)
+                layer = DefinitionLoader._layer(lc, th=th)
                 if layer is not None:
                     model.add(layer)
             return model
@@ -95,7 +115,7 @@ class DefinitionLoader:
                     shape = _th_shape(shape)
                 tensors[name] = K.input_tensor(shape, name=name)
                 continue
-            layer = DefinitionLoader._layer(lc)
+            layer = DefinitionLoader._layer(lc, th=th)
             inbound = lc.get("inbound_nodes") or []
             refs = inbound[0] if inbound else []
             ins = [tensors[r[0]] for r in refs]
@@ -107,16 +127,18 @@ class DefinitionLoader:
                        output=outputs if len(outputs) > 1 else outputs[0])
 
     @staticmethod
-    def _layer(lc: Dict[str, Any]):
+    def _layer(lc: Dict[str, Any], th: bool = False):
         cls = lc["class_name"]
         cfg = dict(lc.get("config", {}))
         name = cfg.get("name")
-        th = cfg.get("dim_ordering") == "th"
         in_shape = cfg.get("batch_input_shape")
         input_shape = tuple(in_shape[1:]) if in_shape else None
         if th:
-            # channels-first model: build it channels-last; WeightLoader
-            # converts the kernels to match
+            # channels-first model (model-global in keras 1): build it
+            # channels-last; WeightLoader converts the kernels to match.
+            # `th` comes from the whole-config detection so layers whose
+            # config carries no dim_ordering key (Merge, Reshape, Dense)
+            # are still handled.
             input_shape = _th_shape(input_shape)
             if cls == "Merge" and cfg.get("concat_axis") == 1:
                 cfg["concat_axis"] = -1  # axis 1 = channels in th
@@ -253,7 +275,15 @@ class WeightLoader:
         # th conversion: remember the most recent Flatten's 3-D input shape
         # ACROSS weightless layers (Dropout/Activation commonly sit between
         # Flatten and the classifier Dense); any weighted layer consumes or
-        # invalidates it
+        # invalidates it. This linear scan is only sound on a Sequential
+        # chain — a branched graph's exec_order can interleave branches and
+        # pair a Dense with the wrong Flatten, so refuse loudly there.
+        if th and not hasattr(model, "_seq") and \
+                any(type(l).__name__ == "Flatten" for _, l in pairs):
+            raise ValueError(
+                "th-ordered functional models containing Flatten are "
+                "unsupported (branch-ambiguous Dense row permutation); "
+                "re-save with tf ordering")
         flatten_shape = None
         for key, layer in pairs:
             cls = type(layer).__name__

@@ -388,6 +388,49 @@ class TestKerasConverter:
         got = np.asarray(model.forward(jnp.asarray(x_hwc), training=False))
         np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
 
+    def test_th_guards(self):
+        """th edge cases fail loudly instead of converting silently:
+        mixed orderings, Reshape in a th model, and th functional models
+        with Flatten (branch-ambiguous Dense permutation)."""
+        from bigdl_tpu.interop.keras_converter import (DefinitionLoader,
+                                                       _detect_th)
+        conv_th = {"class_name": "Convolution2D", "config": {
+            "name": "c", "nb_filter": 2, "nb_row": 3, "nb_col": 3,
+            "dim_ordering": "th", "batch_input_shape": [None, 2, 8, 8]}}
+        conv_tf = {"class_name": "Convolution2D", "config": {
+            "name": "c2", "nb_filter": 2, "nb_row": 3, "nb_col": 3,
+            "dim_ordering": "tf"}}
+        with pytest.raises(ValueError, match="mixes th and tf"):
+            _detect_th({"class_name": "Sequential",
+                        "config": [conv_th, conv_tf]})
+        with pytest.raises(ValueError, match="Reshape"):
+            DefinitionLoader.from_config({
+                "class_name": "Sequential",
+                "config": [conv_th, {"class_name": "Reshape", "config": {
+                    "name": "r", "target_shape": [2, 36]}}]})
+        # Merge concat_axis=1 (channels in th) remaps to -1 even though
+        # Merge's own config has no dim_ordering key (model-global th)
+        merged = DefinitionLoader._layer(
+            {"class_name": "Merge",
+             "config": {"name": "m", "mode": "concat", "concat_axis": 1}},
+            th=True)
+        assert merged.concat_axis == -1
+
+    def test_th_functional_flatten_rejected(self, tmp_path):
+        h5py = pytest.importorskip("h5py")
+        from bigdl_tpu.interop.keras_converter import WeightLoader
+        import bigdl_tpu.keras as K
+        # build any functional model with a Flatten; th weight loading
+        # must refuse it (linear Flatten->Dense tracking is
+        # Sequential-only)
+        inp = K.input_tensor((4, 4, 2), name="in")
+        out = K.Dense(3, name="d1")(K.Flatten(name="fl")(inp))
+        model = K.Model(input=inp, output=out)
+        with pytest.raises(ValueError, match="functional models"):
+            WeightLoader._apply(model, {"d1": [np.zeros((32, 3),
+                                                        np.float32)]},
+                                th=True)
+
 
 class TestReviewRegressions:
     def test_caffe_flatten_layer(self, tmp_path):

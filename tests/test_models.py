@@ -12,7 +12,11 @@ from bigdl_tpu.models import (Autoencoder, Inception_v1, LeNet5, PTBModel,
 from bigdl_tpu.nn.module import functional_apply, param_count
 from bigdl_tpu.utils.table import T
 
-pytestmark = pytest.mark.slow  # full-size models / e2e training
+# Default tier: every zoo model is exercised by the recorded suite (all
+# tests here run in 2-20s on the 8-virtual-device CPU mesh). Only the
+# Inception family keeps the slow mark — its branchy 224px graph costs
+# 45-77s of pure XLA CPU compile, which no reduced shape avoids; its
+# building blocks are covered by test_inception_module_fast below.
 
 KEY = jax.random.PRNGKey(0)
 
@@ -40,6 +44,17 @@ class TestShapes:
         y = m.forward(jnp.ones((2, 32, 32, 3)))
         assert y.shape == (2, 10)
 
+    def test_inception_module_fast(self):
+        """Default-tier coverage of the Inception building block: a narrow
+        two-module stack forwards and matches the branch-concat width."""
+        from bigdl_tpu.models import inception_module
+        m = nn.Sequential()
+        m.add(inception_module(16, 8, 4, 8, 2, 4, 4, "a/"))
+        m.add(inception_module(24, 8, 4, 8, 2, 4, 4, "b/"))
+        y = m.forward(jnp.ones((1, 16, 16, 16)), training=False)
+        assert y.shape == (1, 16, 16, 24)  # 8+8+4+4 concat
+
+    @pytest.mark.slow
     def test_inception_v1(self):
         from bigdl_tpu.models import Inception_v1_NoAuxClassifier
         m = Inception_v1_NoAuxClassifier(1000)
@@ -51,6 +66,7 @@ class TestShapes:
         y = m.forward(jnp.ones((1, 224, 224, 3)), training=False)
         assert y.shape == (1, 1000)
 
+    @pytest.mark.slow
     def test_inception_v1_aux(self):
         m = Inception_v1(1000)
         n = param_count(m.init(KEY))
@@ -60,6 +76,7 @@ class TestShapes:
         y = m.forward(jnp.ones((1, 224, 224, 3)), training=False)
         assert y.shape == (1, 3000)  # concat(main, aux2, aux1)
 
+    @pytest.mark.slow
     def test_inception_v2(self):
         from bigdl_tpu.models import (Inception_v2,
                                       Inception_v2_NoAuxClassifier)
@@ -125,7 +142,8 @@ class TestShapes:
 class TestTrainStep:
     @pytest.mark.parametrize("build,x_shape,classes", [
         (lambda: ResNet(4, depth=18), (4, 32, 32, 3), 4),
-        (lambda: Inception_v1(4), (2, 224, 224, 3), 4),
+        pytest.param(lambda: Inception_v1(4), (2, 224, 224, 3), 4,
+                     marks=pytest.mark.slow),  # 77s pure XLA CPU compile
     ], ids=["resnet18", "inception"])
     def test_one_train_step(self, build, x_shape, classes):
         m = build()
