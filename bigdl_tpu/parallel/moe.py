@@ -1,10 +1,18 @@
 """Mixture-of-Experts with expert parallelism over an 'expert' mesh axis.
 
-Beyond-parity: top-1 (Switch) routing with capacity, experts sharded
-one-per-device, token exchange via `lax.all_to_all` — the ICI-native MoE
-dispatch (Mesh-TensorFlow / Switch-Transformer algorithm). The dense
-single-device `apply` is the numerical reference the expert-parallel path
-must match on undropped tokens.
+Beyond-parity (the reference scales only by data parallelism): top-1
+(Switch) or top-2 (GShard) routing with per-group capacity, experts
+sharded one-or-more-per-device, token exchange via `lax.all_to_all` — the
+ICI-native MoE dispatch (Mesh-TensorFlow / Switch-Transformer algorithm).
+The dense single-device `apply` is the numerical reference the
+expert-parallel path must match on undropped tokens.
+
+Training support: `apply_with_aux` returns the Switch load-balancing
+auxiliary loss (n_experts * sum_e f_e * P_e — minimized at uniform
+routing) plus routing statistics (per-expert load fraction, router
+entropy), so a training loop can add `aux_weight * aux_loss` to its
+objective and monitor balance; `tests/test_pipeline_moe.py` shows the
+loss actually balancing a skewed router.
 """
 
 from __future__ import annotations
@@ -21,18 +29,26 @@ from bigdl_tpu.nn.module import ApplyContext, Module
 
 
 class MoE(Module):
-    """Switch-style FFN MoE: router -> top-1 expert -> gated output.
+    """Switch/GShard-style FFN MoE: router -> top-k experts -> gated sum.
 
     params: router [d, E] + stacked expert FFNs (w1 [E, d, h], b1 [E, h],
     w2 [E, h, d], b2 [E, d]). `capacity_factor` bounds tokens per expert;
-    overflow tokens pass through unchanged (standard Switch behavior).
+    overflow tokens pass through with a zero expert contribution
+    (standard Switch behavior). `top_k` = 1 (Switch) or 2 (GShard; gates
+    renormalized over the chosen pair).
     """
 
     def __init__(self, d_model: int, d_hidden: int, n_experts: int,
-                 capacity_factor: float = 1.25, name=None):
+                 capacity_factor: float = 1.25, top_k: int = 1, name=None):
         super().__init__(name)
+        if top_k not in (1, 2):
+            raise ValueError(f"top_k must be 1 or 2, got {top_k}")
+        if top_k > n_experts:
+            raise ValueError(
+                f"top_k={top_k} exceeds n_experts={n_experts}")
         self.d, self.h, self.E = d_model, d_hidden, n_experts
         self.capacity_factor = capacity_factor
+        self.top_k = top_k
 
     def init(self, rng):
         k1, k2, k3 = jax.random.split(rng, 3)
@@ -50,12 +66,15 @@ class MoE(Module):
         }
 
     def _gates(self, params, x2d):
+        """Top-k routing: experts [T, k], gates [T, k] (sum to the top-k
+        mass, renormalized for k>1), probs [T, E] for the aux loss."""
         logits = x2d @ params["router"]
         probs = jax.nn.softmax(logits, axis=-1)
-        expert = jnp.argmax(probs, axis=-1)               # [T]
-        gate = jnp.take_along_axis(probs, expert[:, None],
-                                   axis=-1)[:, 0]         # [T]
-        return expert, gate
+        gate_vals, experts = lax.top_k(probs, self.top_k)   # [T, k]
+        if self.top_k > 1:
+            gate_vals = gate_vals / jnp.sum(gate_vals, axis=-1,
+                                            keepdims=True)
+        return experts, gate_vals, probs
 
     def _expert_ffn(self, params, e, tokens):
         h = jnp.maximum(tokens @ params["w1"][e] + params["b1"][e], 0.0)
@@ -63,16 +82,40 @@ class MoE(Module):
 
     # -- dense single-device reference ----------------------------------
     def apply(self, params, input, ctx: ApplyContext):
+        return self._dense(params, input)[0]
+
+    def _dense(self, params, input):
         shape = input.shape
         x2d = input.reshape(-1, self.d)
-        expert, gate = self._gates(params, x2d)
-        onehot = jax.nn.one_hot(expert, self.E, dtype=x2d.dtype)  # [T, E]
+        experts, gates, probs = self._gates(params, x2d)
         # run every expert on every token, select by routing (dense ref)
         h = jnp.einsum("td,edh->teh", x2d, params["w1"]) + params["b1"]
         h = jnp.maximum(h, 0.0)
         y_all = jnp.einsum("teh,ehd->ted", h, params["w2"]) + params["b2"]
-        y = jnp.einsum("ted,te->td", y_all, onehot)
-        return (gate[:, None] * y).reshape(shape)
+        y = jnp.zeros_like(x2d)
+        for k in range(self.top_k):  # static tiny loop
+            onehot = jax.nn.one_hot(experts[:, k], self.E, dtype=x2d.dtype)
+            y = y + gates[:, k, None] * jnp.einsum("ted,te->td", y_all,
+                                                   onehot)
+        return y.reshape(shape), (experts, probs)
+
+    def apply_with_aux(self, params, input):
+        """(output, aux) — aux carries the Switch load-balancing loss and
+        routing statistics. Add `weight * aux['aux_loss']` to the training
+        objective; it is minimized (value 1.0) at perfectly uniform
+        routing and grows as the router collapses onto few experts."""
+        y, (experts, probs) = self._dense(params, input)
+        # f_e: fraction of tokens whose TOP-1 choice is e (Switch §2.2);
+        # P_e: mean router probability mass on e
+        top1 = experts[:, 0]
+        f = jnp.mean(jax.nn.one_hot(top1, self.E, dtype=probs.dtype),
+                     axis=0)
+        p = jnp.mean(probs, axis=0)
+        aux_loss = self.E * jnp.sum(f * p)
+        entropy = -jnp.sum(f * jnp.log(f + 1e-9))
+        return y, {"aux_loss": aux_loss, "expert_fraction": f,
+                   "load_entropy": entropy,
+                   "max_load": jnp.max(f)}
 
     # -- expert-parallel execution --------------------------------------
     def expert_parallel_apply(self, mesh: Mesh, params, x):
@@ -80,8 +123,9 @@ class MoE(Module):
         experts per device; E divisible by the axis size). Tokens exchange
         with all_to_all; overflow beyond each expert's capacity drops to a
         zero contribution (Switch-Transformer semantics — the dense
-        reference matches on tokens within capacity)."""
-        E = self.E
+        reference matches on tokens within capacity). top_k routing
+        dispatches each (token, choice) pair as its own routing unit."""
+        E, K = self.E, self.top_k
         n_dev = int(dict(zip(mesh.axis_names,
                              mesh.devices.shape)).get("expert", 0))
         if n_dev == 0 or E % n_dev:
@@ -95,31 +139,34 @@ class MoE(Module):
                              f"'expert' axis size {n_dev}")
         # Switch/Mesh-TF capacity is PER GROUP (this device's tokens), so
         # buffers and all_to_all volume shrink as devices are added
-        cap = max(1, int(math.ceil(T / n_dev / E * self.capacity_factor)))
+        cap = max(1, int(math.ceil(T / n_dev / E * K *
+                                   self.capacity_factor)))
         moe = self
 
         def mapped(params_local, x_local):
             # params_local: this device's slice of each stacked expert
             # leaf [E/n_dev, ...]; router is replicated
             t_local = x_local.shape[0]
-            expert, gate = moe._gates(
+            experts, gates, _ = moe._gates(
                 {"router": params_local["router"]}, x_local)
-            # position of each token within its expert's capacity buffer
-            onehot = jax.nn.one_hot(expert, E, dtype=jnp.int32)  # [t, E]
+            # flatten the k choices into routing units [t*K] (k-major so
+            # every token's first choice claims capacity before any
+            # second choice — matches GShard's dispatch priority)
+            unit_expert = experts.T.reshape(-1)         # [K*t]
+            unit_gate = gates.T.reshape(-1)             # [K*t]
+            unit_x = jnp.tile(x_local, (K, 1))          # [K*t, d]
+            # position of each unit within its expert's capacity buffer
+            onehot = jax.nn.one_hot(unit_expert, E, dtype=jnp.int32)
             pos = jnp.cumsum(onehot, axis=0) * onehot            # 1-based
-            pos_in_e = jnp.sum(pos, axis=-1) - 1                 # [t]
+            pos_in_e = jnp.sum(pos, axis=-1) - 1                 # [K*t]
             keep = pos_in_e < cap
             # dispatch buffer [E, cap, d]
             disp = jnp.zeros((E, cap, moe.d), x_local.dtype)
-            disp = disp.at[expert, jnp.clip(pos_in_e, 0, cap - 1)].add(
-                jnp.where(keep[:, None], x_local, 0.0))
-            # exchange: split the expert dim across devices, gather the
-            # sender dim -> [n_dev * E/n_dev ... ] => view as
-            # [E/n_dev * n_dev, cap, d] with sender-major layout
+            disp = disp.at[unit_expert,
+                           jnp.clip(pos_in_e, 0, cap - 1)].add(
+                jnp.where(keep[:, None], unit_x, 0.0))
             recv = lax.all_to_all(disp, "expert", split_axis=0,
                                   concat_axis=0, tiled=True)
-            # recv: [E_local * n_dev? ...] -- with tiled=True the leading
-            # dim stays E: rows grouped by local expert x sender
             e_local = E // n_dev
             recv = recv.reshape(n_dev, e_local, cap, moe.d)
             out = jnp.zeros_like(recv)
@@ -131,11 +178,12 @@ class MoE(Module):
             back = lax.all_to_all(
                 out.reshape(E, cap, moe.d), "expert",
                 split_axis=0, concat_axis=0, tiled=True)
-            # gather each kept token's result from its (expert, pos) slot
+            # gather each kept unit's result from its (expert, pos) slot
             safe_pos = jnp.clip(pos_in_e, 0, cap - 1)
-            y_tok = back[expert, safe_pos]
-            y_tok = jnp.where(keep[:, None], y_tok, 0.0)
-            return gate[:, None] * y_tok
+            y_unit = back[unit_expert, safe_pos]
+            y_unit = jnp.where(keep[:, None], y_unit, 0.0)
+            y_unit = unit_gate[:, None] * y_unit
+            return jnp.sum(y_unit.reshape(K, t_local, moe.d), axis=0)
 
         from bigdl_tpu.parallel.mesh import get_shard_map
         shard_map = get_shard_map()
