@@ -27,9 +27,16 @@ def test_bench_lenet_host_pipeline_variant():
 
 def test_accel_probe_bounded():
     from bigdl_tpu.tools.bench_cli import _accel_responsive
-    # under the 8-CPU test env the probe sees a cpu backend -> False,
-    # quickly; the call must never hang
-    assert _accel_responsive(timeout_s=60.0) in (True, False)
+    # the probe subprocess inherits the REAL session backend (the axon
+    # sitecustomize overrides JAX_PLATFORMS), so against a healthy tunnel
+    # it answers True quickly and against a dead one it times out — the
+    # test only asserts the call is BOUNDED by its knobs, so pin a single
+    # short attempt with no backoff
+    import time as _time
+    t0 = _time.perf_counter()
+    result = _accel_responsive(timeout_s=45.0, attempts=1, backoff_s=0.0)
+    assert result in (True, False)
+    assert _time.perf_counter() - t0 < 60.0
 
 
 def test_metric_json_contract():

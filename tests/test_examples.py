@@ -32,6 +32,13 @@ class TestExamples:
                     "--hidden", "48", "--embed", "24"])
         assert ppl < 100  # vocab 200; chance ppl ~200, structure helps
 
+    def test_transformer_lm(self):
+        from examples.transformer_lm import main
+        ppl = main(["--max-iteration", "80", "--batch-size", "16",
+                    "--seq-len", "32", "--vocab", "100",
+                    "--long-len", "128", "--sequence-parallel", "ring"])
+        assert ppl < 40  # reaches ~11; chance is ~100
+
     def test_udfpredictor(self):
         from examples.udfpredictor import main
         acc = main(["--rows", "4"])
