@@ -29,7 +29,15 @@ def _check_border(border_mode):
 
 
 class Convolution2D(KerasLayer):
-    """(DL/nn/keras/Convolution2D.scala) input (H, W, C)."""
+    """(DL/nn/keras/Convolution2D.scala) input (H, W, C).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from bigdl_tpu.keras import Convolution2D
+        >>> conv = Convolution2D(8, 3, 3, input_shape=(16, 16, 3))
+        >>> conv.forward(jnp.ones((2, 16, 16, 3))).shape
+        (2, 14, 14, 8)
+    """
 
     def __init__(self, nb_filter: int, nb_row: int, nb_col: int,
                  activation=None, border_mode: str = "valid",

@@ -37,7 +37,15 @@ def _activation_fn(activation):
 
 
 class Dense(KerasLayer):
-    """(DL/nn/keras/Dense.scala) Fully connected over the last dim."""
+    """(DL/nn/keras/Dense.scala) Fully connected over the last dim.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from bigdl_tpu.keras import Dense
+        >>> layer = Dense(5, activation="relu", input_shape=(8,))
+        >>> layer.forward(jnp.ones((3, 8))).shape
+        (3, 5)
+    """
 
     def __init__(self, output_dim: int, activation=None, bias: bool = True,
                  W_regularizer=None, b_regularizer=None,

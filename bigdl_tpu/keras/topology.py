@@ -247,7 +247,17 @@ def _to_samples(x, y):
 
 
 class Sequential(KerasModel):
-    """Keras Sequential (DL/nn/keras/Topology.scala Sequential)."""
+    """Keras Sequential (DL/nn/keras/Topology.scala Sequential).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from bigdl_tpu.keras import Dense, Sequential
+        >>> m = Sequential().add(Dense(8, activation="relu",
+        ...                            input_shape=(4,))).add(Dense(2))
+        >>> _ = m.compile(optimizer="sgd", loss="mse")  # fluent: returns m
+        >>> m.forward(jnp.ones((3, 4))).shape
+        (3, 2)
+    """
 
     def __init__(self, name=None):
         super().__init__(name=name)

@@ -178,7 +178,14 @@ class Max(_Reduce):
 
 
 class ArgMax(Operation):
-    """Argmax along an axis, 0-based output (DL/nn/ops/ArgMax.scala)."""
+    """Argmax along an axis, 0-based output (DL/nn/ops/ArgMax.scala).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from bigdl_tpu.ops import ArgMax
+        >>> ArgMax(axis=1).forward(jnp.asarray([[1., 9., 2.]])).tolist()
+        [1]
+    """
 
     def __init__(self, axis: int = 0, name=None):
         super().__init__(name)

@@ -267,9 +267,7 @@ class DistriOptimizer(BaseOptimizer):
             return batch, x, y
 
         sync_every = max(1, int(getattr(self, "sync_interval", 1)))
-        window_records = 0
-        window_iters = 0
-        window_t0 = time.perf_counter()
+        win = self._SyncWindow()
         loss_val = float("nan")  # last synced loss
         loss = None  # device array of the most recent step's loss
         # device-resident rng chain, advanced inside the donated step; a
@@ -298,20 +296,18 @@ class DistriOptimizer(BaseOptimizer):
             driver_state["neval"] += 1
             driver_state["recordsProcessedThisEpoch"] += n
             driver_state["loss"] = loss_val
-            window_records += n
-            window_iters += 1
+            win.add(n)
             if do_sync:
                 # throughput + per-iteration compute time over the sync
                 # window: exact wall time between device-drained points,
                 # valid for any sync_interval (per iteration when 1,
-                # reference semantics). Recording the metric ONLY here
-                # keeps "computing time average" a true per-step figure —
-                # per-dispatch timing would be meaningless under async.
-                now = time.perf_counter()
-                throughput = window_records / max(now - window_t0, 1e-9)
-                self.metrics.add("computing time average",
-                                 (now - window_t0) / window_iters * 1e9)
-                window_records, window_iters, window_t0 = 0, 0, now
+                # reference semantics). The window counts ONLY
+                # dispatch+device time — it restarts after the
+                # validation/checkpoint/hook tail at the iteration end —
+                # and recording the metric only at sync keeps "computing
+                # time average" a true per-step figure (per-dispatch
+                # timing is meaningless under async).
+                throughput = win.throughput(self.metrics)
                 logger.info(
                     f"[Epoch {driver_state['epoch'] + 1} "
                     f"{driver_state['recordsProcessedThisEpoch']}/"
@@ -354,6 +350,8 @@ class DistriOptimizer(BaseOptimizer):
                                           opt_slots=opt_state)
             if self.iteration_hook is not None:
                 self.iteration_hook(driver_state)
+            if do_sync:
+                win.restart()  # exclude the tail work from the next window
 
         if sync_every > 1 and loss is not None and \
                 driver_state["neval"] % sync_every != 0:

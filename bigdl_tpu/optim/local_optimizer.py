@@ -212,6 +212,36 @@ class BaseOptimizer:
         return jax.tree_util.tree_map(cast, tree)
 
     # -- helpers --
+    class _SyncWindow:
+        """Throughput/compute-time bookkeeping over sync windows, shared
+        by the local and distributed loops. A window spans device-drained
+        point to device-drained point and counts ONLY the dispatch+device
+        portion of each iteration: `restart()` is called at the END of
+        the iteration body (after validation/checkpoint/summary/hooks),
+        so that host-side tail work never inflates the next window's
+        training throughput."""
+
+        def __init__(self):
+            self.records = 0
+            self.iters = 0
+            self.t0 = time.perf_counter()
+
+        def add(self, n: int):
+            self.records += n
+            self.iters += 1
+
+        def throughput(self, metrics) -> float:
+            """At a sync point: window throughput; records the
+            per-iteration compute-time metric."""
+            dt = max(time.perf_counter() - self.t0, 1e-9)
+            metrics.add("computing time average",
+                        dt / max(self.iters, 1) * 1e9)
+            return self.records / dt
+
+        def restart(self):
+            self.records, self.iters = 0, 0
+            self.t0 = time.perf_counter()
+
     def _clip_grads_expr(self, grads):
         """Build the clipping expression (traced under jit). Parity:
         ParameterOperations.scala:71 (constant) and :89 (global L2 norm)."""
@@ -339,34 +369,42 @@ class LocalOptimizer(BaseOptimizer):
                 y = _to_device(batch.get_target())
             return batch, x, y
 
+        sync_every = max(1, int(getattr(self, "sync_interval", 1)))
+        win = self._SyncWindow()
+        loss_val = float("nan")
+        loss = None
         pending = fetch_and_place()
         while pending is not None and not self.end_trigger(driver_state):
             batch, x, y = pending
             lr = self.optim_method.current_lr()
             self.rng, step_rng = jax.random.split(self.rng)
-            it_t0 = time.perf_counter_ns()
             params, opt_state, new_ms, loss = step(
                 params, opt_state, model_state, x, y, lr, step_rng)
             pending = fetch_and_place()  # overlaps the running step
-            loss = float(loss)  # sync: waits for the step to finish
-            self.metrics.add("computing time average",
-                             time.perf_counter_ns() - it_t0)
+            do_sync = (driver_state["neval"] + 1) % sync_every == 0
+            if do_sync:
+                loss_val = float(loss)  # waits for the step to finish
             model_state = merge_state(model_state, new_ms)
 
             n = batch.size()
             driver_state["neval"] += 1
             driver_state["recordsProcessedThisEpoch"] += n
-            driver_state["loss"] = loss
-            t = self.metrics.get("computing time average") / 1e9
-            throughput = n / max(t, 1e-9)
-            logger.info(
-                f"[Epoch {driver_state['epoch'] + 1} "
-                f"{driver_state['recordsProcessedThisEpoch']}/{epoch_size}]"
-                f"[Iteration {driver_state['neval']}] Training cost {loss}. "
-                f"Throughput is {throughput} records/second. ")
-            if self.train_summary is not None:
+            driver_state["loss"] = loss_val
+            win.add(n)
+            if do_sync:
+                # per-window figures: dispatch+device only (the window
+                # restarts AFTER the validation/checkpoint/hook tail)
+                throughput = win.throughput(self.metrics)
+                logger.info(
+                    f"[Epoch {driver_state['epoch'] + 1} "
+                    f"{driver_state['recordsProcessedThisEpoch']}/"
+                    f"{epoch_size}]"
+                    f"[Iteration {driver_state['neval']}] Training cost "
+                    f"{loss_val}. Throughput is {throughput} "
+                    f"records/second. ")
+            if do_sync and self.train_summary is not None:
                 it = driver_state["neval"]
-                self.train_summary.add_scalar("Loss", loss, it)
+                self.train_summary.add_scalar("Loss", loss_val, it)
                 self.train_summary.add_scalar(
                     "LearningRate",
                     float(np.mean([v for v in lr if v]) if any(lr) else 0.0)
@@ -397,7 +435,12 @@ class LocalOptimizer(BaseOptimizer):
                                       opt_slots=opt_state)
             if self.iteration_hook is not None:
                 self.iteration_hook(driver_state)
+            if do_sync:
+                win.restart()  # exclude the tail work from the next window
 
+        if sync_every > 1 and loss is not None and \
+                driver_state["neval"] % sync_every != 0:
+            driver_state["loss"] = float(loss)  # true final loss
         self.model.set_params(params)
         self.model._state = model_state
         return self.model

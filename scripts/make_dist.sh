@@ -1,0 +1,13 @@
+#!/usr/bin/env bash
+# Build a distributable wheel (the reference's make-dist.sh role,
+# SURVEY.md C40). Offline-friendly: no build isolation, no network.
+# The native host-runtime library is intentionally NOT bundled — it
+# builds on demand at first import wherever g++ exists, with a pure
+# python fallback (bigdl_tpu/native/__init__.py).
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+rm -rf dist
+python -m pip wheel . --no-deps --no-build-isolation -w dist/
+echo "wheel in dist/:"
+ls dist/

@@ -10,17 +10,24 @@ import pkgutil
 
 import pytest
 
+import bigdl_tpu.keras
 import bigdl_tpu.nn
+import bigdl_tpu.ops
+import bigdl_tpu.parallel
+
+_PACKAGES = (bigdl_tpu.nn, bigdl_tpu.keras, bigdl_tpu.ops,
+             bigdl_tpu.parallel)
 
 
 def _modules_with_doctests():
     names = []
-    for info in pkgutil.iter_modules(bigdl_tpu.nn.__path__,
-                                     prefix="bigdl_tpu.nn."):
-        mod = importlib.import_module(info.name)
-        finder = doctest.DocTestFinder(exclude_empty=True)
-        if any(t.examples for t in finder.find(mod)):
-            names.append(info.name)
+    for pkg in _PACKAGES:
+        for info in pkgutil.iter_modules(pkg.__path__,
+                                         prefix=pkg.__name__ + "."):
+            mod = importlib.import_module(info.name)
+            finder = doctest.DocTestFinder(exclude_empty=True)
+            if any(t.examples for t in finder.find(mod)):
+                names.append(info.name)
     return names
 
 

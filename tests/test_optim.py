@@ -285,6 +285,20 @@ class TestEndToEnd:
         for leaf in jax.tree_util.tree_leaves(trained.ensure_params()):
             assert leaf.dtype == jnp.float32
 
+    def test_local_optimizer_sync_interval(self):
+        """set_sync_interval works on the LOCAL loop too (it is a
+        BaseOptimizer knob): async windows, final loss surfaced."""
+        X, Y = self._mnist_like(128)
+        model = LeNet5(4)
+        o = optim.Optimizer(model, (X, Y), nn.ClassNLLCriterion(),
+                            batch_size=32, local=True)
+        o.set_optim_method(optim.Adam(learning_rate=3e-3))
+        o.set_sync_interval(4)
+        o.set_end_when(optim.max_iteration(30))  # not a sync multiple
+        o.optimize()
+        assert np.isfinite(o.optim_method.state["loss"])
+        assert o.optim_method.state["loss"] < 1.3  # dropped from ln(4)
+
     def test_distri_matches_local(self):
         """Same seed/data => distributed step == local step numerically."""
         X, Y = self._mnist_like(64)
