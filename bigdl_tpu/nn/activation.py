@@ -51,6 +51,15 @@ class ReLU6(_Elementwise):
 
 
 class Sigmoid(_Elementwise):
+    """1/(1+exp(-x)) (DL/nn/Sigmoid.scala).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from bigdl_tpu.nn import Sigmoid
+        >>> float(Sigmoid().forward(jnp.asarray([0.0]))[0])
+        0.5
+    """
+
     def fn(self, x):
         return jax.nn.sigmoid(x)
 
@@ -61,6 +70,15 @@ class LogSigmoid(_Elementwise):
 
 
 class Tanh(_Elementwise):
+    """Hyperbolic tangent (DL/nn/Tanh.scala).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from bigdl_tpu.nn import Tanh
+        >>> float(Tanh().forward(jnp.asarray([0.0]))[0])
+        0.0
+    """
+
     def fn(self, x):
         return jnp.tanh(x)
 
@@ -168,6 +186,16 @@ class Clamp(HardTanh):
 
 
 class SoftMax(_Elementwise):
+    """Softmax over the last axis (DL/nn/SoftMax.scala).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from bigdl_tpu.nn import SoftMax
+        >>> out = SoftMax().forward(jnp.asarray([[1.0, 2.0, 3.0]]))
+        >>> round(float(out.sum()), 5)
+        1.0
+    """
+
     def fn(self, x):
         return jax.nn.softmax(x, axis=-1)
 
@@ -178,6 +206,16 @@ class SoftMin(_Elementwise):
 
 
 class LogSoftMax(_Elementwise):
+    """log(softmax(x)) over the last axis (DL/nn/LogSoftMax.scala).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from bigdl_tpu.nn import LogSoftMax
+        >>> out = LogSoftMax().forward(jnp.ones((1, 4)))
+        >>> round(float(jnp.exp(out).sum()), 5)
+        1.0
+    """
+
     def fn(self, x):
         return jax.nn.log_softmax(x, axis=-1)
 

@@ -90,7 +90,16 @@ class Sequential(Container):
 
 
 class ConcatTable(Container):
-    """Apply each child to the same input, return a Table of outputs."""
+    """Apply each child to the same input, return a Table of outputs.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from bigdl_tpu.nn import ConcatTable, Linear
+        >>> m = ConcatTable().add(Linear(4, 2)).add(Linear(4, 3))
+        >>> out = m.forward(jnp.ones((1, 4)))
+        >>> (out[1].shape, out[2].shape)  # Table is 1-based
+        ((1, 2), (1, 3))
+    """
 
     def apply(self, params, input, ctx):
         return T(*[self._apply_child(i, params, input, ctx)
@@ -244,7 +253,15 @@ class CAveTable(Module):
 
 class JoinTable(Module):
     """Concatenate table elements along an axis (0-based; reference
-    `JoinTable` uses 1-based dimension + nInputDims)."""
+    `JoinTable` uses 1-based dimension + nInputDims).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from bigdl_tpu.nn import JoinTable
+        >>> from bigdl_tpu.utils.table import T
+        >>> JoinTable(1).forward(T(jnp.ones((2, 3)), jnp.ones((2, 5)))).shape
+        (2, 8)
+    """
 
     def __init__(self, axis: int = 1, name=None):
         super().__init__(name)

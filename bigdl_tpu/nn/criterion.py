@@ -105,7 +105,15 @@ class ClassNLLCriterion(Criterion):
 
 class CrossEntropyCriterion(Criterion):
     """Softmax + NLL fused (DL/nn/CrossEntropyCriterion.scala); input =
-    unnormalized logits."""
+    unnormalized logits.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from bigdl_tpu.nn import CrossEntropyCriterion
+        >>> crit = CrossEntropyCriterion()
+        >>> round(float(crit(jnp.zeros((1, 4)), jnp.asarray([2]))), 4)  # ln(4)
+        1.3863
+    """
     _target_is_elementwise = False
 
     def __init__(self, weights=None, size_average: bool = True, zero_based: bool = False):
@@ -117,6 +125,15 @@ class CrossEntropyCriterion(Criterion):
 
 
 class MSECriterion(Criterion):
+    """Mean squared error (DL/nn/MSECriterion.scala).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from bigdl_tpu.nn import MSECriterion
+        >>> float(MSECriterion()(jnp.asarray([1.0, 3.0]), jnp.asarray([1.0, 1.0])))
+        2.0
+    """
+
     def loss(self, output, target):
         d = output - target
         return jnp.mean(d * d) if self.size_average else jnp.sum(d * d)
