@@ -13,7 +13,9 @@
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
-python -m pip install -e . --quiet
+# --no-build-isolation: build with the ambient setuptools, no network
+# (zero-egress environments; matches scripts/make_dist.sh)
+python -m pip install -e . --no-build-isolation --quiet
 
 if command -v g++ >/dev/null 2>&1; then
   make -C native
