@@ -55,6 +55,14 @@ class MultiHeadAttention(Module):
 
     Input: [B, T, E] (self-attention) or Table(query [B,Tq,E],
     key_value [B,Tk,E]) for cross attention. bias optional; RoPE optional.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from bigdl_tpu.nn import MultiHeadAttention
+        >>> mha = MultiHeadAttention(32, n_head=4, causal=True,
+        ...                          use_flash=False)
+        >>> mha.forward(jnp.ones((2, 10, 32))).shape
+        (2, 10, 32)
     """
 
     def __init__(self, embed_dim: int, n_head: int, causal: bool = False,

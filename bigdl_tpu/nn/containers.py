@@ -357,6 +357,15 @@ class Graph(Container):
         out = Linear(4, 2).inputs(h)
         model = Graph([inp], [out])
 
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from bigdl_tpu.nn import Graph, InputNode, Linear, ReLU
+        >>> inp = InputNode()
+        >>> h = Linear(6, 4).inputs(inp)
+        >>> out = Linear(4, 2).inputs(ReLU().inputs(h))
+        >>> Graph([inp], [out]).forward(jnp.ones((3, 6))).shape
+        (3, 2)
+
     Execution order is a topo sort computed once at construction; under jit
     the whole DAG is traced into a single XLA computation, so there is no
     runtime scheduler (the reference's Scheduler/FrameManager dynamic path is
