@@ -285,6 +285,30 @@ class TestEndToEnd:
         for leaf in jax.tree_util.tree_leaves(trained.ensure_params()):
             assert leaf.dtype == jnp.float32
 
+    def test_sync_interval_with_validation_and_checkpoint(self, tmp_path):
+        """Async windows compose with validation and checkpointing: the
+        validation forward sees the chained (up-to-date) params even on
+        non-synced iterations, and checkpoints restore."""
+        X, Y = self._mnist_like(128)
+        model = LeNet5(4)
+        o = optim.Optimizer(model, (X, Y), nn.ClassNLLCriterion(),
+                            batch_size=32, local=False)
+        o.set_optim_method(optim.Adam(learning_rate=3e-3))
+        o.set_sync_interval(4)
+        from bigdl_tpu.optim.optimizer import _as_batched_dataset
+        o.set_validation(optim.several_iteration(3),  # fires OFF-sync
+                         _as_batched_dataset((X, Y), 64, False),
+                         [optim.Top1Accuracy()])
+        o.set_checkpoint(str(tmp_path / "ck"), optim.several_iteration(5))
+        o.set_end_when(optim.max_iteration(20))
+        o.optimize()
+        assert "score" in o.optim_method.state
+        from bigdl_tpu.serialization import latest_checkpoint, load_checkpoint
+        ck = latest_checkpoint(str(tmp_path / "ck"))
+        assert ck is not None
+        params, _, oblob = load_checkpoint(ck)
+        assert oblob["state"]["neval"] >= 5
+
     def test_local_optimizer_sync_interval(self):
         """set_sync_interval works on the LOCAL loop too (it is a
         BaseOptimizer knob): async windows, final loss surfaced."""
