@@ -150,6 +150,100 @@ def test_conv2d_matches_torch(stride, pad, groups):
     check_elementwise(m, torch_fn, x)
 
 
+def test_dilated_conv2d_matches_torch():
+    cin, cout, k, dil = 3, 5, 3, 2
+    m = nn.SpatialDilatedConvolution(cin, cout, k, k, 1, 1, 2, 2,
+                                     dilation_w=dil, dilation_h=dil)
+    w = RS.randn(k, k, cin, cout).astype(np.float32) * 0.3  # HWIO
+    b = RS.randn(cout).astype(np.float32)
+    m.set_params({"weight": jnp.asarray(w), "bias": jnp.asarray(b)})
+    x = RS.randn(2, 11, 11, cin).astype(np.float32)
+    tw = torch.tensor(np.transpose(w, (3, 2, 0, 1)))  # -> OIHW
+
+    def torch_fn(t):
+        y = F.conv2d(t.permute(0, 3, 1, 2), tw, torch.tensor(b),
+                     padding=2, dilation=dil)
+        return y.permute(0, 2, 3, 1)
+
+    check_elementwise(m, torch_fn, x)
+
+
+def test_full_conv2d_transposed_matches_torch():
+    """SpatialFullConvolution == torch conv_transpose2d."""
+    cin, cout, k, stride = 4, 3, 3, 2
+    m = nn.SpatialFullConvolution(cin, cout, k, k, stride, stride, 1, 1)
+    w = RS.randn(k, k, cout, cin).astype(np.float32) * 0.3  # HW-out-in
+    b = RS.randn(cout).astype(np.float32)
+    m.set_params({"weight": jnp.asarray(w), "bias": jnp.asarray(b)})
+    x = RS.randn(2, 6, 6, cin).astype(np.float32)
+    tw = torch.tensor(np.transpose(w, (3, 2, 0, 1)))  # -> (in, out, kH, kW)
+
+    def torch_fn(t):
+        y = F.conv_transpose2d(t.permute(0, 3, 1, 2), tw, torch.tensor(b),
+                               stride=stride, padding=1)
+        return y.permute(0, 2, 3, 1)
+
+    check_elementwise(m, torch_fn, x)
+
+
+def test_separable_conv2d_matches_torch():
+    """Depthwise (groups=C_in) then pointwise 1x1."""
+    cin, cout, mult, k = 3, 5, 2, 3
+    m = nn.SpatialSeparableConvolution(cin, cout, mult, k, k, 1, 1, 1, 1)
+    dw = RS.randn(k, k, 1, cin * mult).astype(np.float32) * 0.3
+    pw = RS.randn(1, 1, cin * mult, cout).astype(np.float32) * 0.3
+    b = RS.randn(cout).astype(np.float32)
+    m.set_params({"depth_weight": jnp.asarray(dw),
+                  "point_weight": jnp.asarray(pw), "bias": jnp.asarray(b)})
+    x = RS.randn(2, 8, 8, cin).astype(np.float32)
+    tdw = torch.tensor(np.transpose(dw, (3, 2, 0, 1)))  # (cin*mult,1,k,k)
+    tpw = torch.tensor(np.transpose(pw, (3, 2, 0, 1)))  # (cout,cin*mult,1,1)
+
+    def torch_fn(t):
+        y = F.conv2d(t.permute(0, 3, 1, 2), tdw, None, padding=1,
+                     groups=cin)
+        y = F.conv2d(y, tpw, torch.tensor(b))
+        return y.permute(0, 2, 3, 1)
+
+    check_elementwise(m, torch_fn, x)
+
+
+def test_temporal_conv1d_matches_torch():
+    cin, cout, k = 6, 4, 3
+    m = nn.TemporalConvolution(cin, cout, k, 2, pad=1, dilation=2)
+    w = RS.randn(k, cin, cout).astype(np.float32) * 0.3  # WIO
+    b = RS.randn(cout).astype(np.float32)
+    m.set_params({"weight": jnp.asarray(w), "bias": jnp.asarray(b)})
+    x = RS.randn(2, 12, cin).astype(np.float32)  # [B, T, C]
+    tw = torch.tensor(np.transpose(w, (2, 1, 0)))  # -> (out, in, k)
+
+    def torch_fn(t):
+        y = F.conv1d(t.permute(0, 2, 1), tw, torch.tensor(b), stride=2,
+                     padding=1, dilation=2)
+        return y.permute(0, 2, 1)
+
+    check_elementwise(m, torch_fn, x)
+
+
+def test_volumetric_conv3d_matches_torch():
+    cin, cout = 2, 3
+    m = nn.VolumetricConvolution(cin, cout, 3, 3, 2, 2, 1, 1, 1, 1, 0)
+    # ours DHWIO with k=(kt, kh, kw)=(3, 2, 3)
+    w = RS.randn(3, 2, 3, cin, cout).astype(np.float32) * 0.3
+    b = RS.randn(cout).astype(np.float32)
+    m.set_params({"weight": jnp.asarray(w), "bias": jnp.asarray(b)})
+    x = RS.randn(2, 7, 6, 8, cin).astype(np.float32)  # NDHWC
+    tw = torch.tensor(np.transpose(w, (4, 3, 0, 1, 2)))  # (out,in,kt,kh,kw)
+
+    def torch_fn(t):
+        # ours: dt=2 dw=1 dh=1, pad_t=1 pad_w=1 pad_h=0 -> torch (D, H, W)
+        y = F.conv3d(t.permute(0, 4, 1, 2, 3), tw, torch.tensor(b),
+                     stride=(2, 1, 1), padding=(1, 0, 1))
+        return y.permute(0, 2, 3, 4, 1)
+
+    check_elementwise(m, torch_fn, x)
+
+
 def test_conv2d_valid_rect_matches_torch():
     m = nn.SpatialConvolution(3, 5, 3, 2, 2, 1)  # kw=3 kh=2 sw=2 sh=1
     w = RS.randn(2, 3, 3, 5).astype(np.float32) * 0.3  # HWIO
