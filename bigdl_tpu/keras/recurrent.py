@@ -47,6 +47,20 @@ class SimpleRNN(_KerasRecurrent):
 
 
 class LSTM(_KerasRecurrent):
+    """Keras-style LSTM over [B, T, D] (reference PY/keras layer surface).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from bigdl_tpu.keras import LSTM, Sequential
+        >>> m = Sequential().add(LSTM(8, input_shape=(5, 4)))
+        >>> m.forward(jnp.ones((2, 5, 4))).shape  # last hidden state
+        (2, 8)
+        >>> m2 = Sequential().add(LSTM(8, return_sequences=True,
+        ...                            input_shape=(5, 4)))
+        >>> m2.forward(jnp.ones((2, 5, 4))).shape
+        (2, 5, 8)
+    """
+
     def _make_cell(self, input_dim):
         from bigdl_tpu.keras.layers import _activation_fn
         return nn.LSTMCell(input_dim, self.output_dim,

@@ -42,7 +42,15 @@ def bbox_area(boxes: jnp.ndarray) -> jnp.ndarray:
 
 
 def bbox_iou(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
-    """Pairwise IoU matrix [Na, Nb] of corner boxes (BboxUtil.jaccard)."""
+    """Pairwise IoU matrix [Na, Nb] of corner boxes (BboxUtil.jaccard).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> a = jnp.asarray([[0.0, 0.0, 9.0, 9.0]])
+        >>> b = jnp.asarray([[0.0, 0.0, 9.0, 9.0], [20.0, 20.0, 29.0, 29.0]])
+        >>> bbox_iou(a, b).round(2).tolist()  # identical box, disjoint box
+        [[1.0, 0.0]]
+    """
     lt = jnp.maximum(a[:, None, :2], b[None, :, :2])
     rb = jnp.minimum(a[:, None, 2:], b[None, :, 2:])
     wh = jnp.maximum(rb - lt + 1.0, 0.0)
@@ -86,6 +94,14 @@ def nms_mask(boxes: jnp.ndarray, scores: jnp.ndarray, iou_threshold: float,
     IoU matrix is computed once; the sequential greedy dependency runs in a
     `lax.fori_loop` over the score ranking (static trip count), which XLA
     unrolls on-device — no host sync, no dynamic shapes.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> boxes = jnp.asarray([[0.0, 0.0, 9.0, 9.0],   # kept (top score)
+        ...                      [1.0, 1.0, 10.0, 10.0], # suppressed by #0
+        ...                      [20.0, 20.0, 29.0, 29.0]])  # disjoint: kept
+        >>> nms_mask(boxes, jnp.asarray([0.9, 0.8, 0.7]), 0.5).tolist()
+        [True, False, True]
     """
     n = boxes.shape[0]
     order = jnp.argsort(-scores)
@@ -106,7 +122,16 @@ def nms_mask(boxes: jnp.ndarray, scores: jnp.ndarray, iou_threshold: float,
 
 class Nms(Module):
     """Standalone NMS layer (DL/nn/Nms.scala). Input: Table(boxes [N,4],
-    scores [N]); output: keep mask [N] (fixed shape, see module docstring)."""
+    scores [N]); output: keep mask [N] (fixed shape, see module docstring).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from bigdl_tpu.nn.detection import Nms
+        >>> from bigdl_tpu.utils.table import T
+        >>> boxes = jnp.asarray([[0.0, 0.0, 9.0, 9.0], [1.0, 1.0, 10.0, 10.0]])
+        >>> Nms(0.5).forward(T(boxes, jnp.asarray([0.9, 0.8]))).tolist()
+        [True, False]
+    """
 
     def __init__(self, iou_threshold: float = 0.7, name=None):
         super().__init__(name)

@@ -304,6 +304,28 @@ class Scheduler:
         return True
 
 
+class _DynamicGraphDocExamples:
+    """Executable example for the control-flow surface (kept on a helper so
+    the Graph subclass docstring below stays focused on semantics).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> import bigdl_tpu.nn as nn
+        >>> from bigdl_tpu.nn.dynamic_graph import switch_port
+        >>> from bigdl_tpu.utils.table import T
+        >>> x_in, p_in = nn.InputNode(), nn.InputNode()
+        >>> sw = nn.SwitchOps().inputs(x_in, p_in)
+        >>> true_b = switch_port(nn.MulConstant(2.0).inputs(sw), sw, 1)
+        >>> false_b = switch_port(nn.AddConstant(10.0).inputs(sw), sw, 0)
+        >>> merge = nn.MergeOps().inputs(true_b, false_b)
+        >>> g = nn.DynamicGraph([x_in, p_in], [merge])
+        >>> g.forward(T(jnp.asarray([3.0]), jnp.asarray(True))).tolist()
+        [6.0]
+        >>> g.forward(T(jnp.asarray([3.0]), jnp.asarray(False))).tolist()
+        [13.0]
+    """
+
+
 class DynamicGraph(Graph):
     """Graph that executes control ops (DL/nn/DynamicGraph.scala). Build
     with the same node DSL as Graph; back edges (NextIteration -> Merge)

@@ -172,7 +172,19 @@ def _iter_tree(module):
 
 class Quantizer:
     """Walk a trained model and swap supported layers for int8 versions
-    (reference Quantizer.scala, user surface `module.quantize()`)."""
+    (reference Quantizer.scala, user surface `module.quantize()`).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from bigdl_tpu.nn import Linear
+        >>> from bigdl_tpu.nn.quantized import Quantizer
+        >>> m = Linear(4, 2)
+        >>> q = Quantizer.quantize(m)  # m stays fp32 and trainable
+        >>> type(q).__name__
+        'QuantizedLinear'
+        >>> q.forward(jnp.ones((3, 4))).shape
+        (3, 2)
+    """
 
     QUANTIZABLE = ("Linear", "SpatialConvolution", "SpatialDilatedConvolution")
 

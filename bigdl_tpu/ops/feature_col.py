@@ -29,7 +29,15 @@ def _stable_hash(s: str, buckets: int) -> int:
 
 class BucketizedCol(Operation):
     """Bucketize numeric features by boundaries
-    (DL/nn/ops/BucketizedCol.scala): output = #boundaries crossed."""
+    (DL/nn/ops/BucketizedCol.scala): output = #boundaries crossed.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from bigdl_tpu.ops import BucketizedCol
+        >>> col = BucketizedCol(boundaries=[0.0, 10.0, 100.0])
+        >>> col.forward(jnp.asarray([[-1.0, 15.0], [5.0, 200.0]])).tolist()
+        [[0, 2], [1, 3]]
+    """
 
     def __init__(self, boundaries: Sequence[float], name=None):
         super().__init__(name)
@@ -57,7 +65,15 @@ class CategoricalColHashBucket(Operation):
 class CategoricalColVocaList(Operation):
     """String column -> vocabulary index
     (DL/nn/ops/CategoricalColVocaList.scala). Unknowns map to
-    `default_value` or hash into `num_oov_buckets` past the vocab."""
+    `default_value` or hash into `num_oov_buckets` past the vocab.
+
+    Example:
+        >>> import numpy as np
+        >>> from bigdl_tpu.ops import CategoricalColVocaList
+        >>> col = CategoricalColVocaList(["cat", "dog"], default_value=-1)
+        >>> col.forward(np.array(["dog", "cat", "fish"])).tolist()
+        [1, 0, -1]
+    """
 
     def __init__(self, vocab: Sequence[str], default_value: int = -1,
                  num_oov_buckets: int = 0, name=None):

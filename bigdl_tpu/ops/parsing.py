@@ -102,7 +102,14 @@ class DecodeGif(Operation):
 
 class DecodeRaw(Operation):
     """TF `DecodeRaw` (loaders/DecodeRaw.scala): bytes -> fixed-dtype
-    vector; vectorizes over a batch of strings ([...] -> [..., N])."""
+    vector; vectorizes over a batch of strings ([...] -> [..., N]).
+
+    Example:
+        >>> import numpy as np
+        >>> from bigdl_tpu.ops import DecodeRaw
+        >>> DecodeRaw("int16").forward(np.int16([1, 2, 3]).tobytes()).tolist()
+        [1, 2, 3]
+    """
 
     def __init__(self, out_type="float32", little_endian: bool = True,
                  name=None):

@@ -23,7 +23,15 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 def build_mesh(data: Optional[int] = None, model: int = 1,
                devices: Optional[Sequence] = None) -> Mesh:
-    """Build a (data, model) mesh over the available devices."""
+    """Build a (data, model) mesh over the available devices.
+
+    Example:
+        >>> import jax
+        >>> from bigdl_tpu.parallel.mesh import build_mesh
+        >>> mesh = build_mesh(data=2, model=1, devices=jax.devices()[:2])
+        >>> mesh.axis_names, mesh.devices.shape
+        (('data', 'model'), (2, 1))
+    """
     devices = list(devices if devices is not None else jax.devices())
     n = len(devices)
     if data is None:

@@ -24,6 +24,19 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 
 class ShardingRules:
+    """Name/shape-driven parameter placement policy for the 'model' axis.
+
+    Example:
+        >>> from bigdl_tpu.parallel.sharding import ShardingRules
+        >>> rules = ShardingRules(min_shard_dim=256)
+        >>> rules.spec_for(("fc", "weight"), (512, 512), model_axis_size=2)
+        PartitionSpec(None, 'model')
+        >>> rules.spec_for(("fc", "bias"), (512,), model_axis_size=2)
+        PartitionSpec()
+        >>> rules.spec_for(("fc", "weight"), (512, 512), model_axis_size=1)
+        PartitionSpec()
+    """
+
     def __init__(self, min_shard_dim: int = 256, shard_embeddings: bool = True):
         self.min_shard_dim = min_shard_dim
         self.shard_embeddings = shard_embeddings
