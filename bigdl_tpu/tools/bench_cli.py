@@ -379,17 +379,6 @@ def _accel_responsive(timeout_s: float = 150.0, attempts: int = 4,
 
 def main():
     logging.getLogger("bigdl_tpu.optim").setLevel(logging.WARNING)
-    # persistent compile cache: ResNet-50's first XLA compile on the
-    # tunneled chip costs minutes; re-runs (driver + manual) should not
-    # pay it twice. Harmless on CPU fallback.
-    try:
-        import jax
-        jax.config.update("jax_compilation_cache_dir",
-                          os.environ.get("BIGDL_TPU_COMPILE_CACHE",
-                                         "/tmp/bigdl_tpu_jaxcache"))
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 5)
-    except Exception:
-        pass
     accel_ok = _accel_responsive()
     if not accel_ok:
         # dead/absent accelerator: pin to CPU BEFORE the first backend
@@ -403,6 +392,18 @@ def main():
         print("accelerator unresponsive; falling back to CPU LeNet bench",
               file=sys.stderr)
     import jax
+    # persistent compile cache: ResNet-50's first XLA compile on the
+    # tunneled chip costs minutes; re-runs (driver + manual) should not
+    # pay it twice. Harmless on CPU fallback. Must stay AFTER the CPU-pin
+    # above: importing jax any earlier would freeze JAX_PLATFORMS before
+    # the fallback path can set it.
+    try:
+        jax.config.update("jax_compilation_cache_dir",
+                          os.environ.get("BIGDL_TPU_COMPILE_CACHE",
+                                         "/tmp/bigdl_tpu_jaxcache"))
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 5)
+    except Exception:
+        pass
     dev = jax.devices()[0]
     n_dev = jax.device_count()
     on_accel = accel_ok and dev.platform not in ("cpu",)
