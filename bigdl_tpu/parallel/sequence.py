@@ -97,7 +97,23 @@ def make_sequence_parallel_attention(mesh: Mesh, scheme: str = "ring",
                                      axis_name: str = "data",
                                      causal: bool = False):
     """Build a jit-ready fn(q, k, v) -> out with q,k,v sequence-sharded over
-    `axis_name`. q,k,v/out are [B,H,T,D] global arrays."""
+    `axis_name`. q,k,v/out are [B,H,T,D] global arrays.
+
+    Example (ring attention over 4 devices == single-device attention):
+        >>> import jax, numpy as np
+        >>> import jax.numpy as jnp
+        >>> from jax.sharding import Mesh
+        >>> from bigdl_tpu.parallel.sequence import (
+        ...     make_sequence_parallel_attention)
+        >>> from bigdl_tpu.ops.attention_kernel import naive_attention
+        >>> mesh = Mesh(np.array(jax.devices()[:4]), ("data",))
+        >>> attn = make_sequence_parallel_attention(mesh, "ring")
+        >>> ks = jax.random.split(jax.random.PRNGKey(0), 3)
+        >>> q, k, v = (jax.random.normal(kk, (1, 2, 16, 8)) for kk in ks)
+        >>> bool(jnp.allclose(attn(q, k, v), naive_attention(q, k, v),
+        ...                   atol=1e-5))
+        True
+    """
     try:
         from jax import shard_map
     except ImportError:  # older jax
