@@ -377,7 +377,73 @@ def _accel_responsive(timeout_s: float = 150.0, attempts: int = 4,
     return False
 
 
+def _run_secondary(name: str, timeout_s: float):
+    """Run one secondary suite in a SUBPROCESS with a hard timeout.
+
+    Observed failure mode (2026-07-31 live session): the tunneled backend
+    can wedge mid-compile — 0% host CPU, no progress, no exception — which
+    would stall the whole once-per-round bench. The headline has already
+    been flushed to stdout by the time secondaries run; a stuck secondary
+    must cost a bounded amount of wall-clock, not the round. The child
+    re-pays backend init (~30 s), which the persistent compile cache keeps
+    cheap for repeat shapes."""
+    import subprocess
+    cmd = [sys.executable, "-m", "bigdl_tpu.tools.bench_cli",
+           "--secondary", name]
+    # the package may not be pip-installed (driver runs repo-root
+    # bench.py); make the child's -m lookup independent of cwd
+    repo_root = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    env = dict(os.environ)
+    env["PYTHONPATH"] = repo_root + (
+        os.pathsep + env["PYTHONPATH"] if env.get("PYTHONPATH") else "")
+    try:
+        r = subprocess.run(cmd, timeout=timeout_s, capture_output=True,
+                           text=True, env=env)
+        sys.stderr.write(r.stderr or "")
+        if r.returncode != 0:
+            print(f"secondary '{name}' exited rc={r.returncode}",
+                  file=sys.stderr)
+    except subprocess.TimeoutExpired as e:
+        err = e.stderr
+        if err:
+            sys.stderr.write(err if isinstance(err, str)
+                             else err.decode(errors="replace"))
+        print(f"secondary '{name}' timed out after {timeout_s:.0f}s "
+              f"(tunnel stall?); figures above are partial", file=sys.stderr)
+
+
+def _configure_compile_cache():
+    """Persistent XLA compile cache (shared parent/child): first ResNet-50
+    compile on the tunneled chip costs minutes; nobody should pay it twice.
+    Must only run AFTER any JAX_PLATFORMS pinning — importing jax freezes
+    the platform choice."""
+    import jax
+    try:
+        jax.config.update("jax_compilation_cache_dir",
+                          os.environ.get("BIGDL_TPU_COMPILE_CACHE",
+                                         "/tmp/bigdl_tpu_jaxcache"))
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 5)
+    except Exception:
+        pass
+
+
+def _secondary_main(name: str):
+    """Child-process entry for one secondary suite (no probe, no headline)."""
+    logging.getLogger("bigdl_tpu.optim").setLevel(logging.WARNING)
+    _configure_compile_cache()
+    if name == "attention":
+        bench_attention()
+    elif name == "configs":
+        bench_baseline_configs()
+    else:
+        raise SystemExit(f"unknown secondary {name!r}")
+
+
 def main():
+    if len(sys.argv) >= 3 and sys.argv[1] == "--secondary":
+        _secondary_main(sys.argv[2])
+        return
     logging.getLogger("bigdl_tpu.optim").setLevel(logging.WARNING)
     accel_ok = _accel_responsive()
     if not accel_ok:
@@ -392,18 +458,7 @@ def main():
         print("accelerator unresponsive; falling back to CPU LeNet bench",
               file=sys.stderr)
     import jax
-    # persistent compile cache: ResNet-50's first XLA compile on the
-    # tunneled chip costs minutes; re-runs (driver + manual) should not
-    # pay it twice. Harmless on CPU fallback. Must stay AFTER the CPU-pin
-    # above: importing jax any earlier would freeze JAX_PLATFORMS before
-    # the fallback path can set it.
-    try:
-        jax.config.update("jax_compilation_cache_dir",
-                          os.environ.get("BIGDL_TPU_COMPILE_CACHE",
-                                         "/tmp/bigdl_tpu_jaxcache"))
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 5)
-    except Exception:
-        pass
+    _configure_compile_cache()  # AFTER the CPU pin above, by contract
     dev = jax.devices()[0]
     n_dev = jax.device_count()
     on_accel = accel_ok and dev.platform not in ("cpu",)
@@ -461,14 +516,16 @@ def main():
                   f"{host_tp / n_dev:.1f} imgs/sec/chip", file=sys.stderr)
         except Exception:
             pass
-        try:  # secondary figures: long-context attention + transformer LM
-            bench_attention()
-        except Exception as e:
-            print(f"attention bench failed: {e!r}", file=sys.stderr)
-        try:  # remaining BASELINE.md configs (2-5): one line each
-            bench_baseline_configs()
-        except Exception as e:
-            print(f"baseline-config bench failed: {e!r}", file=sys.stderr)
+        # long-context attention + transformer LM, then the remaining
+        # BASELINE.md configs — each in a watchdogged subprocess so a
+        # wedged tunnel costs bounded wall-clock (see _run_secondary)
+        try:
+            budget = float(os.environ.get("BIGDL_TPU_SECONDARY_TIMEOUT",
+                                          "900"))
+        except ValueError:
+            budget = 900.0
+        _run_secondary("attention", budget)
+        _run_secondary("configs", budget)
 
 
 if __name__ == "__main__":
