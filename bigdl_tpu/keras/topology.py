@@ -111,7 +111,8 @@ def resolve_optim_method(o) -> optim.SGD:
         table = {"sgd": lambda: optim.SGD(learning_rate=0.01),
                  "adam": optim.Adam, "adagrad": optim.Adagrad,
                  "adadelta": optim.Adadelta, "adamax": optim.Adamax,
-                 "rmsprop": optim.RMSprop}
+                 "rmsprop": optim.RMSprop, "adamw": optim.AdamW,
+                 "lamb": optim.LAMB}
         if o.lower() not in table:
             raise ValueError(f"unknown optimizer '{o}'")
         return table[o.lower()]()

@@ -1,9 +1,10 @@
 """bigdl_tpu.optim — optimization methods, training loops, validation."""
 
 from bigdl_tpu.optim.optim_method import (CompositeOptimMethod,
-                                          SGD, Adadelta, Adagrad, Adam, Adamax,
-                                          Ftrl, LBFGS, OptimMethod,
-                                          ParallelAdam, RMSprop)
+                                          SGD, Adadelta, Adagrad, Adam,
+                                          AdamW, Adamax, Ftrl, LAMB, LBFGS,
+                                          OptimMethod, ParallelAdam,
+                                          RMSprop)
 from bigdl_tpu.optim import schedules
 from bigdl_tpu.optim.schedules import (Default, EpochDecay,
                                        EpochDecayWithWarmUp, EpochSchedule,

@@ -83,6 +83,76 @@ class TestOptimMethods:
         np.testing.assert_allclose(np.asarray(p1["w"]), tp.detach().numpy(),
                                    rtol=1e-5, atol=1e-6)
 
+    def test_adamw_vs_torch_multistep(self):
+        """Decoupled decay over SEVERAL steps (one step cannot distinguish
+        AdamW from Adam+L2 strongly; five can)."""
+        torch = pytest.importorskip("torch")
+        m = optim.AdamW(learning_rate=0.05, weight_decay=0.1)
+        p = {"w": jnp.asarray([1.0, -2.0, 3.0])}
+        s = m.init_state(p)
+        tp = torch.tensor([1.0, -2.0, 3.0], requires_grad=True)
+        topt = torch.optim.AdamW([tp], lr=0.05, weight_decay=0.1)
+        rs = np.random.RandomState(0)
+        for _ in range(5):
+            g = rs.randn(3).astype(np.float32)
+            p, s = m.update({"w": jnp.asarray(g)}, s, p, 0.05)
+            tp.grad = torch.tensor(g)
+            topt.step()
+        np.testing.assert_allclose(np.asarray(p["w"]), tp.detach().numpy(),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_adamw_differs_from_adam_l2(self):
+        """The decoupled decay must NOT equal Adam's gradient-side L2."""
+        g = {"w": jnp.asarray([0.5, -1.0])}
+        p0 = {"w": jnp.asarray([2.0, 2.0])}
+        a = optim.Adam(learning_rate=0.1, weight_decay=0.1)
+        w = optim.AdamW(learning_rate=0.1, weight_decay=0.1)
+        pa, _ = a.update(g, a.init_state(p0), p0, 0.1)
+        pw, _ = w.update(g, w.init_state(p0), p0, 0.1)
+        assert float(jnp.abs(pa["w"] - pw["w"]).max()) > 1e-4
+
+    def test_lamb_matches_numpy_rederivation(self):
+        """LAMB per-leaf trust-ratio update re-derived step by step in
+        numpy (no torch LAMB to oracle against; You et al. 2019 eqns)."""
+        lr, b1, b2, eps, wd = 0.1, 0.9, 0.999, 1e-6, 0.01
+        m = optim.LAMB(learning_rate=lr, beta1=b1, beta2=b2, epsilon=eps,
+                       weight_decay=wd)
+        p = {"w": jnp.asarray([1.0, -2.0, 0.5])}
+        s = m.init_state(p)
+        pn = np.array([1.0, -2.0, 0.5], np.float64)
+        mn = np.zeros(3)
+        vn = np.zeros(3)
+        rs = np.random.RandomState(3)
+        for t in range(1, 5):
+            g = rs.randn(3).astype(np.float32)
+            p, s = m.update({"w": jnp.asarray(g)}, s, p, lr)
+            gn = g.astype(np.float64)
+            mn = b1 * mn + (1 - b1) * gn
+            vn = b2 * vn + (1 - b2) * gn * gn
+            r = (mn / (1 - b1 ** t)) / (np.sqrt(vn / (1 - b2 ** t)) + eps)
+            r = r + wd * pn
+            trust = np.linalg.norm(pn) / np.linalg.norm(r)
+            pn = pn - lr * trust * r
+        np.testing.assert_allclose(np.asarray(p["w"]), pn, rtol=1e-5)
+
+    def test_lamb_trust_ratio_one_for_zero_params(self):
+        """phi: zero-norm leaves fall back to the plain Adam step."""
+        m = optim.LAMB(learning_rate=0.5)
+        p = {"w": jnp.zeros((2,))}
+        g = {"w": jnp.asarray([1.0, 1.0])}
+        p2, _ = m.update(g, m.init_state(p), p, 0.5)
+        # bias-corrected first step of Adam: r ~ g/|g| elementwise = 1
+        np.testing.assert_allclose(np.asarray(p2["w"]), [-0.5, -0.5],
+                                   rtol=1e-4)
+
+    @pytest.mark.parametrize("method", [
+        optim.AdamW(learning_rate=0.1, weight_decay=0.01),
+        optim.LAMB(learning_rate=0.3),
+    ], ids=["adamw", "lamb"])
+    def test_large_batch_methods_converge(self, method):
+        losses = quad_problem(method, steps=60)
+        assert losses[-1] < losses[0] * 0.1, (losses[0], losses[-1])
+
     def test_weight_decay(self):
         m = optim.SGD(learning_rate=1.0, weight_decay=0.1)
         p = {"w": jnp.ones((2,))}
