@@ -6,8 +6,9 @@ from bigdl_tpu.optim.optim_method import (CompositeOptimMethod,
                                           OptimMethod, ParallelAdam,
                                           RMSprop)
 from bigdl_tpu.optim import schedules
-from bigdl_tpu.optim.schedules import (Default, EpochDecay,
+from bigdl_tpu.optim.schedules import (CosineDecay, Default, EpochDecay,
                                        EpochDecayWithWarmUp, EpochSchedule,
+                                       WarmupCosineDecay,
                                        EpochStep, Exponential,
                                        LearningRateSchedule, MultiStep,
                                        NaturalExp, Plateau, Poly, Regime,

@@ -222,6 +222,54 @@ class SequentialSchedule(LearningRateSchedule):
         return optim.learning_rate
 
 
+class CosineDecay(LearningRateSchedule):
+    """Half-cosine from lr to lr*alpha over `decay_iteration` steps
+    (Loshchilov & Hutter SGDR, without restarts). Beyond reference parity.
+    After `decay_iteration` the rate holds at lr*alpha. For the
+    warmup-then-cosine transformer recipe use `WarmupCosineDecay` — chaining
+    `Warmup` into this schedule via SequentialSchedule leaves a
+    discontinuity (Warmup ends at lr+delta*w, this restarts from lr)."""
+
+    def __init__(self, decay_iteration: int, alpha: float = 0.0):
+        if decay_iteration < 1:
+            raise ValueError(
+                f"decay_iteration must be >= 1, got {decay_iteration}")
+        self.decay_iteration = decay_iteration
+        self.alpha = alpha
+
+    def compute(self, optim):
+        n = min(optim.state["neval"], self.decay_iteration)
+        cos = 0.5 * (1 + math.cos(math.pi * n / self.decay_iteration))
+        return optim.learning_rate * (self.alpha + (1 - self.alpha) * cos)
+
+
+class WarmupCosineDecay(LearningRateSchedule):
+    """Linear ramp 0 -> lr over `warmup_iteration`, then half-cosine
+    lr -> lr*alpha through `total_iteration` (beyond reference parity: the
+    standard AdamW/LAMB transformer recipe as ONE continuous schedule —
+    the optimizer's learning_rate is the PEAK)."""
+
+    def __init__(self, warmup_iteration: int, total_iteration: int,
+                 alpha: float = 0.0):
+        if not 0 <= warmup_iteration < total_iteration:
+            raise ValueError(
+                f"need 0 <= warmup ({warmup_iteration}) < total "
+                f"({total_iteration})")
+        self.warmup_iteration = warmup_iteration
+        self.total_iteration = total_iteration
+        self.alpha = alpha
+
+    def compute(self, optim):
+        n = optim.state["neval"]
+        w = self.warmup_iteration
+        if w > 0 and n < w:
+            return optim.learning_rate * n / w
+        n = min(n, self.total_iteration)
+        cos = 0.5 * (1 + math.cos(math.pi * (n - w) /
+                                  (self.total_iteration - w)))
+        return optim.learning_rate * (self.alpha + (1 - self.alpha) * cos)
+
+
 class EpochDecayWithWarmUp(LearningRateSchedule):
     """Linear warmup then step decay by epoch (SGD.scala
     EpochDecayWithWarmUp — the ImageNet ResNet-50 recipe)."""

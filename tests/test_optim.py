@@ -207,6 +207,49 @@ class TestSchedules:
         s.state["neval"] = 25
         assert abs(s.current_lr() - 0.01) < 1e-9
 
+    def test_cosine_decay(self):
+        s = self._sgd(optim.CosineDecay(100))
+        s.state["neval"] = 0
+        assert abs(s.current_lr() - 1.0) < 1e-9          # start: full lr
+        s.state["neval"] = 50
+        assert abs(s.current_lr() - 0.5) < 1e-9          # midpoint: half
+        s.state["neval"] = 100
+        assert abs(s.current_lr()) < 1e-9                # end: alpha=0
+        s.state["neval"] = 500
+        assert abs(s.current_lr()) < 1e-9                # holds past end
+
+    def test_cosine_decay_alpha_floor(self):
+        s = self._sgd(optim.CosineDecay(10, alpha=0.1))
+        s.state["neval"] = 10
+        assert abs(s.current_lr() - 0.1) < 1e-9
+
+    def test_warmup_cosine_decay_is_continuous(self):
+        """The transformer recipe as one schedule: 0 -> peak -> alpha with
+        no discontinuity at the warmup boundary."""
+        s = self._sgd(optim.WarmupCosineDecay(10, 110))
+        s.state["neval"] = 0
+        assert abs(s.current_lr()) < 1e-9                # starts at 0
+        s.state["neval"] = 5
+        assert abs(s.current_lr() - 0.5) < 1e-9          # mid-ramp
+        s.state["neval"] = 10
+        assert abs(s.current_lr() - 1.0) < 1e-9          # peak, continuous
+        s.state["neval"] = 60                            # cosine midpoint
+        assert abs(s.current_lr() - 0.5) < 1e-9
+        s.state["neval"] = 110
+        assert abs(s.current_lr()) < 1e-9                # end
+        # continuity across the boundary: steps 9,10,11 are close
+        lrs = []
+        for n in (9, 10, 11):
+            s.state["neval"] = n
+            lrs.append(s.current_lr())
+        assert max(abs(lrs[1] - lrs[0]), abs(lrs[2] - lrs[1])) < 0.11
+
+    def test_cosine_decay_rejects_zero_duration(self):
+        with pytest.raises(ValueError):
+            optim.CosineDecay(0)
+        with pytest.raises(ValueError):
+            optim.WarmupCosineDecay(10, 10)
+
     def test_epoch_step(self):
         s = self._sgd(optim.EpochStep(2, 0.1))
         s.state["epoch"] = 4
