@@ -129,10 +129,18 @@ class Adam(OptimMethod):
     def __init__(self, learning_rate: float = 1e-3,
                  learning_rate_decay: float = 0.0,
                  beta1: float = 0.9, beta2: float = 0.999, epsilon: float = 1e-8,
-                 weight_decay: float = 0.0):
+                 weight_decay: float = 0.0,
+                 learning_rate_schedule: Optional[
+                     "LearningRateSchedule"] = None):
         super().__init__(learning_rate, weight_decay)
         self.learning_rate_decay = learning_rate_decay
         self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+        # beyond reference parity (the reference wires schedules into SGD
+        # only): any LearningRateSchedule drives the Adam family too —
+        # AdamW + WarmupCosineDecay is the standard transformer recipe.
+        # Default() reproduces the reference Adam's lr/(1+n*decay).
+        from bigdl_tpu.optim.schedules import Default
+        self.schedule = learning_rate_schedule or Default()
 
     def init_state(self, params):
         return {"m": _tree(jnp.zeros_like, params),
@@ -140,8 +148,7 @@ class Adam(OptimMethod):
                 "t": jnp.zeros((), jnp.int32)}
 
     def current_lr(self):
-        n = self.state["neval"]
-        return self.learning_rate / (1 + n * self.learning_rate_decay)
+        return self.schedule.compute(self)
 
     def _moments(self, grads, opt_state):
         """One EMA step of the Adam first/second moments with bias
@@ -185,9 +192,12 @@ class AdamW(Adam):
     def __init__(self, learning_rate: float = 1e-3,
                  learning_rate_decay: float = 0.0, beta1: float = 0.9,
                  beta2: float = 0.999, epsilon: float = 1e-8,
-                 weight_decay: float = 1e-2):
+                 weight_decay: float = 1e-2,
+                 learning_rate_schedule: Optional[
+                     "LearningRateSchedule"] = None):
         super().__init__(learning_rate, learning_rate_decay, beta1, beta2,
-                         epsilon, weight_decay=0.0)
+                         epsilon, weight_decay=0.0,
+                         learning_rate_schedule=learning_rate_schedule)
         self.decoupled_weight_decay = weight_decay
 
     def update(self, grads, opt_state, params, lr):
@@ -214,9 +224,12 @@ class LAMB(Adam):
 
     def __init__(self, learning_rate: float = 1e-3, beta1: float = 0.9,
                  beta2: float = 0.999, epsilon: float = 1e-6,
-                 weight_decay: float = 0.0):
+                 weight_decay: float = 0.0,
+                 learning_rate_schedule: Optional[
+                     "LearningRateSchedule"] = None):
         super().__init__(learning_rate, beta1=beta1, beta2=beta2,
-                         epsilon=epsilon, weight_decay=0.0)
+                         epsilon=epsilon, weight_decay=0.0,
+                         learning_rate_schedule=learning_rate_schedule)
         self.trust_weight_decay = weight_decay
 
     def update(self, grads, opt_state, params, lr):

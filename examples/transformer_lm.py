@@ -55,7 +55,13 @@ def main(argv=None):
                                        size_average=True)
     opt = optim.Optimizer(model, (X.astype(np.float32), Y),
                           crit, batch_size=args.batch_size, local=True)
-    opt.set_optim_method(optim.Adam(learning_rate=3e-3))
+    # the transformer recipe: AdamW + linear warmup into a cosine tail
+    # (peak lr = learning_rate; one continuous schedule)
+    warm = min(args.max_iteration - 1, max(1, args.max_iteration // 10))
+    opt.set_optim_method(optim.AdamW(
+        learning_rate=3e-3, weight_decay=0.01,
+        learning_rate_schedule=optim.WarmupCosineDecay(
+            warm, args.max_iteration)))
     opt.set_end_when(optim.max_iteration(args.max_iteration))
     trained = opt.optimize()
 

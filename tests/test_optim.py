@@ -244,6 +244,17 @@ class TestSchedules:
             lrs.append(s.current_lr())
         assert max(abs(lrs[1] - lrs[0]), abs(lrs[2] - lrs[1])) < 0.11
 
+    def test_schedule_drives_adam_family(self):
+        """Beyond parity: LearningRateSchedule objects plug into Adam/AdamW/
+        LAMB, not just SGD (the AdamW+WarmupCosineDecay transformer recipe)."""
+        for cls in (optim.Adam, optim.AdamW, optim.LAMB):
+            m = cls(learning_rate=1.0,
+                    learning_rate_schedule=optim.WarmupCosineDecay(10, 110))
+            m.state["neval"] = 5
+            assert abs(m.current_lr() - 0.5) < 1e-9, cls.__name__
+            m.state["neval"] = 60
+            assert abs(m.current_lr() - 0.5) < 1e-9, cls.__name__
+
     def test_cosine_decay_rejects_zero_duration(self):
         with pytest.raises(ValueError):
             optim.CosineDecay(0)
