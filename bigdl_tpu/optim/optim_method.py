@@ -181,6 +181,18 @@ ParallelAdam = Adam
 class AdamW(Adam):
     """Adam with DECOUPLED weight decay (Loshchilov & Hutter 2017).
 
+    Example (the transformer training recipe):
+        >>> import jax.numpy as jnp
+        >>> from bigdl_tpu.optim import AdamW, WarmupCosineDecay
+        >>> m = AdamW(learning_rate=1e-3, weight_decay=0.01,
+        ...           learning_rate_schedule=WarmupCosineDecay(100, 1100))
+        >>> p = {"w": jnp.ones((2,))}
+        >>> s = m.init_state(p)
+        >>> p2, s = m.update({"w": jnp.asarray([0.1, -0.1])}, s, p,
+        ...                  m.current_lr())
+        >>> p2["w"].shape
+        (2,)
+
     Beyond reference parity: the TPU-era default for transformer training.
     Unlike `Adam(weight_decay=...)` — which (like the reference's generic
     L2 path) adds `wd * p` to the GRADIENT and therefore lets the moment

@@ -13,10 +13,11 @@ import pytest
 import bigdl_tpu.keras
 import bigdl_tpu.nn
 import bigdl_tpu.ops
+import bigdl_tpu.optim
 import bigdl_tpu.parallel
 
 _PACKAGES = (bigdl_tpu.nn, bigdl_tpu.keras, bigdl_tpu.ops,
-             bigdl_tpu.parallel)
+             bigdl_tpu.parallel, bigdl_tpu.optim)
 
 
 def _modules_with_doctests():
