@@ -155,6 +155,7 @@ class AtrousConvolution2D(Convolution2D):
 
 
 class AtrousConvolution1D(Convolution1D):
+    """Dilated 1-D conv (PY/keras layer surface)."""
     def __init__(self, nb_filter, filter_length, activation=None,
                  subsample_length: int = 1, atrous_rate: int = 1,
                  bias: bool = True, input_shape=None, name=None):
@@ -202,6 +203,7 @@ class Deconvolution2D(KerasLayer):
 
 
 class SeparableConvolution2D(KerasLayer):
+    """Depthwise + pointwise conv (PY/keras layer surface)."""
     def __init__(self, nb_filter: int, nb_row: int, nb_col: int,
                  activation=None, border_mode: str = "valid",
                  subsample=(1, 1), depth_multiplier: int = 1,
@@ -229,6 +231,7 @@ class SeparableConvolution2D(KerasLayer):
 
 
 class LocallyConnected2D(KerasLayer):
+    """Unshared-weight 2-D conv (PY/keras layer surface)."""
     def __init__(self, nb_filter: int, nb_row: int, nb_col: int,
                  activation=None, subsample=(1, 1), bias: bool = True,
                  input_shape=None, name=None):
@@ -251,6 +254,7 @@ class LocallyConnected2D(KerasLayer):
 
 
 class LocallyConnected1D(KerasLayer):
+    """Unshared-weight 1-D conv (PY/keras layer surface)."""
     def __init__(self, nb_filter: int, filter_length: int, activation=None,
                  subsample_length: int = 1, bias: bool = True,
                  input_shape=None, name=None):
@@ -297,6 +301,7 @@ class _Pool2D(KerasLayer):
 
 
 class MaxPooling2D(_Pool2D):
+    """2-D max pooling (PY/keras layer surface)."""
     def _build_labor(self, input_shape):
         pad = -1 if self.border == "same" else 0  # -1 = SAME
         return nn.SpatialMaxPooling(self.pool_size[1], self.pool_size[0],
@@ -305,6 +310,7 @@ class MaxPooling2D(_Pool2D):
 
 
 class AveragePooling2D(_Pool2D):
+    """2-D average pooling (PY/keras layer surface)."""
     def _build_labor(self, input_shape):
         pad = -1 if self.border == "same" else 0
         return nn.SpatialAveragePooling(self.pool_size[1], self.pool_size[0],
@@ -313,6 +319,7 @@ class AveragePooling2D(_Pool2D):
 
 
 class MaxPooling1D(KerasLayer):
+    """1-D max pooling (PY/keras layer surface)."""
     def __init__(self, pool_length: int = 2, stride: Optional[int] = None,
                  border_mode: str = "valid", input_shape=None, name=None):
         super().__init__(input_shape, name)
@@ -333,6 +340,7 @@ class MaxPooling1D(KerasLayer):
 
 
 class AveragePooling1D(MaxPooling1D):
+    """1-D average pooling (PY/keras layer surface)."""
     def _build_labor(self, input_shape):
         # sequence as H=steps, W=1 image
         pad = -1 if self.border == "same" else 0
@@ -360,6 +368,7 @@ class _Pool3D(KerasLayer):
 
 
 class MaxPooling3D(_Pool3D):
+    """3-D max pooling (PY/keras layer surface)."""
     def _build_labor(self, input_shape):
         pt, ph, pw = self.pool_size
         st, sh, sw = self.strides
@@ -367,6 +376,7 @@ class MaxPooling3D(_Pool3D):
 
 
 class AveragePooling3D(_Pool3D):
+    """3-D average pooling (PY/keras layer surface)."""
     def _build_labor(self, input_shape):
         pt, ph, pw = self.pool_size
         st, sh, sw = self.strides
@@ -391,26 +401,32 @@ class _GlobalPool(KerasLayer):
 
 
 class GlobalMaxPooling1D(_GlobalPool):
+    """Max over time (PY/keras layer surface)."""
     reduce = "max"
 
 
 class GlobalAveragePooling1D(_GlobalPool):
+    """Mean over time (PY/keras layer surface)."""
     reduce = "mean"
 
 
 class GlobalMaxPooling2D(_GlobalPool):
+    """Max over H,W (PY/keras layer surface)."""
     reduce = "max"
 
 
 class GlobalAveragePooling2D(_GlobalPool):
+    """Mean over H,W (PY/keras layer surface)."""
     reduce = "mean"
 
 
 class GlobalMaxPooling3D(_GlobalPool):
+    """Max over D,H,W (PY/keras layer surface)."""
     reduce = "max"
 
 
 class GlobalAveragePooling3D(_GlobalPool):
+    """Mean over D,H,W (PY/keras layer surface)."""
     reduce = "mean"
 
 
@@ -419,6 +435,7 @@ class GlobalAveragePooling3D(_GlobalPool):
 # --------------------------------------------------------------------------- #
 
 class UpSampling1D(KerasLayer):
+    """Repeat timesteps (PY/keras layer surface)."""
     def __init__(self, length: int = 2, input_shape=None, name=None):
         super().__init__(input_shape, name)
         self.length = length
@@ -432,6 +449,7 @@ class UpSampling1D(KerasLayer):
 
 
 class UpSampling2D(KerasLayer):
+    """Nearest 2-D upsampling (PY/keras layer surface)."""
     def __init__(self, size=(2, 2), input_shape=None, name=None):
         super().__init__(input_shape, name)
         self.size = size
@@ -445,6 +463,7 @@ class UpSampling2D(KerasLayer):
 
 
 class UpSampling3D(KerasLayer):
+    """Nearest 3-D upsampling (PY/keras layer surface)."""
     def __init__(self, size=(2, 2, 2), input_shape=None, name=None):
         super().__init__(input_shape, name)
         self.size = size
@@ -459,6 +478,7 @@ class UpSampling3D(KerasLayer):
 
 
 class ZeroPadding2D(KerasLayer):
+    """Pad rows/cols (PY/keras layer surface)."""
     def __init__(self, padding=(1, 1), input_shape=None, name=None):
         super().__init__(input_shape, name)
         self.padding = padding
@@ -474,6 +494,7 @@ class ZeroPadding2D(KerasLayer):
 
 
 class ZeroPadding1D(KerasLayer):
+    """Pad timesteps (PY/keras layer surface)."""
     def __init__(self, padding: int = 1, input_shape=None, name=None):
         super().__init__(input_shape, name)
         self.padding = padding
@@ -491,6 +512,7 @@ class ZeroPadding1D(KerasLayer):
 
 
 class ZeroPadding3D(KerasLayer):
+    """Pad a volume (PY/keras layer surface)."""
     def __init__(self, padding=(1, 1, 1), input_shape=None, name=None):
         super().__init__(input_shape, name)
         self.padding = padding
@@ -510,6 +532,7 @@ class ZeroPadding3D(KerasLayer):
 
 
 class Cropping2D(KerasLayer):
+    """Crop rows/cols (PY/keras layer surface)."""
     def __init__(self, cropping=((0, 0), (0, 0)), input_shape=None, name=None):
         super().__init__(input_shape, name)
         self.cropping = cropping
@@ -524,6 +547,7 @@ class Cropping2D(KerasLayer):
 
 
 class Cropping1D(KerasLayer):
+    """Crop timesteps (PY/keras layer surface)."""
     def __init__(self, cropping=(1, 1), input_shape=None, name=None):
         super().__init__(input_shape, name)
         self.cropping = cropping
@@ -539,6 +563,7 @@ class Cropping1D(KerasLayer):
 
 
 class Cropping3D(KerasLayer):
+    """Crop a volume (PY/keras layer surface)."""
     def __init__(self, cropping=((1, 1), (1, 1), (1, 1)), input_shape=None,
                  name=None):
         super().__init__(input_shape, name)

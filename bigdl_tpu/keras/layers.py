@@ -69,6 +69,7 @@ class Dense(KerasLayer):
 
 
 class Activation(KerasLayer):
+    """Apply a named activation (PY/keras layer surface)."""
     def __init__(self, activation, input_shape=None, name=None):
         super().__init__(input_shape, name)
         self.activation = activation
@@ -78,6 +79,7 @@ class Activation(KerasLayer):
 
 
 class Dropout(KerasLayer):
+    """Inverted dropout (PY/keras layer surface)."""
     def __init__(self, p: float, input_shape=None, name=None):
         super().__init__(input_shape, name)
         self.p = p
@@ -87,6 +89,7 @@ class Dropout(KerasLayer):
 
 
 class GaussianDropout(KerasLayer):
+    """Multiplicative gaussian noise (PY/keras layer surface)."""
     def __init__(self, p: float, input_shape=None, name=None):
         super().__init__(input_shape, name)
         self.p = p
@@ -96,6 +99,7 @@ class GaussianDropout(KerasLayer):
 
 
 class GaussianNoise(KerasLayer):
+    """Additive gaussian noise (PY/keras layer surface)."""
     def __init__(self, sigma: float, input_shape=None, name=None):
         super().__init__(input_shape, name)
         self.sigma = sigma
@@ -105,6 +109,7 @@ class GaussianNoise(KerasLayer):
 
 
 class SpatialDropout1D(KerasLayer):
+    """Drop whole channels [B,T,C] (PY/keras layer surface)."""
     def __init__(self, p: float = 0.5, input_shape=None, name=None):
         super().__init__(input_shape, name)
         self.p = p
@@ -114,6 +119,7 @@ class SpatialDropout1D(KerasLayer):
 
 
 class SpatialDropout2D(KerasLayer):
+    """Drop whole feature maps (PY/keras layer surface)."""
     def __init__(self, p: float = 0.5, input_shape=None, name=None):
         super().__init__(input_shape, name)
         self.p = p
@@ -123,6 +129,7 @@ class SpatialDropout2D(KerasLayer):
 
 
 class SpatialDropout3D(KerasLayer):
+    """Drop whole volumes (PY/keras layer surface)."""
     def __init__(self, p: float = 0.5, input_shape=None, name=None):
         super().__init__(input_shape, name)
         self.p = p
@@ -132,6 +139,7 @@ class SpatialDropout3D(KerasLayer):
 
 
 class Flatten(KerasLayer):
+    """Flatten to [B, -1] (PY/keras layer surface)."""
     def _build_labor(self, input_shape):
         n = int(np_prod(input_shape))
         return nn.Reshape((n,))
@@ -148,6 +156,7 @@ def np_prod(shape) -> int:
 
 
 class Reshape(KerasLayer):
+    """Reshape non-batch dims (PY/keras layer surface)."""
     def __init__(self, target_shape: Shape, input_shape=None, name=None):
         super().__init__(input_shape, name)
         self.target_shape = tuple(target_shape)
@@ -183,6 +192,7 @@ class Permute(KerasLayer):
 
 
 class RepeatVector(KerasLayer):
+    """[B, D] -> [B, n, D] (PY/keras layer surface)."""
     def __init__(self, n: int, input_shape=None, name=None):
         super().__init__(input_shape, name)
         self.n = n
@@ -195,6 +205,7 @@ class RepeatVector(KerasLayer):
 
 
 class Masking(KerasLayer):
+    """Zero timesteps equal to mask_value (PY/keras layer surface)."""
     def __init__(self, mask_value: float = 0.0, input_shape=None, name=None):
         super().__init__(input_shape, name)
         self.mask_value = mask_value
@@ -222,6 +233,7 @@ class Embedding(KerasLayer):
 
 
 class Highway(KerasLayer):
+    """Gated identity-transform mix (PY/keras layer surface)."""
     def __init__(self, activation="tanh", bias: bool = True,
                  input_shape=None, name=None):
         super().__init__(input_shape, name)
@@ -234,6 +246,7 @@ class Highway(KerasLayer):
 
 
 class MaxoutDense(KerasLayer):
+    """Max over k affine pieces (PY/keras layer surface)."""
     def __init__(self, output_dim: int, nb_feature: int = 4,
                  input_shape=None, name=None):
         super().__init__(input_shape, name)
@@ -329,6 +342,7 @@ def merge(inputs, mode="sum", concat_axis=-1, name=None):
 # ---------------------------------------------------------------------------
 
 class ELU(KerasLayer):
+    """Exponential linear unit (PY/keras layer surface)."""
     def __init__(self, alpha: float = 1.0, input_shape=None, name=None):
         super().__init__(input_shape, name)
         self.alpha = alpha
@@ -338,6 +352,7 @@ class ELU(KerasLayer):
 
 
 class LeakyReLU(KerasLayer):
+    """max(x, alpha*x) (PY/keras layer surface)."""
     def __init__(self, alpha: float = 0.3, input_shape=None, name=None):
         super().__init__(input_shape, name)
         self.alpha = alpha
@@ -347,6 +362,7 @@ class LeakyReLU(KerasLayer):
 
 
 class SReLU(KerasLayer):
+    """S-shaped ReLU with learned knots (PY/keras layer surface)."""
     def __init__(self, input_shape=None, name=None):
         super().__init__(input_shape, name)
 
@@ -355,6 +371,7 @@ class SReLU(KerasLayer):
 
 
 class ThresholdedReLU(KerasLayer):
+    """x where x > theta else 0 (PY/keras layer surface)."""
     def __init__(self, theta: float = 1.0, input_shape=None, name=None):
         super().__init__(input_shape, name)
         self.theta = theta
@@ -364,6 +381,7 @@ class ThresholdedReLU(KerasLayer):
 
 
 class SoftMax(KerasLayer):
+    """Softmax over the last dim (PY/keras layer surface)."""
     def _build_labor(self, input_shape):
         return nn.SoftMax()
 

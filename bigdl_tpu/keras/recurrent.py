@@ -40,6 +40,7 @@ class _KerasRecurrent(KerasLayer):
 
 
 class SimpleRNN(_KerasRecurrent):
+    """Vanilla RNN over [B, T, D] (PY/keras layer surface)."""
     def _make_cell(self, input_dim):
         from bigdl_tpu.keras.layers import _activation_fn
         return nn.RnnCell(input_dim, self.output_dim,
@@ -70,6 +71,7 @@ class LSTM(_KerasRecurrent):
 
 
 class GRU(_KerasRecurrent):
+    """Gated recurrent unit over [B, T, D] (PY/keras layer surface)."""
     def _make_cell(self, input_dim):
         from bigdl_tpu.keras.layers import _activation_fn
         return nn.GRUCell(input_dim, self.output_dim,

@@ -91,6 +91,7 @@ class Input(KerasLayer):
 # --------------------------------------------------------------------------- #
 
 def activation_module(act: Union[str, Module, None]) -> Optional[Module]:
+    """Resolve an activation name to its nn layer."""
     if act is None or isinstance(act, Module):
         return act
     table: dict = {
@@ -107,6 +108,7 @@ def activation_module(act: Union[str, Module, None]) -> Optional[Module]:
 
 
 def resolve_optim_method(o) -> optim.SGD:
+    """Resolve a Keras optimizer name/instance to an OptimMethod."""
     if isinstance(o, str):
         table = {"sgd": lambda: optim.SGD(learning_rate=0.01),
                  "adam": optim.Adam, "adagrad": optim.Adagrad,
@@ -120,6 +122,7 @@ def resolve_optim_method(o) -> optim.SGD:
 
 
 def resolve_loss(l):
+    """Resolve a Keras loss name/instance to a Criterion."""
     from bigdl_tpu.nn.criterion import Criterion
     if isinstance(l, Criterion):
         return l
@@ -157,6 +160,7 @@ class CategoricalCrossEntropy(nn.criterion.Criterion):
 
 
 def resolve_metric(m):
+    """Resolve a Keras metric name to a ValidationMethod."""
     if isinstance(m, optim.ValidationMethod):
         return m
     table = {"accuracy": optim.Top1Accuracy, "acc": optim.Top1Accuracy,

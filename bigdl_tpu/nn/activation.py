@@ -46,6 +46,7 @@ class ReLU(_Elementwise):
 
 
 class ReLU6(_Elementwise):
+    """min(max(x, 0), 6) (DL/nn/ReLU6.scala)."""
     def fn(self, x):
         return jnp.clip(x, 0.0, 6.0)
 
@@ -65,6 +66,7 @@ class Sigmoid(_Elementwise):
 
 
 class LogSigmoid(_Elementwise):
+    """log(sigmoid(x)), numerically stable (DL/nn/LogSigmoid.scala)."""
     def fn(self, x):
         return jax.nn.log_sigmoid(x)
 
@@ -84,11 +86,13 @@ class Tanh(_Elementwise):
 
 
 class TanhShrink(_Elementwise):
+    """x - tanh(x) (DL/nn/TanhShrink.scala)."""
     def fn(self, x):
         return x - jnp.tanh(x)
 
 
 class SoftPlus(_Elementwise):
+    """log(1 + exp(beta*x))/beta (DL/nn/SoftPlus.scala)."""
     def __init__(self, beta: float = 1.0, name=None):
         super().__init__(name)
         self.beta = beta
@@ -98,11 +102,13 @@ class SoftPlus(_Elementwise):
 
 
 class SoftSign(_Elementwise):
+    """x / (1 + |x|) (DL/nn/SoftSign.scala)."""
     def fn(self, x):
         return jax.nn.soft_sign(x)
 
 
 class ELU(_Elementwise):
+    """Exponential linear unit (DL/nn/ELU.scala)."""
     def __init__(self, alpha: float = 1.0, ip: bool = False, name=None):
         super().__init__(name)
         self.alpha = alpha
@@ -112,11 +118,13 @@ class ELU(_Elementwise):
 
 
 class GELU(_Elementwise):
+    """Gaussian error linear unit (tanh form; beyond-parity transformer activation)."""
     def fn(self, x):
         return jax.nn.gelu(x)
 
 
 class LeakyReLU(_Elementwise):
+    """max(x, negval*x) (DL/nn/LeakyReLU.scala)."""
     def __init__(self, negval: float = 0.01, ip: bool = False, name=None):
         super().__init__(name)
         self.negval = negval
@@ -137,6 +145,7 @@ class Threshold(_Elementwise):
 
 
 class BinaryThreshold(_Elementwise):
+    """1 where input > th else 0 (DL/nn/BinaryThreshold.scala)."""
     def __init__(self, th: float = 1e-6, name=None):
         super().__init__(name)
         self.th = th
@@ -146,6 +155,7 @@ class BinaryThreshold(_Elementwise):
 
 
 class HardShrink(_Elementwise):
+    """Zero inside [-lambda, lambda] (DL/nn/HardShrink.scala)."""
     def __init__(self, lambd: float = 0.5, name=None):
         super().__init__(name)
         self.lambd = lambd
@@ -155,6 +165,7 @@ class HardShrink(_Elementwise):
 
 
 class SoftShrink(_Elementwise):
+    """Shrink toward zero by lambda (DL/nn/SoftShrink.scala)."""
     def __init__(self, lambd: float = 0.5, name=None):
         super().__init__(name)
         self.lambd = lambd
@@ -171,6 +182,7 @@ class HardSigmoid(_Elementwise):
 
 
 class HardTanh(_Elementwise):
+    """Linear clipped to [min, max] (DL/nn/HardTanh.scala)."""
     def __init__(self, min_value: float = -1.0, max_value: float = 1.0,
                  ip: bool = False, name=None):
         super().__init__(name)
@@ -181,6 +193,7 @@ class HardTanh(_Elementwise):
 
 
 class Clamp(HardTanh):
+    """Clip into [min, max] (DL/nn/Clamp.scala)."""
     def __init__(self, min_v: float, max_v: float, name=None):
         super().__init__(min_v, max_v, name=name)
 
@@ -201,6 +214,7 @@ class SoftMax(_Elementwise):
 
 
 class SoftMin(_Elementwise):
+    """softmax of -x over the last dim (DL/nn/SoftMin.scala)."""
     def fn(self, x):
         return jax.nn.softmax(-x, axis=-1)
 
@@ -284,31 +298,37 @@ class Power(_Elementwise):
 
 
 class Sqrt(_Elementwise):
+    """Elementwise square root (DL/nn/Sqrt.scala)."""
     def fn(self, x):
         return jnp.sqrt(x)
 
 
 class Square(_Elementwise):
+    """Elementwise square (DL/nn/Square.scala)."""
     def fn(self, x):
         return x * x
 
 
 class Log(_Elementwise):
+    """Elementwise natural log (DL/nn/Log.scala)."""
     def fn(self, x):
         return jnp.log(x)
 
 
 class Exp(_Elementwise):
+    """Elementwise exp (DL/nn/Exp.scala)."""
     def fn(self, x):
         return jnp.exp(x)
 
 
 class Abs(_Elementwise):
+    """Elementwise absolute value (DL/nn/Abs.scala)."""
     def fn(self, x):
         return jnp.abs(x)
 
 
 class Negative(_Elementwise):
+    """Elementwise negation (DL/nn/Negative.scala)."""
     def fn(self, x):
         return -x
 

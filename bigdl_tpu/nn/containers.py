@@ -19,6 +19,7 @@ from bigdl_tpu.utils.table import T, Table
 
 
 class Container(Module):
+    """Base for modules that hold submodules (DL/nn/Container.scala)."""
     # bumped on every structural mutation anywhere; predictor caches store
     # the value they were built at, so a nested add() invalidates ancestors
     # whose _params dict was extended in place (identity check can't see it)
@@ -216,36 +217,43 @@ class _TableReduce(Module):
 
 
 class CAddTable(_TableReduce):
+    """Elementwise sum of a Table of tensors (DL/nn/CAddTable.scala)."""
     def _reduce(self, a, b):
         return a + b
 
 
 class CSubTable(_TableReduce):
+    """Elementwise difference of two Table entries (DL/nn/CSubTable.scala)."""
     def _reduce(self, a, b):
         return a - b
 
 
 class CMulTable(_TableReduce):
+    """Elementwise product of a Table of tensors (DL/nn/CMulTable.scala)."""
     def _reduce(self, a, b):
         return a * b
 
 
 class CDivTable(_TableReduce):
+    """Elementwise quotient of two Table entries (DL/nn/CDivTable.scala)."""
     def _reduce(self, a, b):
         return a / b
 
 
 class CMaxTable(_TableReduce):
+    """Elementwise max over a Table of tensors (DL/nn/CMaxTable.scala)."""
     def _reduce(self, a, b):
         return jnp.maximum(a, b)
 
 
 class CMinTable(_TableReduce):
+    """Elementwise min over a Table of tensors (DL/nn/CMinTable.scala)."""
     def _reduce(self, a, b):
         return jnp.minimum(a, b)
 
 
 class CAveTable(Module):
+    """Elementwise mean of a Table of tensors (DL/nn/CAveTable.scala)."""
     def apply(self, params, input, ctx):
         vals = list(input)
         return sum(vals) / float(len(vals))
@@ -272,6 +280,7 @@ class JoinTable(Module):
 
 
 class SplitTable(Module):
+    """Split a tensor along a dim into a Table (DL/nn/SplitTable.scala)."""
     def __init__(self, axis: int = 1, name=None):
         super().__init__(name)
         self.axis = axis
@@ -283,6 +292,7 @@ class SplitTable(Module):
 
 
 class FlattenTable(Module):
+    """Flatten nested Tables into one flat Table (DL/nn/FlattenTable.scala)."""
     def apply(self, params, input, ctx):
         flat = []
 
@@ -311,6 +321,7 @@ class SelectTable(Module):
 
 
 class NarrowTable(Module):
+    """Slice a Table to [offset, offset+length) (DL/nn/NarrowTable.scala)."""
     def __init__(self, offset: int, length: int = 1, name=None):
         super().__init__(name)
         self.offset, self.length = offset, length
@@ -345,6 +356,7 @@ class Input(Module):
 
 
 def InputNode(name: Optional[str] = None) -> Node:
+    """Create a graph input placeholder node (DL/nn/Input.scala)."""
     return Node(Input(name or "Input"), [])
 
 
@@ -411,6 +423,7 @@ class Graph(Container):
 
 
 class Identity(Module):
+    """Pass input through unchanged (DL/nn/Identity.scala)."""
     def apply(self, params, input, ctx):
         return input
 

@@ -140,12 +140,14 @@ class MSECriterion(Criterion):
 
 
 class AbsCriterion(Criterion):
+    """Mean absolute error (DL/nn/AbsCriterion.scala)."""
     def loss(self, output, target):
         d = jnp.abs(output - target)
         return jnp.mean(d) if self.size_average else jnp.sum(d)
 
 
 class SmoothL1Criterion(Criterion):
+    """Huber-style smooth L1 (DL/nn/SmoothL1Criterion.scala)."""
     def loss(self, output, target):
         d = jnp.abs(output - target)
         l = jnp.where(d < 1.0, 0.5 * d * d, d - 0.5)
@@ -153,6 +155,7 @@ class SmoothL1Criterion(Criterion):
 
 
 class SmoothL1CriterionWithWeights(Criterion):
+    """Smooth L1 with inside/outside weights, Fast-RCNN style (DL/nn/SmoothL1CriterionWithWeights.scala)."""
     def __init__(self, sigma: float = 1.0, num: int = 0):
         super().__init__(size_average=False)
         self.sigma2 = sigma * sigma
@@ -244,6 +247,7 @@ class MultiLabelMarginCriterion(Criterion):
 
 
 class MultiLabelSoftMarginCriterion(Criterion):
+    """Per-label sigmoid BCE (DL/nn/MultiLabelSoftMarginCriterion.scala)."""
     def __init__(self, weights=None, size_average: bool = True):
         super().__init__(size_average)
         self.weights = None if weights is None else jnp.asarray(weights)
@@ -281,6 +285,7 @@ class MultiMarginCriterion(Criterion):
 
 
 class HingeEmbeddingCriterion(Criterion):
+    """Hinge loss over +-1 labels (DL/nn/HingeEmbeddingCriterion.scala)."""
     def __init__(self, margin: float = 1.0, size_average: bool = True):
         super().__init__(size_average)
         self.margin = margin
@@ -291,6 +296,7 @@ class HingeEmbeddingCriterion(Criterion):
 
 
 class L1HingeEmbeddingCriterion(Criterion):
+    """L1-distance hinge over pairs with +-1 labels (DL/nn/L1HingeEmbeddingCriterion.scala)."""
     def __init__(self, margin: float = 1.0):
         super().__init__()
         self.margin = margin
@@ -304,6 +310,7 @@ class L1HingeEmbeddingCriterion(Criterion):
 
 
 class CosineEmbeddingCriterion(Criterion):
+    """Cosine margin loss over pairs with +-1 labels (DL/nn/CosineEmbeddingCriterion.scala)."""
     def __init__(self, margin: float = 0.0, size_average: bool = True):
         super().__init__(size_average)
         self.margin = margin
@@ -319,6 +326,7 @@ class CosineEmbeddingCriterion(Criterion):
 
 
 class CosineDistanceCriterion(Criterion):
+    """1 - cosine(output, target) (DL/nn/CosineDistanceCriterion.scala)."""
     def loss(self, output, target):
         cos = jnp.sum(output * target, axis=-1) / (
             jnp.linalg.norm(output, axis=-1) * jnp.linalg.norm(target, axis=-1) + 1e-12)
@@ -326,6 +334,7 @@ class CosineDistanceCriterion(Criterion):
 
 
 class CosineProximityCriterion(Criterion):
+    """Negative mean cosine proximity (DL/nn/CosineProximityCriterion.scala)."""
     def loss(self, output, target):
         o = output / (jnp.linalg.norm(output, axis=-1, keepdims=True) + 1e-12)
         t = target / (jnp.linalg.norm(target, axis=-1, keepdims=True) + 1e-12)
@@ -370,17 +379,20 @@ class GaussianCriterion(Criterion):
 
 
 class PoissonCriterion(Criterion):
+    """Poisson NLL: mean(output - target*log(output)) (DL/nn/PoissonCriterion.scala)."""
     def loss(self, output, target):
         return jnp.mean(output - target * jnp.log(output + 1e-7))
 
 
 class MeanAbsolutePercentageCriterion(Criterion):
+    """Mean |err/target| * 100 (DL/nn/MeanAbsolutePercentageCriterion.scala)."""
     def loss(self, output, target):
         diff = jnp.abs(target - output) / jnp.clip(jnp.abs(target), 1e-7, None)
         return 100.0 * jnp.mean(diff)
 
 
 class MeanSquaredLogarithmicCriterion(Criterion):
+    """MSE of log(1+x) terms (DL/nn/MeanSquaredLogarithmicCriterion.scala)."""
     def loss(self, output, target):
         a = jnp.log(jnp.clip(output, 1e-7, None) + 1.0)
         b = jnp.log(jnp.clip(target, 1e-7, None) + 1.0)
@@ -388,11 +400,13 @@ class MeanSquaredLogarithmicCriterion(Criterion):
 
 
 class L1Cost(Criterion):
+    """Sum of absolute values of the input (DL/nn/L1Cost.scala)."""
     def loss(self, output, target=None):
         return jnp.sum(jnp.abs(output))
 
 
 class L1Penalty(Criterion):
+    """L1 activity penalty passed through as a layer (DL/nn/L1Penalty.scala)."""
     def __init__(self, l1weight: float, size_average: bool = False,
                  provide_output: bool = True):
         super().__init__(size_average)
@@ -403,6 +417,7 @@ class L1Penalty(Criterion):
 
 
 class NegativeEntropyPenalty(Criterion):
+    """Penalize low-entropy distributions (DL/nn/NegativeEntropyPenalty.scala)."""
     def __init__(self, beta: float = 0.01):
         super().__init__()
         self.beta = beta
@@ -413,6 +428,7 @@ class NegativeEntropyPenalty(Criterion):
 
 
 class SoftMarginCriterion(Criterion):
+    """log(1 + exp(-y*x)) over +-1 labels (DL/nn/SoftMarginCriterion.scala)."""
     def loss(self, output, target):
         l = jnp.log1p(jnp.exp(-output * target))
         return jnp.mean(l) if self.size_average else jnp.sum(l)
@@ -452,6 +468,7 @@ class SoftmaxWithCriterion(Criterion):
 
 
 class DiceCoefficientCriterion(Criterion):
+    """1 - Dice overlap, for segmentation (DL/nn/DiceCoefficientCriterion.scala)."""
     def __init__(self, size_average: bool = True, epsilon: float = 1.0):
         super().__init__(size_average)
         self.epsilon = epsilon
@@ -466,6 +483,7 @@ class DiceCoefficientCriterion(Criterion):
 
 
 class DotProductCriterion(Criterion):
+    """Negative mean dot product (DL/nn/DotProductCriterion.scala)."""
     def loss(self, output, target):
         return -jnp.sum(output * target)
 

@@ -91,14 +91,17 @@ class _SpatialDropout(Module):
 
 
 class SpatialDropout1D(_SpatialDropout):
+    """Drop whole feature channels of [B, T, C] (DL/nn/SpatialDropout1D.scala)."""
     spatial_ndim = 1
 
 
 class SpatialDropout2D(_SpatialDropout):
+    """Drop whole feature maps of [B, H, W, C] (DL/nn/SpatialDropout2D.scala)."""
     spatial_ndim = 2
 
 
 class SpatialDropout3D(_SpatialDropout):
+    """Drop whole volumes of [B, D, H, W, C] (DL/nn/SpatialDropout3D.scala)."""
     spatial_ndim = 3
 
 

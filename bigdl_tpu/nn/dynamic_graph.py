@@ -101,6 +101,7 @@ class LoopCondOps(ControlOps):
 
 
 class ControlTrigger(ControlOps):
+    """Control-dependency join: fires when any input arrives (loaders/ControlFlowOps)."""
     def apply(self, params, input, ctx):
         return Table()
 

@@ -36,16 +36,19 @@ class InitializationMethod:
 
 
 class Zeros(InitializationMethod):
+    """Fill with zeros (DL/nn/InitializationMethod.scala Zeros)."""
     def __call__(self, rng, shape, dtype=jnp.float32):
         return jnp.zeros(shape, dtype)
 
 
 class Ones(InitializationMethod):
+    """Fill with ones (DL/nn/InitializationMethod.scala Ones)."""
     def __call__(self, rng, shape, dtype=jnp.float32):
         return jnp.ones(shape, dtype)
 
 
 class ConstInitMethod(InitializationMethod):
+    """Fill with a constant (DL/nn/InitializationMethod.scala ConstInitMethod)."""
     def __init__(self, value: float):
         self.value = value
 
@@ -54,6 +57,7 @@ class ConstInitMethod(InitializationMethod):
 
 
 class RandomUniform(InitializationMethod):
+    """Uniform init in [lower, upper] (DL/nn/InitializationMethod.scala RandomUniform)."""
     def __init__(self, lower: Optional[float] = None, upper: Optional[float] = None):
         self.lower, self.upper = lower, upper
 
@@ -68,6 +72,7 @@ class RandomUniform(InitializationMethod):
 
 
 class RandomNormal(InitializationMethod):
+    """Gaussian init with given mean/std (DL/nn/InitializationMethod.scala RandomNormal)."""
     def __init__(self, mean: float = 0.0, stdv: float = 1.0):
         self.mean, self.stdv = mean, stdv
 

@@ -154,6 +154,7 @@ class Add(Module):
 
 
 class MulConstant(Module):
+    """Multiply by a scalar constant (DL/nn/MulConstant.scala)."""
     def __init__(self, scalar: float, name=None):
         super().__init__(name)
         self.scalar = scalar
@@ -163,6 +164,7 @@ class MulConstant(Module):
 
 
 class AddConstant(Module):
+    """Add a scalar constant (DL/nn/AddConstant.scala)."""
     def __init__(self, constant: float, name=None):
         super().__init__(name)
         self.constant = constant

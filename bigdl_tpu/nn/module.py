@@ -305,10 +305,12 @@ def functional_apply(module: Module, params: Dict, input: Activity, *,
 
 
 def merge_state(old: Dict, new: Dict) -> Dict:
+    """Merge an updated sub-state pytree over a base state (BN running stats after a step)."""
     merged = dict(old)
     merged.update(new)
     return merged
 
 
 def param_count(params: Dict) -> int:
+    """Total scalar count of a params pytree."""
     return sum(int(np.prod(l.shape)) for l in jax.tree_util.tree_leaves(params))

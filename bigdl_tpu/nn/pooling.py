@@ -143,6 +143,7 @@ class TemporalMaxPooling(Module):
 
 
 class VolumetricMaxPooling(Module):
+    """3-D max pooling (DL/nn/VolumetricMaxPooling.scala)."""
     def __init__(self, kt, kw, kh, dt=None, dw=None, dh=None,
                  pad_t=0, pad_w=0, pad_h=0, name=None):
         super().__init__(name)
@@ -159,6 +160,7 @@ class VolumetricMaxPooling(Module):
 
 
 class VolumetricAveragePooling(Module):
+    """3-D average pooling (DL/nn/VolumetricAveragePooling.scala)."""
     def __init__(self, kt, kw, kh, dt=None, dw=None, dh=None,
                  pad_t=0, pad_w=0, pad_h=0, name=None):
         super().__init__(name)
@@ -215,6 +217,7 @@ class UpSampling2D(Module):
 
 
 class UpSampling1D(Module):
+    """Repeat timesteps length-wise (DL/nn/UpSampling1D.scala)."""
     def __init__(self, length: int = 2, name=None):
         super().__init__(name)
         self.length = length
@@ -224,6 +227,7 @@ class UpSampling1D(Module):
 
 
 class UpSampling3D(Module):
+    """Nearest-neighbor 3-D upsampling (DL/nn/UpSampling3D.scala)."""
     def __init__(self, size, name=None):
         super().__init__(name)
         self.s = (size,) * 3 if isinstance(size, int) else tuple(size)

@@ -58,10 +58,12 @@ class InferReshape(Module):
 
 
 class View(Reshape):
+    """Reshape keeping batch dim (DL/nn/View.scala)."""
     pass
 
 
 class Contiguous(Module):
+    """Force a contiguous copy; identity under XLA (DL/nn/Contiguous.scala)."""
     def apply(self, params, input, ctx):
         return input  # jax arrays are always materialized contiguously
 
@@ -81,6 +83,7 @@ class Transpose(Module):
 
 
 class Permute(Module):
+    """Reorder non-batch dims (DL/nn/Transpose.scala role)."""
     def __init__(self, dims: Sequence[int], name=None):
         super().__init__(name)
         self.dims = tuple(dims)
@@ -90,6 +93,7 @@ class Permute(Module):
 
 
 class Squeeze(Module):
+    """Drop size-1 dims (DL/nn/Squeeze.scala)."""
     def __init__(self, dim: Optional[int] = None, name=None):
         super().__init__(name)
         self.dim = dim
@@ -99,6 +103,7 @@ class Squeeze(Module):
 
 
 class Unsqueeze(Module):
+    """Insert a size-1 dim (DL/nn/Unsqueeze.scala)."""
     def __init__(self, pos: int, name=None):
         super().__init__(name)
         self.pos = pos
@@ -119,6 +124,7 @@ class Select(Module):
 
 
 class Narrow(Module):
+    """Slice [offset, offset+length) along a dim (DL/nn/Narrow.scala)."""
     def __init__(self, dim: int, offset: int, length: int = 1, name=None):
         super().__init__(name)
         self.dim, self.offset, self.length = dim, offset, length
@@ -154,6 +160,7 @@ class MaskedSelect(Module):
 
 
 class Max(Module):
+    """Max over a dim (DL/nn/Max.scala)."""
     def __init__(self, dim: int = -1, num_input_dims: int = 0, name=None):
         super().__init__(name)
         self.dim = dim
@@ -163,6 +170,7 @@ class Max(Module):
 
 
 class Min(Module):
+    """Min over a dim (DL/nn/Min.scala)."""
     def __init__(self, dim: int = -1, name=None):
         super().__init__(name)
         self.dim = dim
@@ -172,6 +180,7 @@ class Min(Module):
 
 
 class Mean(Module):
+    """Mean over a dim (DL/nn/Mean.scala)."""
     def __init__(self, dimension: int = 0, n_input_dims: int = -1,
                  squeeze: bool = True, name=None):
         super().__init__(name)
@@ -182,6 +191,7 @@ class Mean(Module):
 
 
 class Sum(Module):
+    """Sum over a dim (DL/nn/Sum.scala)."""
     def __init__(self, dimension: int = 0, n_input_dims: int = -1,
                  size_average: bool = False, squeeze: bool = True, name=None):
         super().__init__(name)
@@ -207,6 +217,7 @@ class Pack(Module):
 
 
 class Tile(Module):
+    """Repeat along a dim (DL/nn/Tile.scala)."""
     def __init__(self, dim: int, copies: int = 2, name=None):
         super().__init__(name)
         self.dim, self.copies = dim, copies
@@ -229,6 +240,7 @@ class Replicate(Module):
 
 
 class Reverse(Module):
+    """Reverse along a dim (DL/nn/Reverse.scala)."""
     def __init__(self, dimension: int = 0, name=None):
         super().__init__(name)
         self.dimension = dimension
@@ -267,6 +279,7 @@ class SpatialZeroPadding(Module):
 
 
 class Cropping2D(Module):
+    """Crop rows/cols of NHWC images (DL/nn/Cropping2D.scala)."""
     def __init__(self, height_crop=(0, 0), width_crop=(0, 0), name=None):
         super().__init__(name)
         self.hc, self.wc = tuple(height_crop), tuple(width_crop)
@@ -277,6 +290,7 @@ class Cropping2D(Module):
 
 
 class Cropping3D(Module):
+    """Crop a 3-D volume (DL/nn/Cropping3D.scala)."""
     def __init__(self, dim1_crop=(0, 0), dim2_crop=(0, 0), dim3_crop=(0, 0), name=None):
         super().__init__(name)
         self.c1, self.c2, self.c3 = tuple(dim1_crop), tuple(dim2_crop), tuple(dim3_crop)
@@ -304,6 +318,7 @@ class MM(Module):
 
 
 class MV(Module):
+    """Matrix-vector product of a Table pair (DL/nn/MV.scala)."""
     def __init__(self, trans: bool = False, name=None):
         super().__init__(name)
         self.trans = trans
@@ -316,12 +331,14 @@ class MV(Module):
 
 
 class DotProduct(Module):
+    """Rowwise dot product of a Table pair (DL/nn/DotProduct.scala)."""
     def apply(self, params, input, ctx):
         a, b = input[1], input[2]
         return jnp.sum(a * b, axis=-1)
 
 
 class CosineDistance(Module):
+    """Cosine similarity of a Table pair (DL/nn/CosineDistance.scala)."""
     def apply(self, params, input, ctx):
         a, b = input[1], input[2]
         an = a / (jnp.linalg.norm(a, axis=-1, keepdims=True) + 1e-12)
@@ -330,6 +347,7 @@ class CosineDistance(Module):
 
 
 class PairwiseDistance(Module):
+    """Lp distance of a Table pair (DL/nn/PairwiseDistance.scala)."""
     def __init__(self, norm: int = 2, name=None):
         super().__init__(name)
         self.norm = norm

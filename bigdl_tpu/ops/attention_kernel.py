@@ -89,6 +89,7 @@ def attention_state_init(q):
 
 
 def attention_state_finish(acc, m, l):
+    """Normalize blockwise partial sums into the final attention output."""
     den = jnp.where(l == 0.0, 1.0, l)
     return acc / den[..., None]
 

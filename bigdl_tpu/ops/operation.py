@@ -166,14 +166,17 @@ class Any(_Reduce):
 
 
 class Sum(_Reduce):
+    """Reduce-sum over an axis operand (DL/nn/ops/Sum.scala)."""
     rfn = staticmethod(jnp.sum)
 
 
 class Prod(_Reduce):
+    """Reduce-prod over an axis operand (DL/nn/ops/Prod.scala)."""
     rfn = staticmethod(jnp.prod)
 
 
 class Max(_Reduce):
+    """Reduce-max over an axis operand (DL/nn/ops/Max.scala)."""
     rfn = staticmethod(jnp.max)
 
 

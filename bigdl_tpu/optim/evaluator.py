@@ -21,6 +21,7 @@ from bigdl_tpu.utils.table import Table
 
 
 class Evaluator:
+    """model.evaluate entry (DL/optim/Evaluator.scala)."""
     def __init__(self, model: Module, batch_size: int = 32,
                  predictor: LocalPredictor = None):
         self.model = model

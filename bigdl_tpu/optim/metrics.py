@@ -15,6 +15,7 @@ from typing import Dict
 
 
 class Metrics:
+    """Named phase timers for the train loop (DL/optim/Metrics.scala)."""
     def __init__(self):
         self._sum: Dict[str, float] = defaultdict(float)
         self._count: Dict[str, int] = defaultdict(int)

@@ -263,6 +263,7 @@ class LAMB(Adam):
 
 
 class Adagrad(OptimMethod):
+    """Per-coordinate accumulated-gradient scaling (DL/optim/Adagrad.scala)."""
     def __init__(self, learning_rate: float = 1e-3,
                  learning_rate_decay: float = 0.0, weight_decay: float = 0.0):
         super().__init__(learning_rate, weight_decay)
@@ -284,6 +285,7 @@ class Adagrad(OptimMethod):
 
 
 class Adadelta(OptimMethod):
+    """Accumulated-delta adaptive method (DL/optim/Adadelta.scala)."""
     def __init__(self, decay_rate: float = 0.9, epsilon: float = 1e-10,
                  weight_decay: float = 0.0):
         super().__init__(1.0, weight_decay)
@@ -307,6 +309,7 @@ class Adadelta(OptimMethod):
 
 
 class Adamax(OptimMethod):
+    """Adam with infinity-norm second moment (DL/optim/Adamax.scala)."""
     def __init__(self, learning_rate: float = 0.002, beta1: float = 0.9,
                  beta2: float = 0.999, epsilon: float = 1e-38,
                  weight_decay: float = 0.0):
@@ -331,6 +334,7 @@ class Adamax(OptimMethod):
 
 
 class RMSprop(OptimMethod):
+    """EMA-of-squares gradient scaling (DL/optim/RMSprop.scala)."""
     def __init__(self, learning_rate: float = 1e-2,
                  learning_rate_decay: float = 0.0, decay_rate: float = 0.99,
                  epsilon: float = 1e-8, weight_decay: float = 0.0):

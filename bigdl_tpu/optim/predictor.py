@@ -35,6 +35,7 @@ def _iter_modules(root: Module):
 
 
 class LocalPredictor:
+    """Single-device batched inference (DL/optim/LocalPredictor.scala)."""
     def __init__(self, model: Module, batch_size: int = 32,
                  convert: bool = True):
         if convert:

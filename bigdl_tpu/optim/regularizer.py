@@ -12,6 +12,7 @@ import jax.numpy as jnp
 
 
 class Regularizer:
+    """Base weight-penalty contract (DL/optim/Regularizer.scala)."""
     def grad_update(self, param, grad):
         return grad
 
@@ -20,6 +21,7 @@ class Regularizer:
 
 
 class L1L2Regularizer(Regularizer):
+    """Combined L1+L2 penalty (DL/optim/Regularizer.scala)."""
     def __init__(self, l1: float = 0.0, l2: float = 0.0):
         self.l1, self.l2 = l1, l2
 
@@ -41,10 +43,12 @@ class L1L2Regularizer(Regularizer):
 
 
 class L1Regularizer(L1L2Regularizer):
+    """L1 penalty (DL/optim/Regularizer.scala)."""
     def __init__(self, l1: float):
         super().__init__(l1=l1)
 
 
 class L2Regularizer(L1L2Regularizer):
+    """L2 penalty (DL/optim/Regularizer.scala)."""
     def __init__(self, l2: float):
         super().__init__(l2=l2)

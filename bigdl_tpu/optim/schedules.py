@@ -15,6 +15,7 @@ from typing import List, Optional, Sequence
 
 
 class LearningRateSchedule:
+    """Base: compute(optim) -> learning rate (SGD.scala LearningRateSchedule)."""
     def compute(self, optim: "SGD") -> float:  # noqa: F821
         raise NotImplementedError
 
@@ -53,6 +54,7 @@ class Step(LearningRateSchedule):
 
 
 class MultiStep(LearningRateSchedule):
+    """lr * gamma^(#milestones passed) (SGD.scala MultiStep)."""
     def __init__(self, step_sizes: Sequence[int], gamma: float):
         self.step_sizes, self.gamma = list(step_sizes), gamma
 
@@ -66,6 +68,7 @@ class MultiStep(LearningRateSchedule):
 
 
 class EpochDecay(LearningRateSchedule):
+    """lr * 0.1^decay(epoch) (SGD.scala EpochDecay)."""
     def __init__(self, decay_fn):
         self.decay_fn = decay_fn
 
@@ -75,6 +78,7 @@ class EpochDecay(LearningRateSchedule):
 
 
 class EpochStep(LearningRateSchedule):
+    """lr * gamma^(epoch/stepSize) (SGD.scala EpochStep)."""
     def __init__(self, step_size: int, gamma: float):
         self.step_size, self.gamma = step_size, gamma
 
@@ -84,6 +88,7 @@ class EpochStep(LearningRateSchedule):
 
 
 class NaturalExp(LearningRateSchedule):
+    """lr * exp(-gamma * iter/decayIter) (SGD.scala NaturalExp)."""
     def __init__(self, decay_step: int, gamma: float):
         self.decay_step, self.gamma = decay_step, gamma
 
@@ -93,6 +98,7 @@ class NaturalExp(LearningRateSchedule):
 
 
 class Exponential(LearningRateSchedule):
+    """lr * gamma^(iter/decayIter), optionally staircased (SGD.scala Exponential)."""
     def __init__(self, decay_step: int, decay_rate: float, staircase: bool = False):
         self.decay_step, self.decay_rate, self.staircase = decay_step, decay_rate, staircase
 
@@ -104,6 +110,7 @@ class Exponential(LearningRateSchedule):
 
 
 class Regime:
+    """An (startEpoch, endEpoch, config) span for EpochSchedule (SGD.scala Regime)."""
     def __init__(self, start_epoch: int, end_epoch: int, config: dict):
         self.start_epoch, self.end_epoch, self.config = start_epoch, end_epoch, config
 

@@ -37,31 +37,38 @@ def every_epoch() -> Trigger:
 
 
 def several_iteration(interval: int) -> Trigger:
+    """Fire every n iterations (Trigger.scala severalIteration)."""
     return Trigger(lambda s: s.get("neval", 0) % interval == 0
                    and s.get("neval", 0) > 0)
 
 
 def max_epoch(n: int) -> Trigger:
+    """Fire once epoch reaches n (Trigger.scala maxEpoch)."""
     return Trigger(lambda s: s.get("epoch", 0) >= n)
 
 
 def max_iteration(n: int) -> Trigger:
+    """Fire once neval reaches n (Trigger.scala maxIteration)."""
     return Trigger(lambda s: s.get("neval", 0) >= n)
 
 
 def max_score(v: float) -> Trigger:
+    """Fire once validation score exceeds s (Trigger.scala maxScore)."""
     return Trigger(lambda s: s.get("score", float("-inf")) > v)
 
 
 def min_loss(v: float) -> Trigger:
+    """Fire once loss drops below l (Trigger.scala minLoss)."""
     return Trigger(lambda s: s.get("loss", float("inf")) < v)
 
 
 def and_(*triggers: Trigger) -> Trigger:
+    """Trigger firing when BOTH triggers fire (Trigger.scala and)."""
     return Trigger(lambda s: all(t(s) for t in triggers))
 
 
 def or_(*triggers: Trigger) -> Trigger:
+    """Trigger firing when EITHER trigger fires (Trigger.scala or)."""
     return Trigger(lambda s: any(t(s) for t in triggers))
 
 

@@ -15,6 +15,7 @@ import numpy as np
 
 
 class ValidationResult:
+    """Base mergeable result contract (DL/optim/ValidationResult.scala)."""
     def result(self):
         raise NotImplementedError
 
@@ -23,6 +24,7 @@ class ValidationResult:
 
 
 class AccuracyResult(ValidationResult):
+    """correct/count pair, mergeable (DL/optim/ValidationResult.scala)."""
     def __init__(self, correct: float, count: float):
         self.correct, self.count = float(correct), float(count)
 
@@ -38,6 +40,7 @@ class AccuracyResult(ValidationResult):
 
 
 class LossResult(ValidationResult):
+    """Accumulated loss result (DL/optim/ValidationResult.scala)."""
     def __init__(self, loss: float, count: float):
         self.loss, self.count = float(loss), float(count)
 
@@ -53,6 +56,7 @@ class LossResult(ValidationResult):
 
 
 class ContiguousResult(LossResult):
+    """Scalar-sum result with count (DL/optim/ValidationResult.scala)."""
     pass
 
 
@@ -99,6 +103,7 @@ class Top1Accuracy(ValidationMethod):
 
 
 class Top5Accuracy(ValidationMethod):
+    """Target within top-5 predictions (DL/optim/ValidationMethod.scala Top5Accuracy)."""
     def __init__(self, zero_based: bool = False):
         self.zero_based = zero_based
 
@@ -116,6 +121,7 @@ class Top5Accuracy(ValidationMethod):
 
 
 class Loss(ValidationMethod):
+    """Mean criterion loss as a validation method (DL/optim/ValidationMethod.scala Loss)."""
     def __init__(self, criterion=None):
         if criterion is None:
             from bigdl_tpu.nn.criterion import ClassNLLCriterion
@@ -132,6 +138,7 @@ class Loss(ValidationMethod):
 
 
 class MAE(ValidationMethod):
+    """Mean absolute error validation method (DL/optim/ValidationMethod.scala MAE)."""
     def apply(self, output, target):
         # reference compares the 1-based max index to the target
         # (ValidationMethod.scala MAE)
@@ -174,6 +181,7 @@ class HitRatio(ValidationMethod):
 
 
 class NDCG(ValidationMethod):
+    """Ranking NDCG for recommendation (DL/optim/ValidationMethod.scala NDCG)."""
     def __init__(self, k: int = 10, neg_num: int = 100):
         self.k, self.neg_num = k, neg_num
 

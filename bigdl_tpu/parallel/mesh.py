@@ -48,6 +48,7 @@ def data_sharding(mesh: Mesh) -> NamedSharding:
 
 
 def replicate_sharding(mesh: Mesh) -> NamedSharding:
+    """NamedSharding replicating a value across the whole mesh."""
     return NamedSharding(mesh, P())
 
 

@@ -1,0 +1,107 @@
+#!/usr/bin/env python
+"""Generate docs/LAYERS.md — the complete public surface index.
+
+The reference documents every layer in its doc site's APIGuide; here the
+index is GENERATED from the live package so it cannot drift: every public
+export of bigdl_tpu.nn / .keras / .ops / .optim / .parallel with its
+docstring summary and the reference-file citation extracted from the
+docstring (the `(DL/...)` / `(reference ...)` parity markers).
+
+Run: python scripts/gen_layer_index.py   (rewrites docs/LAYERS.md)
+Checked by tests/test_docs_index.py: the committed file matches a fresh
+generation, so adding an export without regenerating fails the suite.
+"""
+
+import inspect
+import os
+import re
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+_CITE = re.compile(r"\(((?:reference\s+)?(?:DL|PY|loaders)/[^)]+?)\)")
+
+
+def _summary(obj):
+    # the class's OWN docstring only — inspect.getdoc falls back to the
+    # base class and would caption `Abs` with Module's docstring
+    if inspect.isclass(obj):
+        doc = obj.__dict__.get("__doc__") or ""
+    else:
+        doc = inspect.getdoc(obj) or ""
+    doc = inspect.cleandoc(doc) if doc else ""
+    first = doc.split("\n\n")[0].replace("\n", " ").strip()
+    cite = _CITE.search(doc)
+    # strip the citation from the prose so it gets its own column
+    if cite:
+        first = first.replace(f"({cite.group(1)})", "").strip()
+    first = re.sub(r"\s+", " ", first)
+    if len(first) > 160:
+        first = first[:157] + "..."
+    return first, (cite.group(1).replace("reference ", "") if cite else "")
+
+
+def _rows(pkg, names):
+    rows = []
+    for name in sorted(names):
+        obj = getattr(pkg, name)
+        kind = ("class" if inspect.isclass(obj)
+                else "fn" if callable(obj) else "alias")
+        summary, cite = _summary(obj)
+        rows.append((name, kind, summary, cite))
+    return rows
+
+
+def _emit(f, title, rows):
+    f.write(f"\n## {title} ({len(rows)} exports)\n\n")
+    f.write("| name | kind | summary | reference |\n|---|---|---|---|\n")
+    for name, kind, summary, cite in rows:
+        f.write(f"| `{name}` | {kind} | {summary or '—'} "
+                f"| {('`' + cite + '`') if cite else '—'} |\n")
+
+
+def _public(pkg):
+    names = getattr(pkg, "__all__", None)
+    if names:
+        return list(names)
+    return [n for n in dir(pkg)
+            if not n.startswith("_") and
+            (inspect.isclass(getattr(pkg, n)) or
+             inspect.isfunction(getattr(pkg, n)))]
+
+
+def main(out_path=None):
+    import bigdl_tpu.keras as keras
+    import bigdl_tpu.nn as nn
+    import bigdl_tpu.ops as ops
+    import bigdl_tpu.optim as optim
+    import bigdl_tpu.parallel as parallel
+
+    out_path = out_path or os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "docs", "LAYERS.md")
+    with open(out_path, "w") as f:
+        f.write(
+            "# Public surface index\n\n"
+            "GENERATED — do not edit by hand; run "
+            "`python scripts/gen_layer_index.py`.\n"
+            "One row per public export, with the reference-parity citation "
+            "extracted from the docstring where the symbol maps to a "
+            "reference file. `tests/test_docs_index.py` keeps this file in "
+            "sync with the package.\n")
+        _emit(f, "bigdl_tpu.nn — layers, containers, criterions",
+              _rows(nn, _public(nn)))
+        _emit(f, "bigdl_tpu.keras — Keras-style API",
+              _rows(keras, _public(keras)))
+        _emit(f, "bigdl_tpu.ops — TF-style ops & feature columns",
+              _rows(ops, _public(ops)))
+        _emit(f, "bigdl_tpu.optim — methods, schedules, triggers, metrics",
+              _rows(optim, _public(optim)))
+        _emit(f, "bigdl_tpu.parallel — mesh, sharding, pp/ep/sp",
+              _rows(parallel, _public(parallel)))
+    return out_path
+
+
+if __name__ == "__main__":
+    print(main())

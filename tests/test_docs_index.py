@@ -1,0 +1,45 @@
+"""docs/LAYERS.md is generated; this guard keeps it in sync with the
+package (add an export or docstring -> regenerate or this fails)."""
+
+import importlib.util
+import os
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_layers_index_in_sync(tmp_path):
+    spec = importlib.util.spec_from_file_location(
+        "gen_layer_index", os.path.join(REPO, "scripts",
+                                        "gen_layer_index.py"))
+    gen = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(gen)
+    fresh = str(tmp_path / "LAYERS.md")
+    gen.main(fresh)
+    with open(fresh) as f, open(os.path.join(REPO, "docs",
+                                             "LAYERS.md")) as g:
+        assert f.read() == g.read(), (
+            "docs/LAYERS.md is stale — run python scripts/gen_layer_index.py")
+
+
+def test_every_public_export_documented():
+    """The parity bar the reference sets with its per-layer docs: every
+    public class/function in the five user-facing packages carries its OWN
+    docstring (no silent inheritance from Module)."""
+    import inspect
+    import bigdl_tpu.keras, bigdl_tpu.nn, bigdl_tpu.ops  # noqa: E401
+    import bigdl_tpu.optim, bigdl_tpu.parallel  # noqa: E401
+
+    undocumented = []
+    for pkg in (bigdl_tpu.nn, bigdl_tpu.keras, bigdl_tpu.ops,
+                bigdl_tpu.optim, bigdl_tpu.parallel):
+        names = getattr(pkg, "__all__", None) or [
+            n for n in dir(pkg) if not n.startswith("_") and
+            (inspect.isclass(getattr(pkg, n)) or
+             inspect.isfunction(getattr(pkg, n)))]
+        for n in sorted(set(names)):
+            obj = getattr(pkg, n)
+            if inspect.isclass(obj) and not obj.__dict__.get("__doc__"):
+                undocumented.append(f"{pkg.__name__}.{n}")
+            elif inspect.isfunction(obj) and not obj.__doc__:
+                undocumented.append(f"{pkg.__name__}.{n}()")
+    assert not undocumented, undocumented
