@@ -486,22 +486,28 @@ class TestEndToEnd:
 
 
 class TestCheckpointSlots:
-    def test_opt_slots_roundtrip(self, tmp_path):
+    @pytest.mark.parametrize("method_cls",
+                             [optim.Adam, optim.AdamW, optim.LAMB],
+                             ids=["adam", "adamw", "lamb"])
+    def test_opt_slots_roundtrip(self, tmp_path, method_cls):
+        """Optimizer moments survive the checkpoint (the failure-recovery
+        path restores them on retry); both m and v slots checked."""
         import bigdl_tpu.nn as nn2
         from bigdl_tpu.serialization.checkpoint import (load_checkpoint,
                                                         save_checkpoint)
         m = nn2.Linear(4, 2)
         params = m.init(jax.random.PRNGKey(0))
-        method = optim.Adam()
+        method = method_cls()
         slots = method.init_state(params)
         slots = jax.tree_util.tree_map(lambda x: x + 1.0, slots)
         ck = save_checkpoint(str(tmp_path), m, params, {}, method,
                              opt_slots=slots, tag="t1")
         _, _, blob = load_checkpoint(ck)
         assert blob["slots"] is not None
-        np.testing.assert_allclose(
-            np.asarray(blob["slots"]["m"]["weight"]),
-            np.asarray(slots["m"]["weight"]))
+        for slot in ("m", "v"):
+            np.testing.assert_allclose(
+                np.asarray(blob["slots"][slot]["weight"]),
+                np.asarray(slots[slot]["weight"]))
 
     def test_epoch_schedule_regime(self):
         s = optim.SGD(learning_rate=1.0, learning_rate_schedule=optim.EpochSchedule([
