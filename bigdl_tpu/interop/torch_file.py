@@ -50,18 +50,28 @@ class _Reader:
         self.f = f
         self.memo: Dict[int, Any] = {}
 
+    def _exact(self, n: int) -> bytes:
+        """Read exactly n bytes; a short read means the file is truncated
+        — fail with the diagnosis, not with whatever the bytes misparse
+        into downstream."""
+        buf = self.f.read(n)
+        if len(buf) != n:
+            raise ValueError(
+                f"truncated .t7 stream: wanted {n} bytes, got {len(buf)}")
+        return buf
+
     def i32(self) -> int:
-        return struct.unpack("<i", self.f.read(4))[0]
+        return struct.unpack("<i", self._exact(4))[0]
 
     def i64(self) -> int:
-        return struct.unpack("<q", self.f.read(8))[0]
+        return struct.unpack("<q", self._exact(8))[0]
 
     def f64(self) -> float:
-        return struct.unpack("<d", self.f.read(8))[0]
+        return struct.unpack("<d", self._exact(8))[0]
 
     def string(self) -> str:
         n = self.i32()
-        return self.f.read(n).decode("utf-8", errors="replace")
+        return self._exact(n).decode("utf-8", errors="replace")
 
     def read_object(self) -> Any:
         t = self.i32()
@@ -122,7 +132,9 @@ class _Reader:
         if cls in _STORAGE_CLASSES:
             n = self.i64()
             dtype = np.dtype(_STORAGE_CLASSES[cls])
-            return np.frombuffer(self.f.read(n * dtype.itemsize),
+            if n < 0:
+                raise ValueError(f"corrupt .t7 storage length {n}")
+            return np.frombuffer(self._exact(n * dtype.itemsize),
                                  dtype).copy()
         raise ValueError(f"unsupported torch class in .t7: {cls}")
 
@@ -212,7 +224,12 @@ class TorchFile:
     @staticmethod
     def load(path: str) -> Any:
         with open(path, "rb") as f:
-            return _Reader(f).read_object()
+            try:
+                return _Reader(f).read_object()
+            except (struct.error, ValueError) as e:
+                # name WHICH file is damaged; the cause says how
+                raise ValueError(
+                    f"failed to load .t7 file {path}: {e}") from e
 
     @staticmethod
     def save(obj: Any, path: str):

@@ -145,7 +145,11 @@ class NativeTFRecordReader:
         if masked_crc32c(header[:8]) != len_crc:
             raise IOError(f"corrupt TFRecord length: {self.path}")
         data = self._pyfile.read(length)
-        (data_crc,) = struct.unpack("<I", self._pyfile.read(4))
+        crc_buf = self._pyfile.read(4)
+        if len(data) < length or len(crc_buf) < 4:
+            # short read after a VALID length header = file cut mid-record
+            raise IOError(f"truncated TFRecord: {self.path}")
+        (data_crc,) = struct.unpack("<I", crc_buf)
         if masked_crc32c(data) != data_crc:
             raise IOError(f"corrupt TFRecord data: {self.path}")
         return data
