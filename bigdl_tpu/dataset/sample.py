@@ -70,7 +70,16 @@ class MiniBatch:
     silently round-trip it device->host->device, which on a tunneled TPU
     costs seconds per step (the reference's broadcast-and-persist perf
     driver, DistriOptimizerPerf.scala:108-118, exists precisely to avoid
-    per-iteration ingest)."""
+    per-iteration ingest).
+
+    Example:
+        >>> import numpy as np
+        >>> from bigdl_tpu.dataset.sample import MiniBatch
+        >>> mb = MiniBatch(np.ones((4, 3), np.float32),
+        ...                np.ones((4,), np.int32))
+        >>> mb.size()
+        4
+    """
 
     @staticmethod
     def _norm(x):

@@ -78,6 +78,17 @@ class Tensor:
         Tensor(3, 4)            # zeros of shape (3, 4)
         Tensor(ndarray)         # copy data (host or jax array, nested list)
         Tensor()                # empty 0-element tensor
+
+    Example (1-based indexing, storage-sharing views — the reference's
+    DenseTensor contract):
+        >>> from bigdl_tpu.tensor import Tensor
+        >>> t = Tensor([[1.0, 2.0], [3.0, 4.0]])
+        >>> t.valueAt(1, 2)
+        2.0
+        >>> row = t.select(1, 1)        # view of the first row
+        >>> _ = row.fill(9.0)           # in-place write through the view
+        >>> t.to_numpy().tolist()       # ...observed by the base tensor
+        [[9.0, 9.0], [3.0, 4.0]]
     """
 
     def __init__(self, *args, dtype=None):
