@@ -83,6 +83,42 @@ class TestOptimMethods:
         np.testing.assert_allclose(np.asarray(p1["w"]), tp.detach().numpy(),
                                    rtol=1e-5, atol=1e-6)
 
+    @pytest.mark.parametrize("ours,theirs", [
+        # NOTE our dampening defaults to `momentum` (torch7/reference
+        # SGD.scala convention); torch.optim defaults to 0 — align
+        (lambda: optim.SGD(learning_rate=0.05, momentum=0.9,
+                           dampening=0.0),
+         lambda p, t: t.optim.SGD([p], lr=0.05, momentum=0.9)),
+        (lambda: optim.SGD(learning_rate=0.05, momentum=0.9, dampening=0.0,
+                           nesterov=True),
+         lambda p, t: t.optim.SGD([p], lr=0.05, momentum=0.9,
+                                  nesterov=True)),
+        (lambda: optim.RMSprop(learning_rate=0.01, decay_rate=0.9),
+         lambda p, t: t.optim.RMSprop([p], lr=0.01, alpha=0.9, eps=1e-8)),
+        (lambda: optim.Adagrad(learning_rate=0.05),
+         lambda p, t: t.optim.Adagrad([p], lr=0.05, eps=1e-10)),
+    ], ids=["sgd_momentum", "nesterov", "rmsprop", "adagrad"])
+    def test_trajectory_vs_torch_multistep(self, ours, theirs):
+        """Eight-step trajectories on identical gradient streams: moment
+        buffers, dampening, and epsilon placement all have to line up,
+        which a single step cannot distinguish."""
+        torch = pytest.importorskip("torch")
+        m = ours()
+        p = {"w": jnp.asarray([1.0, -2.0, 3.0, 0.5])}
+        s = m.init_state(p)
+        tp = torch.tensor([1.0, -2.0, 3.0, 0.5], requires_grad=True)
+        topt = theirs(tp, torch)
+        rs = np.random.RandomState(5)
+        for _ in range(8):
+            g = rs.randn(4).astype(np.float32)
+            lr = m.current_lr()
+            p, s = m.update({"w": jnp.asarray(g)}, s, p, lr)
+            m.state["neval"] += 1
+            tp.grad = torch.tensor(g)
+            topt.step()
+        np.testing.assert_allclose(np.asarray(p["w"]), tp.detach().numpy(),
+                                   rtol=1e-5, atol=1e-6)
+
     def test_adamw_vs_torch_multistep(self):
         """Decoupled decay over SEVERAL steps (one step cannot distinguish
         AdamW from Adam+L2 strongly; five can)."""
