@@ -1,0 +1,57 @@
+"""Random-composition serialization property: any randomly assembled
+Sequential round-trips with identical eval-mode behavior.
+
+The registry sweep (test_serialization_sweep.py) proves every module
+round-trips ALONE; this sweep proves COMPOSITIONS do — ctor capture,
+nesting, shared-storage dedup and state all surviving together, which is
+what real checkpoints contain.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import bigdl_tpu.nn as nn
+from bigdl_tpu.serialization import ModuleSerializer
+
+
+def _random_mlp(rs):
+    dims = [6] + [int(rs.randint(3, 12)) for _ in range(rs.randint(1, 4))]
+    m = nn.Sequential()
+    for d_in, d_out in zip(dims, dims[1:]):
+        m.add(nn.Linear(d_in, d_out, with_bias=bool(rs.randint(0, 2))))
+        act = rs.randint(0, 4)
+        if act == 0:
+            m.add(nn.ReLU())
+        elif act == 1:
+            m.add(nn.Tanh())
+        elif act == 2:
+            m.add(nn.BatchNormalization(d_out))
+        # act == 3: no activation
+        if rs.randint(0, 3) == 0:
+            m.add(nn.Dropout(0.3))
+    if rs.randint(0, 2):
+        # a branchy tail: ConcatTable -> CAddTable residual-ish pair
+        d = dims[-1]
+        m.add(nn.ConcatTable()
+              .add(nn.Linear(d, d))
+              .add(nn.Identity()))
+        m.add(nn.CAddTable())
+    return m
+
+
+@pytest.mark.parametrize("seed", range(15))
+def test_random_sequential_roundtrip(tmp_path, seed):
+    rs = np.random.RandomState(seed)
+    model = _random_mlp(rs)
+    x = jnp.asarray(rs.rand(5, 6).astype(np.float32))
+    # settle params + BN state with one training pass
+    model.forward(x, training=True, rng=jax.random.PRNGKey(seed))
+    want = np.asarray(model.forward(x, training=False))
+
+    path = str(tmp_path / f"m{seed}.bigdl")
+    ModuleSerializer.save(model, path)
+    loaded = ModuleSerializer.load(path)
+    got = np.asarray(loaded.forward(x, training=False))
+    np.testing.assert_allclose(got, want, rtol=1e-6, atol=1e-7)
