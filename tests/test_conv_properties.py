@@ -1,0 +1,75 @@
+"""Random-configuration conv/pool sweep vs torch.
+
+test_golden.py pins hand-picked configurations; this sweep draws random
+(kernel, stride, pad, groups, shape) combinations — the space where
+off-by-one padding and group-reshape bugs hide — and checks forward
+outputs against torch on every one.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+import torch
+import torch.nn.functional as F
+
+import bigdl_tpu.nn as nn
+
+
+@pytest.mark.parametrize("seed", range(25))
+def test_random_conv2d_config_matches_torch(seed):
+    rs = np.random.RandomState(seed)
+    kh, kw = int(rs.randint(1, 5)), int(rs.randint(1, 5))
+    sh, sw = int(rs.randint(1, 4)), int(rs.randint(1, 4))
+    ph, pw = int(rs.randint(0, 3)), int(rs.randint(0, 3))
+    groups = int(rs.choice([1, 1, 2]))
+    c_in = int(rs.randint(1, 4)) * groups
+    c_out = int(rs.randint(1, 4)) * groups
+    h = int(rs.randint(max(kh, 6), 14))
+    w = int(rs.randint(max(kw, 6), 14))
+
+    m = nn.SpatialConvolution(c_in, c_out, kw, kh, sw, sh, pad_w=pw,
+                              pad_h=ph, n_group=groups)
+    m.set_params(m.init(jax.random.PRNGKey(seed)))
+    params = m.ensure_params()
+    x = rs.rand(2, h, w, c_in).astype(np.float32)
+
+    ours = np.asarray(m.forward(jnp.asarray(x)))
+
+    # torch: NCHW, weight [out, in/groups, kh, kw]
+    tw = torch.from_numpy(
+        np.transpose(np.asarray(params["weight"]), (3, 2, 0, 1)).copy())
+    tb = torch.from_numpy(np.asarray(params["bias"]).copy())
+    tx = torch.from_numpy(np.transpose(x, (0, 3, 1, 2)).copy())
+    want = F.conv2d(tx, tw, tb, stride=(sh, sw), padding=(ph, pw),
+                    groups=groups)
+    want = np.transpose(want.numpy(), (0, 2, 3, 1))
+    np.testing.assert_allclose(
+        ours, want, rtol=1e-4, atol=1e-5,
+        err_msg=f"k=({kh},{kw}) s=({sh},{sw}) p=({ph},{pw}) g={groups} "
+                f"cin={c_in} cout={c_out} hw=({h},{w})")
+
+
+@pytest.mark.parametrize("seed", range(15))
+def test_random_pool_config_matches_torch(seed):
+    rs = np.random.RandomState(100 + seed)
+    k = int(rs.randint(2, 5))
+    s = int(rs.randint(1, 4))
+    p = int(rs.randint(0, (k + 1) // 2))
+    c = int(rs.randint(1, 5))
+    h = int(rs.randint(8, 16))
+    kind = "max" if rs.randint(0, 2) else "avg"
+
+    x = rs.rand(2, h, h, c).astype(np.float32)
+    tx = torch.from_numpy(np.transpose(x, (0, 3, 1, 2)).copy())
+    if kind == "max":
+        m = nn.SpatialMaxPooling(k, k, s, s, pad_w=p, pad_h=p)
+        want = F.max_pool2d(tx, k, stride=s, padding=p)
+    else:
+        m = nn.SpatialAveragePooling(k, k, s, s, pad_w=p, pad_h=p)
+        want = F.avg_pool2d(tx, k, stride=s, padding=p)
+    ours = np.asarray(m.forward(jnp.asarray(x)))
+    want = np.transpose(want.numpy(), (0, 2, 3, 1))
+    np.testing.assert_allclose(
+        ours, want, rtol=1e-5, atol=1e-6,
+        err_msg=f"{kind} k={k} s={s} p={p} c={c} h={h}")
