@@ -1,0 +1,49 @@
+"""Keras shape-inference property: for randomly assembled stacks, the
+DECLARED output shape (compute_output_shape chain) must equal the ACTUAL
+forward shape. The reference's Keras layers carry the same contract
+(KerasBaseSpec shape checks); a drift here breaks model summaries and
+downstream layer construction silently.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import bigdl_tpu.keras as K
+
+
+def _random_stack(rs):
+    """A random but shape-consistent image stack, then a dense tail."""
+    h = int(rs.randint(12, 21))
+    c = int(rs.randint(1, 4))
+    m = K.Sequential()
+    first = True
+    spatial = (h, h, c)
+    for _ in range(int(rs.randint(1, 4))):
+        kind = rs.randint(0, 4)
+        kw = dict(input_shape=spatial) if first else {}
+        first = False
+        if kind == 0:
+            m.add(K.Convolution2D(int(rs.randint(2, 6)), 3, 3,
+                                  border_mode=str(rs.choice(
+                                      ["same", "valid"])), **kw))
+        elif kind == 1:
+            m.add(K.MaxPooling2D(**kw))
+        elif kind == 2:
+            m.add(K.AveragePooling2D(**kw))
+        else:
+            m.add(K.ZeroPadding2D(**kw))
+    m.add(K.Flatten())
+    m.add(K.Dense(int(rs.randint(2, 8))))
+    return m, (h, h, c)
+
+
+@pytest.mark.parametrize("seed", range(20))
+def test_declared_shape_equals_actual(seed):
+    rs = np.random.RandomState(seed)
+    model, in_shape = _random_stack(rs)
+    declared = tuple(model.get_output_shape())[1:]  # drop batch ('None')
+    x = jnp.asarray(rs.rand(2, *in_shape).astype(np.float32))
+    out = model.forward(x)
+    assert tuple(out.shape[1:]) == declared, (
+        f"declared {declared} != actual {out.shape[1:]}")
