@@ -23,6 +23,17 @@ def _conv(n_in, n_out, k, stride=1, pad=0, name=None):
             .add(nn.ReLU()))
 
 
+def _stem7(s2d: bool, name: str) -> nn.Sequential:
+    """The 7x7/s2 stem; s2d=True restates it through space-to-depth
+    (`nn.SpaceToDepthStemConvolution` — same parameters and math,
+    MXU-friendly tiling; see docs/PERF.md)."""
+    if not s2d:
+        return _conv(3, 64, 7, 2, 3, name=name)
+    conv = nn.SpaceToDepthStemConvolution(3, 64, 7, with_bias=True,
+                                          weight_init=Xavier(), name=name)
+    return nn.Sequential().add(conv).add(nn.ReLU())
+
+
 def inception_module(n_in, c1, c3r, c3, c5r, c5, pool_proj, name=""):
     """One Inception block (Inception_v1.scala inception())."""
     concat = nn.Concat(axis=3, name=name)  # NHWC channel axis
@@ -40,9 +51,10 @@ def inception_module(n_in, c1, c3r, c3, c5r, c5, pool_proj, name=""):
 
 
 def Inception_v1_NoAuxClassifier(class_num: int = 1000,
-                                 has_dropout: bool = True) -> nn.Sequential:
+                                 has_dropout: bool = True,
+                                 s2d_stem: bool = False) -> nn.Sequential:
     m = (nn.Sequential(name="Inception_v1")
-         .add(_conv(3, 64, 7, 2, 3, name="conv1/7x7_s2"))
+         .add(_stem7(s2d_stem, name="conv1/7x7_s2"))
          .add(nn.SpatialMaxPooling(3, 3, 2, 2).ceil())
          .add(nn.SpatialCrossMapLRN(5, 0.0001, 0.75))
          .add(_conv(64, 64, 1, name="conv2/3x3_reduce"))
@@ -86,12 +98,13 @@ def _aux_head(n_in: int, class_num: int, side: int, name: str,
 
 
 def Inception_v1(class_num: int = 1000,
-                 has_dropout: bool = True) -> nn.Sequential:
+                 has_dropout: bool = True,
+                 s2d_stem: bool = False) -> nn.Sequential:
     """Training form with the two auxiliary heads: output is
     [B, 3*class_num] = concat(main, aux2, aux1) on the class axis
     (Inception_v1.scala Inception_v1.apply, split1/split2 Concats)."""
     feature1 = (nn.Sequential(name="feature1")
-                .add(_conv(3, 64, 7, 2, 3, name="conv1/7x7_s2"))
+                .add(_stem7(s2d_stem, name="conv1/7x7_s2"))
                 .add(nn.SpatialMaxPooling(3, 3, 2, 2).ceil())
                 .add(nn.SpatialCrossMapLRN(5, 0.0001, 0.75))
                 .add(_conv(64, 64, 1, name="conv2/3x3_reduce"))

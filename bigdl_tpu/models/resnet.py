@@ -106,19 +106,27 @@ _IMAGENET_CFG = {
 
 def ResNet(class_num: int = 1000, depth: int = 50, shortcut_type: str = "B",
            data_set: str = "ImageNet", zero_gamma: bool = True,
-           remat: bool = False) -> nn.Sequential:
+           remat: bool = False, s2d_stem: bool = False) -> nn.Sequential:
     """Reference ResNet.apply (DL/models/resnet/ResNet.scala).
 
     remat=True wraps every residual block in `nn.Remat`
     (jax.checkpoint): backward-pass activations are recomputed instead
     of stored, cutting peak HBM ~linearly in depth — enables larger
-    per-chip batches on TPU at ~1.3x step FLOPs."""
+    per-chip batches on TPU at ~1.3x step FLOPs.
+
+    s2d_stem=True computes conv1 through the 2x2 space-to-depth
+    reformulation (`nn.SpaceToDepthStemConvolution`) — bit-for-bit the
+    same parameter tree and the same math, restated so the 7x7/s2
+    3-channel stem tiles the MXU well (the standard TPU ResNet trick)."""
     if data_set.lower() in ("cifar10", "cifar-10"):
         return _cifar_resnet(class_num, depth, shortcut_type)
     kind, reps = _IMAGENET_CFG[depth]
     widths = [64, 128, 256, 512]
+    stem = (nn.SpaceToDepthStemConvolution(3, 64, 7, weight_init=MsraFiller(),
+                                           name="conv1")
+            if s2d_stem else _conv(3, 64, 7, 2, 3, name="conv1"))
     model = (nn.Sequential(name=f"ResNet{depth}")
-             .add(_conv(3, 64, 7, 2, 3, name="conv1"))
+             .add(stem)
              .add(_bn(64))
              .add(nn.ReLU())
              .add(nn.SpatialMaxPooling(3, 3, 2, 2, pad_w=1, pad_h=1)))

@@ -20,6 +20,7 @@ from bigdl_tpu.nn.linear import (Add, AddConstant, Bilinear, CAdd, CMul,
                                  Cosine, Euclidean, Highway, Linear, Maxout,
                                  Mul, MulConstant, Scale)
 from bigdl_tpu.nn.conv import (LocallyConnected1D, LocallyConnected2D,
+                               SpaceToDepthStemConvolution,
                                SpatialConvolution, SpatialConvolutionMap,
                                SpatialDilatedConvolution, SpatialFullConvolution,
                                SpatialSeparableConvolution,

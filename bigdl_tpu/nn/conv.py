@@ -118,6 +118,73 @@ class SpatialConvolution(Module):
 SpatialShareConvolution = SpatialConvolution
 
 
+class SpaceToDepthStemConvolution(SpatialConvolution):
+    """Stride-2 stem conv computed through a 2x2 space-to-depth transform.
+
+    Mathematically identical to `SpatialConvolution(k, k, stride=2,
+    pad=(k-1)//2)` with the same weights — the parameter tree has the
+    SAME shapes (``(k, k, C_in, C_out)`` + bias), so checkpoints are
+    interchangeable with the plain stem — but the compute is restated as
+    a stride-1 conv on the 2x2-block space-to-depth input:
+
+      (B, H, W, C) -> (B, H/2, W/2, 4C), kernel (k+1)/2 square over 4C.
+
+    Why: ResNet-style stems (7x7/s2 over 3 channels at 224x224) are the
+    classic memory-bound MXU-hostile op — the reduction dimension is
+    k*k*3 = 147 over a huge spatial extent. The transform quadruples the
+    channel count and quarters the spatial extent, giving XLA tiles that
+    fit the 128-lane MXU reduction far better (the standard TPU ResNet
+    trick, e.g. MLPerf TPU submissions). The kernel is zero-padded to
+    (k+1) and re-blocked at trace time (a few-KB reshape, fused by XLA).
+
+    Requires odd k with k % 4 == 3 (3, 7, 11, ...), stride 2,
+    pad = (k-1)//2, groups = 1, and even input H, W.
+
+    Reference contrast: DL/models/resnet/ResNet.scala:265 builds the
+    plain 7x7/s2 stem; the reference has no equivalent because im2col on
+    CPU is layout-insensitive. Round-3 perf work (docs/PERF.md) measured
+    the stem as part of the residual memory-bound share.
+    """
+
+    def __init__(self, n_input_plane: int, n_output_plane: int,
+                 kernel: int = 7, with_bias: bool = False,
+                 weight_init: Optional[InitializationMethod] = None,
+                 bias_init: Optional[InitializationMethod] = None,
+                 name: Optional[str] = None, dtype=jnp.float32):
+        if kernel % 4 != 3:
+            raise ValueError(
+                f"SpaceToDepthStemConvolution needs kernel % 4 == 3, got {kernel}")
+        super().__init__(n_input_plane, n_output_plane, kernel, kernel,
+                         2, 2, pad_w=(kernel - 1) // 2, pad_h=(kernel - 1) // 2,
+                         with_bias=with_bias, weight_init=weight_init,
+                         bias_init=bias_init, name=name, dtype=dtype)
+
+    def apply(self, params, input, ctx):
+        x = input
+        b, h, w, c = x.shape
+        if h % 2 or w % 2:
+            raise ValueError(f"input spatial dims must be even, got {h}x{w}")
+        k, o = self.kh, self.n_out
+        kt = (k + 1) // 2          # transformed kernel size
+        front = (self.pad_h + 1) // 2
+        rear = kt - 1 - front
+        # 2x2 space-to-depth, channel order (h_offset, w_offset, c)
+        x2 = x.reshape(b, h // 2, 2, w // 2, 2, c)
+        x2 = x2.transpose(0, 1, 3, 2, 4, 5).reshape(b, h // 2, w // 2, 4 * c)
+        # zero-pad the kernel front edge to even size, then re-block so tap
+        # (2i+a, 2j+b, cin) lands at transformed tap (i, j, a*2c + b*c + cin)
+        wk = jnp.pad(params["weight"], ((1, 0), (1, 0), (0, 0), (0, 0)))
+        wk = wk.reshape(kt, 2, kt, 2, c, o).transpose(0, 2, 1, 3, 4, 5)
+        wk = wk.reshape(kt, kt, 4 * c, o)
+        y = lax.conv_general_dilated(
+            x2, wk, window_strides=(1, 1),
+            padding=((front, rear), (front, rear)),
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        if self.with_bias:
+            y = y + params["bias"]
+        return y
+
+
 class SpatialDilatedConvolution(SpatialConvolution):
     """Atrous conv (DL/nn/SpatialDilatedConvolution.scala)."""
 

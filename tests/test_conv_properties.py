@@ -73,3 +73,82 @@ def test_random_pool_config_matches_torch(seed):
     np.testing.assert_allclose(
         ours, want, rtol=1e-5, atol=1e-6,
         err_msg=f"{kind} k={k} s={s} p={p} c={c} h={h}")
+
+
+class TestSpaceToDepthStem:
+    """`SpaceToDepthStemConvolution` must equal the plain stride-2 conv
+    bit-for-bit in parameters and numerically in outputs — it is a compute
+    restatement, not a different layer."""
+
+    @pytest.mark.parametrize("k,c_in,c_out,h", [
+        (7, 3, 64, 32),   # the ResNet-50 stem shape (reduced spatial)
+        (7, 3, 8, 30),    # non-multiple-of-4 spatial
+        (3, 5, 7, 16),    # k=3 branch (k % 4 == 3)
+        (11, 2, 4, 26),
+    ])
+    def test_matches_plain_conv(self, k, c_in, c_out, h):
+        pad = (k - 1) // 2
+        plain = nn.SpatialConvolution(c_in, c_out, k, k, 2, 2, pad_w=pad,
+                                      pad_h=pad, with_bias=True)
+        s2d = nn.SpaceToDepthStemConvolution(c_in, c_out, k, with_bias=True)
+        params = plain.init(jax.random.PRNGKey(0))
+        assert jax.tree_util.tree_map(jnp.shape, params) == \
+            jax.tree_util.tree_map(jnp.shape, s2d.init(jax.random.PRNGKey(0)))
+        plain.set_params(params)
+        s2d.set_params(params)
+        x = jnp.asarray(np.random.RandomState(1).rand(2, h, h, c_in),
+                        jnp.float32)
+        np.testing.assert_allclose(np.asarray(s2d.forward(x)),
+                                   np.asarray(plain.forward(x)),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_gradients_match(self):
+        from bigdl_tpu.nn.module import functional_apply
+        plain = nn.SpatialConvolution(3, 8, 7, 7, 2, 2, pad_w=3, pad_h=3,
+                                      with_bias=False)
+        s2d = nn.SpaceToDepthStemConvolution(3, 8, 7)
+        params = plain.init(jax.random.PRNGKey(2))
+        x = jnp.asarray(np.random.RandomState(3).rand(2, 16, 16, 3),
+                        jnp.float32)
+
+        def loss(mod, p):
+            return jnp.sum(functional_apply(mod, p, x)[0] ** 2)
+
+        gp = jax.grad(lambda p: loss(plain, p))(params)
+        gs = jax.grad(lambda p: loss(s2d, p))(params)
+        jax.tree_util.tree_map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-4), gp, gs)
+
+    def test_rejects_bad_kernel_and_odd_input(self):
+        with pytest.raises(ValueError):
+            nn.SpaceToDepthStemConvolution(3, 8, 5)
+        m = nn.SpaceToDepthStemConvolution(3, 8, 7)
+        with pytest.raises(ValueError):
+            m.forward(jnp.ones((1, 15, 16, 3)))
+
+    def test_resnet_s2d_flag_equivalent(self):
+        from bigdl_tpu.models.resnet import ResNet
+        a = ResNet(class_num=10, depth=18, s2d_stem=False)
+        b = ResNet(class_num=10, depth=18, s2d_stem=True)
+        params = a.init(jax.random.PRNGKey(4))
+        a.set_params(params)
+        b.set_params(params)
+        x = jnp.asarray(np.random.RandomState(5).rand(2, 64, 64, 3),
+                        jnp.float32)
+        np.testing.assert_allclose(np.asarray(b.forward(x)),
+                                   np.asarray(a.forward(x)),
+                                   rtol=1e-4, atol=1e-4)
+
+    def test_inception_s2d_flag_equivalent(self):
+        from bigdl_tpu.models.inception import Inception_v1_NoAuxClassifier
+        a = Inception_v1_NoAuxClassifier(10, has_dropout=False)
+        b = Inception_v1_NoAuxClassifier(10, has_dropout=False, s2d_stem=True)
+        params = a.init(jax.random.PRNGKey(6))
+        a.set_params(params)
+        b.set_params(params)
+        x = jnp.asarray(np.random.RandomState(7).rand(2, 224, 224, 3),
+                        jnp.float32)
+        np.testing.assert_allclose(np.asarray(b.forward(x)),
+                                   np.asarray(a.forward(x)),
+                                   rtol=1e-4, atol=1e-4)

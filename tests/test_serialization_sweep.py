@@ -71,6 +71,8 @@ SPECS = {
 
     # convolution family (NHWC)
     "SpatialConvolution": (lambda: nn.SpatialConvolution(3, 4, 3, 3), IMG),
+    "SpaceToDepthStemConvolution": (
+        lambda: nn.SpaceToDepthStemConvolution(3, 4, 3), IMG),
     "SpatialShareConvolution": (
         lambda: nn.SpatialShareConvolution(3, 4, 3, 3), IMG),
     "SpatialDilatedConvolution": (
