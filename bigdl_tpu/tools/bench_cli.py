@@ -205,12 +205,18 @@ def bench_attention():
     key = jax.random.PRNGKey(0)
 
     def timed(fn, qkv, tag, t_len, n=20):
-        f = jax.jit(lambda q, k, v: fn(q, k, v, True))  # causal
-        f(*qkv).block_until_ready()  # compile
+        # sync by SCALAR FETCH, not block_until_ready: on the tunneled
+        # backend block_until_ready returns at enqueue time (see
+        # utils.profiling.device_sync), which once timed this kernel at
+        # 0.05 ms / 2800 TFLOP/s. The on-device sum is noise vs the
+        # attention itself; the fetch is 4 bytes.
+        f = jax.jit(
+            lambda q, k, v: jnp.sum(fn(q, k, v, True).astype(jnp.float32)))
+        float(f(*qkv))  # compile + drain
         t0 = time.perf_counter()
         for _ in range(n):
-            o = f(*qkv)
-        o.block_until_ready()
+            s = f(*qkv)
+        float(s)
         dt = (time.perf_counter() - t0) / n
         # causal attention: 2 matmuls x 2*B*H*T^2*D flops, half masked
         fl = 2 * B * H * t_len * t_len * D * 2 / 2
@@ -355,7 +361,7 @@ def _accel_responsive(timeout_s: float = 150.0, attempts: int = 4,
         return True
     code = ("import jax, jax.numpy as jnp;"
             "x = jnp.ones((256, 256));"
-            "(x @ x).block_until_ready();"
+            "float(jnp.sum(x @ x));"  # value fetch = real completion barrier
             "print(jax.devices()[0].platform)")
     for attempt in range(1, attempts + 1):
         try:

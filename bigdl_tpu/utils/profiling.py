@@ -17,6 +17,24 @@ import time
 from typing import Dict, List, Optional, Tuple
 
 import jax
+import numpy as np
+
+
+def device_sync(val) -> None:
+    """True device-completion barrier for timing code.
+
+    `jax.block_until_ready` is NOT a reliable completion barrier on a
+    relayed/tunneled PJRT backend: observed live on the axon TPU tunnel
+    (2026-07-31), it returned at enqueue time and timed a 5 ms attention
+    kernel as 0.05 ms. A VALUE fetch is a real barrier on every backend —
+    this reduces every array leaf to ONE combined scalar on device and
+    fetches it once (4 bytes over the wire total — per-leaf fetches would
+    pay one tunnel round-trip each inside the timed region)."""
+    jnp = jax.numpy
+    leaves = [l for l in jax.tree_util.tree_leaves(val)
+              if hasattr(l, "dtype") and getattr(l, "size", 0)]
+    if leaves:
+        np.asarray(sum(jnp.sum(l).astype(jnp.float32) for l in leaves))
 
 
 def get_times(module, x, training: bool = False,
@@ -36,7 +54,7 @@ def get_times(module, x, training: bool = False,
         ctx = ApplyContext(training=training, rng=rng, state=m._state or {})
         t0 = time.perf_counter()
         val = m.apply(params, val, ctx)
-        jax.block_until_ready(val)
+        device_sync(val)
         out.append((path or m.name, time.perf_counter() - t0))
         return val
 

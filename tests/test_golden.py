@@ -1211,3 +1211,135 @@ class TestBidirectionalGolden:
                 getattr(tl, f"bias_hh_{tag}").zero_()
         want = tl(torch.tensor(x))[0].detach().numpy()
         np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+class TestRecurrentStackGolden:
+    """Full-layer recurrent compositions vs torch: bidirectional LSTM and a
+    2-layer stack — the configurations the reference's BiRecurrent.scala and
+    stacked-Recurrent examples exercise, one altitude above the single-cell
+    goldens in TestRecurrentGolden."""
+
+    B, T, I, H = 3, 6, 4, 5
+
+    def _x(self):
+        return np.random.RandomState(10).randn(
+            self.B, self.T, self.I).astype(np.float32)
+
+    @staticmethod
+    def _load_lstm(tl, params, layer=0, suffix=""):
+        import torch
+        with torch.no_grad():
+            getattr(tl, f"weight_ih_l{layer}{suffix}").copy_(
+                torch.tensor(np.asarray(params["wi"]).T))
+            getattr(tl, f"weight_hh_l{layer}{suffix}").copy_(
+                torch.tensor(np.asarray(params["wh"]).T))
+            getattr(tl, f"bias_ih_l{layer}{suffix}").copy_(
+                torch.tensor(np.asarray(params["bias"])))
+            getattr(tl, f"bias_hh_l{layer}{suffix}").zero_()
+
+    def test_bilstm_concat_matches_torch(self):
+        torch = pytest.importorskip("torch")
+        m = nn.BiRecurrent(nn.LSTMCell(self.I, self.H), merge="concat")
+        params = m.init(jax.random.PRNGKey(20))
+        x = self._x()
+        got = np.asarray(functional_apply(m, params, jnp.asarray(x))[0])
+
+        tl = torch.nn.LSTM(self.I, self.H, batch_first=True,
+                           bidirectional=True)
+        self._load_lstm(tl, params["fwd"]["cell"])
+        self._load_lstm(tl, params["bwd"]["cell"], suffix="_reverse")
+        want = tl(torch.tensor(x))[0].detach().numpy()
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+    def test_two_layer_lstm_matches_torch(self):
+        torch = pytest.importorskip("torch")
+        m = (nn.Sequential()
+             .add(nn.Recurrent(nn.LSTMCell(self.I, self.H)))
+             .add(nn.Recurrent(nn.LSTMCell(self.H, self.H))))
+        params = m.init(jax.random.PRNGKey(21))
+        x = self._x()
+        got = np.asarray(functional_apply(m, params, jnp.asarray(x))[0])
+
+        tl = torch.nn.LSTM(self.I, self.H, num_layers=2, batch_first=True)
+        layers = sorted(params.keys())
+        self._load_lstm(tl, params[layers[0]]["cell"], layer=0)
+        self._load_lstm(tl, params[layers[1]]["cell"], layer=1)
+        want = tl(torch.tensor(x))[0].detach().numpy()
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+class TestMultiHeadAttentionGolden:
+    """nn.MultiHeadAttention (full layer: q/k/v/out projections + softmax
+    attention) vs torch.nn.MultiheadAttention — self- and cross-attention.
+    Torch packs in_proj as [3E, E] rows (q, k, v) with y = x @ W.T; ours is
+    y = x @ w, so w = W.T slices."""
+
+    B, T, E, NH = 2, 7, 8, 2
+
+    def _mha_pair(self, causal=False):
+        import torch
+        m = nn.MultiHeadAttention(self.E, self.NH, causal=causal,
+                                  use_flash=False)
+        params = m.init(jax.random.PRNGKey(30))
+        tm = torch.nn.MultiheadAttention(self.E, self.NH, batch_first=True)
+        E = self.E
+        with torch.no_grad():
+            w = np.concatenate([np.asarray(params["wq"]).T,
+                                np.asarray(params["wk"]).T,
+                                np.asarray(params["wv"]).T], axis=0)
+            tm.in_proj_weight.copy_(torch.tensor(w))
+            tm.in_proj_bias.copy_(torch.tensor(np.concatenate(
+                [np.asarray(params["bq"]), np.asarray(params["bk"]),
+                 np.asarray(params["bv"])])))
+            tm.out_proj.weight.copy_(
+                torch.tensor(np.asarray(params["wo"]).T))
+            tm.out_proj.bias.copy_(torch.tensor(np.asarray(params["bo"])))
+        return m, params, tm
+
+    def test_self_attention_matches_torch(self):
+        torch = pytest.importorskip("torch")
+        m, params, tm = self._mha_pair()
+        x = np.random.RandomState(31).randn(
+            self.B, self.T, self.E).astype(np.float32)
+        got = np.asarray(functional_apply(m, params, jnp.asarray(x))[0])
+        want = tm(torch.tensor(x), torch.tensor(x), torch.tensor(x),
+                  need_weights=False)[0].detach().numpy()
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+    def test_causal_self_attention_matches_torch(self):
+        torch = pytest.importorskip("torch")
+        m, params, tm = self._mha_pair(causal=True)
+        x = np.random.RandomState(32).randn(
+            self.B, self.T, self.E).astype(np.float32)
+        got = np.asarray(functional_apply(m, params, jnp.asarray(x))[0])
+        mask = torch.triu(torch.ones(self.T, self.T, dtype=torch.bool), 1)
+        want = tm(torch.tensor(x), torch.tensor(x), torch.tensor(x),
+                  attn_mask=mask, need_weights=False)[0].detach().numpy()
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+    def test_cross_attention_matches_torch(self):
+        torch = pytest.importorskip("torch")
+        from bigdl_tpu.utils.table import Table
+        m, params, tm = self._mha_pair()
+        rs = np.random.RandomState(33)
+        q = rs.randn(self.B, self.T, self.E).astype(np.float32)
+        kv = rs.randn(self.B, self.T + 3, self.E).astype(np.float32)
+        got = np.asarray(functional_apply(
+            m, params, Table(jnp.asarray(q), jnp.asarray(kv)))[0])
+        want = tm(torch.tensor(q), torch.tensor(kv), torch.tensor(kv),
+                  need_weights=False)[0].detach().numpy()
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+    def test_grad_matches_torch(self):
+        torch = pytest.importorskip("torch")
+        m, params, tm = self._mha_pair()
+        x = np.random.RandomState(34).randn(
+            self.B, self.T, self.E).astype(np.float32)
+
+        def loss(p, xx):
+            return jnp.sum(functional_apply(m, p, xx)[0] ** 2)
+
+        gx = np.asarray(jax.grad(loss, argnums=1)(params, jnp.asarray(x)))
+        tx = torch.tensor(x, requires_grad=True)
+        (tm(tx, tx, tx, need_weights=False)[0] ** 2).sum().backward()
+        np.testing.assert_allclose(gx, tx.grad.numpy(), rtol=1e-3, atol=1e-4)

@@ -155,3 +155,20 @@ class TestUserErrorProbes:
         p2 = m2.init(jax.random.PRNGKey(42))
         np.testing.assert_array_equal(np.asarray(p1["weight"]),
                                       np.asarray(p2["weight"]))
+
+
+class TestDeviceSync:
+    """device_sync must be a safe no-op-like barrier over any activity
+    pytree — arrays, Tables, nested dicts — because every timing path
+    (bench, per-layer profiler) relies on it instead of
+    jax.block_until_ready (not a real barrier on relayed PJRT backends)."""
+
+    def test_array_and_pytree(self):
+        import jax.numpy as jnp
+        from bigdl_tpu.utils.profiling import device_sync
+        from bigdl_tpu.utils.table import Table
+        device_sync(jnp.ones((3, 3)))
+        device_sync(Table(jnp.ones(2), jnp.zeros((2, 2), jnp.int32)))
+        device_sync({"a": jnp.ones(1), "b": [jnp.zeros(2, jnp.bool_)]})
+        device_sync(3.0)          # plain scalar: ignored
+        device_sync(jnp.ones(0))  # empty leaf: ignored
