@@ -7,7 +7,7 @@ from bigdl_tpu.nn.containers import (Bottle, CAddTable, CAveTable, CDivTable,
                                      Remat,
                                      CMaxTable, CMinTable, CMulTable, CSubTable,
                                      Concat, ConcatTable, Container, Echo,
-                                     BifurcateSplitTable, FlattenTable, Graph, Identity, Input,
+                                     BifurcateSplitTable, FlattenTable, Graph, Identity, Input, StaticGraph,
                                      InputNode, JoinTable, MapTable,
                                      MixtureTable, NarrowTable, ParallelTable,
                                      SelectTable, Sequential, SplitTable)

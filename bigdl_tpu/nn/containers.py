@@ -422,6 +422,11 @@ class Graph(Container):
         return outs[0] if len(outs) == 1 else T(*outs)
 
 
+# Reference StaticGraph.scala IS this container (DynamicGraph is the
+# data-dependent variant in dynamic_graph.py); export the name for parity.
+StaticGraph = Graph
+
+
 class Identity(Module):
     """Pass input through unchanged (DL/nn/Identity.scala)."""
     def apply(self, params, input, ctx):

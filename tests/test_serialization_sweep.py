@@ -579,6 +579,8 @@ SKIP = {
     "keras.Input": "keras input placeholder",
     "Graph": "covered by dedicated graph round-trip tests "
              "(test_serialization.py::TestGraphRoundTrip)",
+    "StaticGraph": "alias of Graph (reference StaticGraph.scala IS the "
+                   "static Graph container); covered by the same tests",
     "Model": "keras functional Model; covered by test_interop functional "
              "round-trip + requires KTensor wiring not a bare ctor",
     "keras.Sequential": "keras Sequential covered by test_keras save/load",
