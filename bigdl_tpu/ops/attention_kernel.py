@@ -259,7 +259,16 @@ def _flash_impl(q, k, v, causal, sm_scale, use_pallas):
         return blockwise_attention(q, k, v, causal=causal, sm_scale=sm_scale)
     b, h, t, d = q.shape
     tk = k.shape[2]
-    bq, bk = min(256, _ceil_to(t, 8)), min(512, _ceil_to(tk, 8))
+    # block_k 1024: +7% at 16k tokens vs 512 on v5e (neutral at 8k),
+    # measured 2026-07-31 block sweep (docs/bench_records). Prefer it only
+    # when it divides tk — padding would push non-causal odd-multiple-of-512
+    # key lengths (1536, 2560, ...) off the Pallas path entirely.
+    bq = min(256, _ceil_to(t, 8))
+    for bk in (1024, 512):
+        if tk % bk == 0:
+            break
+    else:
+        bk = min(512, _ceil_to(tk, 8))
     pq, pk = _ceil_to(t, bq) - t, _ceil_to(tk, bk) - tk
     qp = jnp.pad(q, ((0, 0), (0, 0), (0, pq), (0, 0))) if pq else q
     kp = jnp.pad(k, ((0, 0), (0, 0), (0, pk), (0, 0))) if pk else k
