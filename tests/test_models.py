@@ -39,6 +39,25 @@ class TestShapes:
                                 state=m.state_init())
         assert y.shape == (1, 1000)
 
+    def test_resnet_s2d_remat_compose(self):
+        """The two TPU production flags together: s2d stem + remat blocks
+        train a gradient step with the same param count as the plain
+        model (remat and s2d change compute scheduling, never the tree)."""
+        a = ResNet(4, depth=18)
+        b = ResNet(4, depth=18, s2d_stem=True, remat=True)
+        pa, pb = a.init(KEY), b.init(KEY)
+        assert param_count(pa) == param_count(pb)
+        x = jnp.ones((2, 64, 64, 3))
+
+        def loss(p):
+            out, _ = functional_apply(b, p, x, state=b.state_init(),
+                                      training=True)
+            return -out[:, 0].sum()
+
+        g = jax.grad(loss)(pb)
+        assert all(bool(jnp.all(jnp.isfinite(l)))
+                   for l in jax.tree_util.tree_leaves(g))
+
     def test_resnet_cifar(self):
         m = ResNet(10, depth=20, data_set="cifar10")
         y = m.forward(jnp.ones((2, 32, 32, 3)))

@@ -795,3 +795,91 @@ class TestGradientAccumulation:
                             local=False)
         with pytest.raises(ValueError, match="steps"):
             o.set_gradient_accumulation(0)
+
+
+class TestMixedPrecisionFidelity:
+    """Quantitative check that bf16 mixed-precision training computes the
+    SAME optimization trajectory as f32, up to bf16 rounding: one full
+    optimizer step from identical init must produce a parameter delta
+    nearly parallel to the f32 delta. Guards the compute-precision cast
+    machinery (cast-in, upcast-adjoint, f32 masters) against silently
+    dropping or double-casting a branch — a class of bug a convergence
+    test absorbs without noticing."""
+
+    def _one_step_delta(self, model_fn, data, precision):
+        X, Y = data
+        model = model_fn()
+        o = optim.Optimizer(model, (X, Y), nn.ClassNLLCriterion(),
+                            batch_size=X.shape[0], local=False)
+        o.set_optim_method(optim.SGD(learning_rate=0.1))
+        if precision:
+            o.set_compute_precision(precision)
+        o.set_end_when(optim.max_iteration(1))
+        p0 = jax.tree_util.tree_map(np.asarray, model.init(
+            jax.random.PRNGKey(7)))
+        model.set_params(jax.tree_util.tree_map(jnp.asarray, p0))
+        trained = o.optimize()
+        p1 = jax.tree_util.tree_map(np.asarray, trained.ensure_params())
+        flat0 = np.concatenate([a.ravel() for a in
+                                jax.tree_util.tree_leaves(p0)])
+        flat1 = np.concatenate([a.ravel() for a in
+                                jax.tree_util.tree_leaves(p1)])
+        return flat1 - flat0
+
+    @pytest.mark.parametrize("arch", ["conv", "mlp"])
+    def test_bf16_step_parallel_to_f32(self, arch):
+        rs = np.random.RandomState(0)
+        if arch == "conv":
+            X = rs.rand(32, 12, 12, 3).astype(np.float32)
+            model_fn = lambda: (nn.Sequential()
+                                .add(nn.SpatialConvolution(3, 8, 3, 3))
+                                .add(nn.ReLU())
+                                .add(nn.Pooler())
+                                .add(nn.Linear(8, 4))
+                                .add(nn.LogSoftMax()))
+        else:
+            X = rs.rand(32, 10).astype(np.float32)
+            model_fn = lambda: (nn.Sequential()
+                                .add(nn.Linear(10, 16)).add(nn.Tanh())
+                                .add(nn.Linear(16, 4)).add(nn.LogSoftMax()))
+        Y = (rs.randint(0, 4, 32) + 1).astype(np.int32)
+
+        d32 = self._one_step_delta(model_fn, (X, Y), None)
+        d16 = self._one_step_delta(model_fn, (X, Y), "bfloat16")
+        assert np.linalg.norm(d32) > 0  # the step did something
+        cos = float(d32 @ d16 / (np.linalg.norm(d32) *
+                                 np.linalg.norm(d16)))
+        rel = float(np.linalg.norm(d16 - d32) / np.linalg.norm(d32))
+        assert cos > 0.99, f"bf16 step direction diverged: cos={cos}"
+        assert rel < 0.15, f"bf16 step magnitude off: rel={rel}"
+
+
+class TestSyncIntervalInvariance:
+    """set_sync_interval changes WHEN the host fetches the loss, never the
+    math: training k iterations with sync=1 vs sync=8 from the same init
+    and data must produce bit-identical parameters. This is the invariant
+    the bench's monitoring-cadence argument (docs/PERF.md) rests on."""
+
+    def test_params_bit_identical_across_sync_windows(self):
+        rs = np.random.RandomState(3)
+        X = rs.rand(64, 10).astype(np.float32)
+        Y = (rs.randint(0, 4, 64) + 1).astype(np.int32)
+
+        def train(sync):
+            model = (nn.Sequential()
+                     .add(nn.Linear(10, 16)).add(nn.Tanh())
+                     .add(nn.Linear(16, 4)).add(nn.LogSoftMax()))
+            model.set_params(model.init(jax.random.PRNGKey(11)))
+            o = optim.Optimizer(model, (X, Y), nn.ClassNLLCriterion(),
+                                batch_size=32, local=False)
+            o.set_optim_method(optim.Adam(learning_rate=1e-2))
+            o.set_sync_interval(sync)
+            o.set_end_when(optim.max_iteration(16))
+            trained = o.optimize()
+            return jax.tree_util.tree_map(np.asarray,
+                                          trained.ensure_params())
+
+        a, b, c = train(1), train(8), train(16)
+        for pa, pb in [(a, b), (a, c)]:
+            jax.tree_util.tree_map(
+                lambda x, y: np.testing.assert_array_equal(x, y), pa, pb)
