@@ -152,3 +152,32 @@ class TestSpaceToDepthStem:
         np.testing.assert_allclose(np.asarray(b.forward(x)),
                                    np.asarray(a.forward(x)),
                                    rtol=1e-4, atol=1e-4)
+
+
+class TestRandomRecurrentConfigs:
+    """Random (batch, time, input, hidden) LSTM/RNN configurations vs
+    torch — the scan-path counterpart of the conv sweep above: shape
+    broadcasting and gate-packing bugs hide in drawn configurations, not
+    the one hand-picked golden shape."""
+
+    @pytest.mark.parametrize("seed", range(10))
+    def test_random_lstm_config_matches_torch(self, seed):
+        rs = np.random.RandomState(100 + seed)
+        B, T = int(rs.randint(1, 5)), int(rs.randint(2, 9))
+        I, H = int(rs.randint(1, 7)), int(rs.randint(1, 8))
+        m = nn.Recurrent(nn.LSTMCell(I, H), return_sequences=True)
+        params = m.init(jax.random.PRNGKey(seed))
+        x = rs.randn(B, T, I).astype(np.float32)
+        from bigdl_tpu.nn.module import functional_apply
+        got = np.asarray(functional_apply(m, params, jnp.asarray(x))[0])
+
+        tl = torch.nn.LSTM(I, H, batch_first=True)
+        p = params["cell"]
+        with torch.no_grad():
+            tl.weight_ih_l0.copy_(torch.tensor(np.asarray(p["wi"]).T))
+            tl.weight_hh_l0.copy_(torch.tensor(np.asarray(p["wh"]).T))
+            tl.bias_ih_l0.copy_(torch.tensor(np.asarray(p["bias"])))
+            tl.bias_hh_l0.zero_()
+        want = tl(torch.tensor(x))[0].detach().numpy()
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5,
+                                   err_msg=f"B={B} T={T} I={I} H={H}")
