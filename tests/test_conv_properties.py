@@ -181,3 +181,51 @@ class TestRandomRecurrentConfigs:
         want = tl(torch.tensor(x))[0].detach().numpy()
         np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5,
                                    err_msg=f"B={B} T={T} I={I} H={H}")
+
+    @pytest.mark.parametrize("seed", range(6))
+    def test_random_gru_config_matches_formulation(self, seed):
+        """GRU vs a numpy loop of the ORIGINAL Cho et al. formulation
+        (the variant the reference implements — torch's cuDNN variant is
+        not a valid oracle; see test_golden.TestRecurrentGolden)."""
+        rs = np.random.RandomState(200 + seed)
+        B, T = int(rs.randint(1, 4)), int(rs.randint(2, 7))
+        I, H = int(rs.randint(1, 6)), int(rs.randint(1, 7))
+        m = nn.Recurrent(nn.GRUCell(I, H), return_sequences=True)
+        params = m.init(jax.random.PRNGKey(seed))
+        x = rs.randn(B, T, I).astype(np.float32)
+        from bigdl_tpu.nn.module import functional_apply
+        got = np.asarray(functional_apply(m, params, jnp.asarray(x))[0])
+
+        p = jax.tree_util.tree_map(np.asarray, params["cell"])
+        sigm = lambda v: 1.0 / (1.0 + np.exp(-v))
+        h = np.zeros((B, H), np.float32)
+        for t in range(T):
+            xt = x[:, t]
+            rz = sigm(xt @ p["wi_rz"] + h @ p["wh_rz"] + p["b_rz"])
+            r, z = rz[:, :H], rz[:, H:]
+            n = np.tanh(xt @ p["wi_n"] + (r * h) @ p["wh_n"] + p["b_n"])
+            h = (1.0 - z) * n + z * h
+            np.testing.assert_allclose(
+                got[:, t], h, rtol=1e-4, atol=1e-5,
+                err_msg=f"B={B} T={T} I={I} H={H} t={t}")
+
+    @pytest.mark.parametrize("merge", ["sum", "mul", "ave"])
+    def test_birecurrent_merge_modes(self, merge):
+        """BiRecurrent merge=sum|mul|ave must equal the elementwise
+        combination of the two directional Recurrent runs (concat is
+        golden-tested vs torch bidirectional in test_golden)."""
+        from bigdl_tpu.nn.module import functional_apply
+        rs = np.random.RandomState(5)
+        x = rs.randn(2, 5, 3).astype(np.float32)
+        m = nn.BiRecurrent(nn.LSTMCell(3, 4), merge=merge)
+        params = m.init(jax.random.PRNGKey(9))
+        got = np.asarray(functional_apply(m, params, jnp.asarray(x))[0])
+
+        fwd = nn.Recurrent(nn.LSTMCell(3, 4))
+        bwd = nn.Recurrent(nn.LSTMCell(3, 4), reverse=True)
+        a = np.asarray(functional_apply(fwd, params["fwd"],
+                                        jnp.asarray(x))[0])
+        b = np.asarray(functional_apply(bwd, params["bwd"],
+                                        jnp.asarray(x))[0])
+        want = {"sum": a + b, "mul": a * b, "ave": (a + b) / 2}[merge]
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
