@@ -97,7 +97,13 @@ class TestOptimMethods:
          lambda p, t: t.optim.RMSprop([p], lr=0.01, alpha=0.9, eps=1e-8)),
         (lambda: optim.Adagrad(learning_rate=0.05),
          lambda p, t: t.optim.Adagrad([p], lr=0.05, eps=1e-10)),
-    ], ids=["sgd_momentum", "nesterov", "rmsprop", "adagrad"])
+        (lambda: optim.Adadelta(decay_rate=0.9, epsilon=1e-6),
+         lambda p, t: t.optim.Adadelta([p], lr=1.0, rho=0.9, eps=1e-6)),
+        (lambda: optim.Adamax(learning_rate=0.002),
+         lambda p, t: t.optim.Adamax([p], lr=0.002, betas=(0.9, 0.999),
+                                     eps=1e-38)),
+    ], ids=["sgd_momentum", "nesterov", "rmsprop", "adagrad", "adadelta",
+            "adamax"])
     def test_trajectory_vs_torch_multistep(self, ours, theirs):
         """Eight-step trajectories on identical gradient streams: moment
         buffers, dampening, and epsilon placement all have to line up,
