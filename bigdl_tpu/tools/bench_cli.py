@@ -50,7 +50,8 @@ _PEAK_BF16 = [
 
 
 def _peak_flops(device):
-    kind = getattr(device, "device_kind", "").lower()
+    kind = (device if isinstance(device, str)
+            else getattr(device, "device_kind", "")).lower()
     for key, peak in _PEAK_BF16:
         if key in kind:
             return peak
@@ -343,6 +344,17 @@ def bench_baseline_configs():
         (rs.randint(0, 2, b) + 1).astype(np.int32))
 
 
+def _env_num(name, cast, default):
+    """Parse a numeric env knob; malformed values are logged and ignored —
+    a bad knob must never forfeit the once-per-round artifact."""
+    try:
+        return cast(os.environ.get(name, default))
+    except (TypeError, ValueError):
+        print(f"ignoring malformed {name}={os.environ[name]!r}",
+              file=sys.stderr)
+        return default
+
+
 def _repo_root() -> str:
     """Repo root from this file's location (bigdl_tpu/tools/ -> two up)."""
     return os.path.dirname(os.path.dirname(os.path.dirname(
@@ -370,17 +382,8 @@ def _accel_responsive(timeout_s: float = 150.0, attempts: int = 6,
     import os
     import subprocess
     import sys as _sys
-    def _env_num(name, cast, default):
-        try:
-            return cast(os.environ.get(name, default))
-        except (TypeError, ValueError):
-            # a malformed knob must never forfeit the round's artifact
-            print(f"ignoring malformed {name}={os.environ[name]!r}",
-                  file=sys.stderr)
-            return default
-
     timeout_s = max(1.0, _env_num("BIGDL_TPU_PROBE_TIMEOUT", float,
-                                   timeout_s))
+                                  timeout_s))
     attempts = max(1, _env_num("BIGDL_TPU_PROBE_ATTEMPTS", int, attempts))
     backoff_s = max(0.0, _env_num("BIGDL_TPU_PROBE_BACKOFF", float,
                                   backoff_s))
@@ -416,6 +419,22 @@ def _accel_responsive(timeout_s: float = 150.0, attempts: int = 6,
     return False
 
 
+def _spawn_child(name: str, timeout_s: float):
+    """Spawn `python -m bigdl_tpu.tools.bench_cli --secondary name` with the
+    repo on PYTHONPATH and a hard timeout. Returns the CompletedProcess;
+    raises subprocess.TimeoutExpired (with captured stderr) on stall."""
+    import subprocess
+    cmd = [sys.executable, "-m", "bigdl_tpu.tools.bench_cli",
+           "--secondary", name]
+    # the package may not be pip-installed (driver runs repo-root
+    # bench.py); make the child's -m lookup independent of cwd
+    env = dict(os.environ)
+    env["PYTHONPATH"] = _repo_root() + (
+        os.pathsep + env["PYTHONPATH"] if env.get("PYTHONPATH") else "")
+    return subprocess.run(cmd, timeout=timeout_s, capture_output=True,
+                          text=True, env=env)
+
+
 def _run_secondary(name: str, timeout_s: float):
     """Run one secondary suite in a SUBPROCESS with a hard timeout.
 
@@ -427,17 +446,8 @@ def _run_secondary(name: str, timeout_s: float):
     re-pays backend init (~30 s), which the persistent compile cache keeps
     cheap for repeat shapes."""
     import subprocess
-    cmd = [sys.executable, "-m", "bigdl_tpu.tools.bench_cli",
-           "--secondary", name]
-    # the package may not be pip-installed (driver runs repo-root
-    # bench.py); make the child's -m lookup independent of cwd
-    repo_root = _repo_root()
-    env = dict(os.environ)
-    env["PYTHONPATH"] = repo_root + (
-        os.pathsep + env["PYTHONPATH"] if env.get("PYTHONPATH") else "")
     try:
-        r = subprocess.run(cmd, timeout=timeout_s, capture_output=True,
-                           text=True, env=env)
+        r = _spawn_child(name, timeout_s)
         sys.stderr.write(r.stderr or "")
         if r.returncode != 0:
             print(f"secondary '{name}' exited rc={r.returncode}",
@@ -467,15 +477,79 @@ def _configure_compile_cache():
 
 
 def _secondary_main(name: str):
-    """Child-process entry for one secondary suite (no probe, no headline)."""
+    """Child-process entry for one suite (no probe). `resnet` / `lenet`
+    are the headline children: they print ONE json line on stdout
+    ({throughput, flops, device_*, n_dev}; phase table on stderr) for the
+    parent to assemble into the round artifact — the parent never touches
+    the backend, so a mid-run tunnel wedge costs the child's timeout, not
+    the round."""
     logging.getLogger("bigdl_tpu.optim").setLevel(logging.WARNING)
+    if name == "lenet" or os.environ.get("JAX_PLATFORMS") == "cpu":
+        # fallback path, or the operator pinned CPU explicitly (the env
+        # var alone does not override a sitecustomize-forced backend;
+        # honoring it here makes the resnet child's CPU refusal instant
+        # instead of a backend-touch that may hang on a wedged tunnel)
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        import jax
+        try:
+            jax.config.update("jax_platforms", "cpu")
+        except Exception:
+            pass
     _configure_compile_cache()
     if name == "attention":
         bench_attention()
     elif name == "configs":
         bench_baseline_configs()
+    elif name == "host_pipeline":
+        # secondary figure: fresh host batches + H2D every step
+        import jax
+        host_tp, _, _ = bench_resnet50(warmup=4, iters=8, resident=False)
+        print(f"host-pipeline (fresh H2D per step): "
+              f"{host_tp / jax.device_count():.1f} imgs/sec/chip",
+              file=sys.stderr)
+    elif name in ("resnet", "lenet"):
+        import jax
+        dev = jax.devices()[0]
+        if name == "resnet":
+            if dev.platform == "cpu":
+                # probe false-positive (e.g. BIGDL_TPU_FORCE_ACCEL on a
+                # CPU host): fail over instantly, don't burn the timeout
+                raise SystemExit("cpu backend: ResNet-50 headline refused")
+            thr, metrics, flops = bench_resnet50()
+        else:
+            thr, metrics, flops = bench_lenet()
+        print(metrics.summary(), file=sys.stderr)
+        print(json.dumps({
+            "throughput": thr, "flops": flops,
+            "device_platform": dev.platform,
+            "device_kind": getattr(dev, "device_kind", "?"),
+            "n_dev": jax.device_count(),
+        }), flush=True)
     else:
         raise SystemExit(f"unknown secondary {name!r}")
+
+
+def _headline_child(name: str, timeout_s: float):
+    """Run a headline child (`resnet`/`lenet`) and parse its json line.
+    Raises on timeout, nonzero exit, or missing output; the child's stderr
+    (phase table / failure diagnostics) is always forwarded."""
+    import subprocess
+    try:
+        r = _spawn_child(name, timeout_s)
+    except subprocess.TimeoutExpired as e:
+        err = e.stderr
+        if err:
+            sys.stderr.write(err if isinstance(err, str)
+                             else err.decode(errors="replace"))
+        raise
+    sys.stderr.write(r.stderr or "")
+    if r.returncode != 0:
+        raise RuntimeError(f"headline child '{name}' rc={r.returncode}: "
+                           f"{(r.stderr or '').strip()[-200:]}")
+    lines = [l for l in (r.stdout or "").splitlines() if l.strip()]
+    if not lines:
+        raise RuntimeError(f"headline child '{name}' produced no output")
+    return json.loads(lines[-1])
 
 
 def main():
@@ -485,14 +559,6 @@ def main():
     logging.getLogger("bigdl_tpu.optim").setLevel(logging.WARNING)
     accel_ok = _accel_responsive()
     if not accel_ok:
-        # dead/absent accelerator: pin to CPU BEFORE the first backend
-        # touch so the fallback bench cannot hang on the tunnel
-        os.environ["JAX_PLATFORMS"] = "cpu"
-        import jax
-        try:
-            jax.config.update("jax_platforms", "cpu")
-        except Exception:
-            pass
         print("accelerator unresponsive; falling back to CPU LeNet bench",
               file=sys.stderr)
         rec_dir = os.path.join(_repo_root(), "docs", "bench_records")
@@ -500,32 +566,48 @@ def main():
             print("validated TPU captures for this build are archived in "
                   f"{rec_dir} (latest headline: see r03_sync72_headline_*)",
                   file=sys.stderr)
-    import jax
-    _configure_compile_cache()  # AFTER the CPU pin above, by contract
-    dev = jax.devices()[0]
-    n_dev = jax.device_count()
-    on_accel = accel_ok and dev.platform not in ("cpu",)
+    # both headline variants run in WATCHDOGGED CHILDREN and this parent
+    # never touches the backend: a tunnel that wedges AFTER a healthy
+    # probe costs the child's timeout, never the round (observed live
+    # 2026-07-31: a healthy session wedged mid-run for hours)
+    budget = _env_num("BIGDL_TPU_HEADLINE_TIMEOUT", float, 1500.0)
+    info = None
     batch_size = 128
-    try:
-        if not on_accel:
-            raise RuntimeError("CPU host: ResNet-50 bench too slow")
-        throughput, metrics, flops = bench_resnet50(batch_size=batch_size)
-        metric = "resnet50_train_imgs_per_sec_per_chip"
-        baseline = 55.0  # BigDL-era ResNet-50 imgs/sec on one Xeon node
-    except Exception:
-        throughput, metrics, flops = bench_lenet()
+    if accel_ok:
+        try:
+            info = _headline_child("resnet", budget)
+            metric = "resnet50_train_imgs_per_sec_per_chip"
+            baseline = 55.0  # BigDL-era ResNet-50 imgs/sec on one Xeon node
+        except Exception as e:
+            print(f"resnet headline child failed ({e!r}); falling back to "
+                  "CPU LeNet bench", file=sys.stderr)
+            info = None
+    if info is None:
+        try:
+            info = _headline_child("lenet", budget)
+        except Exception as e:
+            # even a dead CPU fallback must leave a parseable artifact
+            print(f"lenet fallback child failed: {e!r}", file=sys.stderr)
+            print(json.dumps({"metric": "bench_failed", "value": 0.0,
+                              "unit": "imgs/sec", "vs_baseline": 0.0,
+                              "baseline": 0.0, "device": "none"}),
+                  flush=True)
+            return
         metric = "lenet_train_throughput"
         baseline = 100.0
         batch_size = 512
+    throughput, flops = info["throughput"], info["flops"]
+    dev_platform, dev_kind = info["device_platform"], info["device_kind"]
+    n_dev = info["n_dev"]
+    on_accel = accel_ok and dev_platform not in ("cpu",)
 
     per_chip = throughput / n_dev
-    # phase breakdown (reference Metrics.scala summary) + MFU -> stderr,
+    # child already forwarded the phase table on stderr; MFU -> stderr,
     # headline JSON line alone on stdout
-    print(metrics.summary(), file=sys.stderr)
     mfu = None
     if flops:
         achieved = flops * throughput / batch_size  # whole-mesh FLOP/s
-        peak = _peak_flops(dev)
+        peak = _peak_flops(dev_kind)
         print(f"model flops/step (XLA cost model): {flops:.3e}  "
               f"achieved: {achieved / 1e12:.1f} TFLOP/s over {n_dev} "
               f"device(s)", file=sys.stderr)
@@ -540,8 +622,7 @@ def main():
         "unit": "imgs/sec",
         "vs_baseline": round(per_chip / baseline, 2),
         "baseline": baseline,  # denominator, imgs/sec — differs per metric
-        "device": f"{dev.platform}:{getattr(dev, 'device_kind', '?')}"
-                  f" x{n_dev}",
+        "device": f"{dev_platform}:{dev_kind} x{n_dev}",
     }
     if mfu is not None:
         out["mfu"] = round(mfu, 4)
@@ -552,23 +633,14 @@ def main():
     resnet_headline = metric == "resnet50_train_imgs_per_sec_per_chip"
     if on_accel and resnet_headline and \
             not os.environ.get("BIGDL_TPU_BENCH_FAST"):
-        try:  # secondary figure: fresh host batches + H2D every step
-            host_tp, _, _ = bench_resnet50(batch_size=batch_size, warmup=4,
-                                           iters=8, resident=False)
-            print(f"host-pipeline (fresh H2D per step): "
-                  f"{host_tp / n_dev:.1f} imgs/sec/chip", file=sys.stderr)
-        except Exception:
-            pass
-        # long-context attention + transformer LM, then the remaining
-        # BASELINE.md configs — each in a watchdogged subprocess so a
-        # wedged tunnel costs bounded wall-clock (see _run_secondary)
-        try:
-            budget = float(os.environ.get("BIGDL_TPU_SECONDARY_TIMEOUT",
-                                          "900"))
-        except ValueError:
-            budget = 900.0
-        _run_secondary("attention", budget)
-        _run_secondary("configs", budget)
+        # host-pipeline figure, long-context attention + transformer LM,
+        # then the remaining BASELINE.md configs — each in a watchdogged
+        # subprocess so a wedged tunnel costs bounded wall-clock; the
+        # parent NEVER touches the backend (see _run_secondary)
+        sec_budget = _env_num("BIGDL_TPU_SECONDARY_TIMEOUT", float, 900.0)
+        _run_secondary("host_pipeline", sec_budget)
+        _run_secondary("attention", sec_budget)
+        _run_secondary("configs", sec_budget)
 
 
 if __name__ == "__main__":
