@@ -47,3 +47,15 @@ def test_metric_json_contract():
                        "vs_baseline": 1.0})
     parsed = json.loads(line)
     assert set(parsed) >= {"metric", "value", "unit", "vs_baseline"}
+
+
+def test_headline_child_plumbing():
+    """The round artifact is now assembled from a watchdogged child
+    process; exercise the real spawn -> json-line -> parse path with the
+    CPU-pinned lenet child (the resnet child needs an accelerator)."""
+    from bigdl_tpu.tools.bench_cli import _headline_child
+    info = _headline_child("lenet", 600.0)
+    assert info["throughput"] > 0
+    assert info["device_platform"] == "cpu"
+    assert info["n_dev"] >= 1
+    assert info["flops"] is None or info["flops"] > 0
