@@ -225,6 +225,26 @@ class TestTensorflow:
         got = np.asarray(g.forward(x))
         np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
 
+    def test_s2d_stem_exports_as_plain_conv(self, tmp_path):
+        """SpaceToDepthStemConvolution is a compute restatement of the
+        plain stride-2 conv with the SAME parameter tree, so the TF export
+        path (isinstance-dispatched) must emit the equivalent plain Conv2D
+        and round-trip numerically."""
+        m = nn.Sequential()
+        m.add(nn.SpaceToDepthStemConvolution(3, 4, 7, with_bias=True,
+                                             name="stem"))
+        m.add(nn.ReLU())
+        m.evaluate()
+        m.ensure_params()
+        path = str(tmp_path / "s2d.pb")
+        TensorflowSaver.save(m, path, input_name="input")
+        g = TensorflowLoader.load(path, ["input"], ["layer1_ReLU"])
+        x = jnp.asarray(np.random.RandomState(2).rand(2, 16, 16, 3),
+                        jnp.float32)
+        np.testing.assert_allclose(np.asarray(g.forward(x)),
+                                   np.asarray(m.forward(x)),
+                                   rtol=1e-5, atol=1e-6)
+
     def test_fused_batchnorm_import(self):
         from bigdl_tpu.proto import tf_graph_pb2 as tpb
         from bigdl_tpu.interop.tensorflow import ndarray_to_tensor
