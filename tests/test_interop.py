@@ -63,6 +63,26 @@ class TestCaffe:
         got = np.asarray(loaded.forward(x))
         np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
 
+    def test_s2d_stem_persists_as_plain_conv(self, tmp_path):
+        """Same contract as the TF saver: the s2d stem's parameter tree IS
+        the plain conv's, so the Caffe persister (isinstance-dispatched)
+        emits the equivalent Convolution layer and round-trips."""
+        m = nn.Sequential()
+        m.add(nn.SpaceToDepthStemConvolution(3, 4, 7, with_bias=True,
+                                             name="stem"))
+        m.add(nn.ReLU())
+        m.evaluate()
+        m.ensure_params()
+        proto, weights = str(tmp_path / "s.prototxt"), str(
+            tmp_path / "s.caffemodel")
+        CaffePersister.persist(proto, weights, m)
+        loaded = CaffeLoader.load(proto, weights)
+        x = jnp.asarray(np.random.RandomState(4).rand(2, 16, 16, 3),
+                        jnp.float32)
+        np.testing.assert_allclose(np.asarray(loaded.forward(x)),
+                                   np.asarray(m.forward(x)),
+                                   rtol=1e-5, atol=1e-6)
+
     def test_load_handcrafted_prototxt(self, tmp_path):
         # structure-only load (no caffemodel) with input + eltwise fork
         proto = tmp_path / "fork.prototxt"
