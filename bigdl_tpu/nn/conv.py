@@ -163,7 +163,10 @@ class SpaceToDepthStemConvolution(SpatialConvolution):
         x = input
         b, h, w, c = x.shape
         if h % 2 or w % 2:
-            raise ValueError(f"input spatial dims must be even, got {h}x{w}")
+            # odd spatial dims can't 2x2 space-to-depth; the parameter tree
+            # is identical to the plain stride-2 stem, so fall back to it
+            # (same math, just without the MXU-friendly restatement)
+            return super().apply(params, input, ctx)
         k, o = self.kh, self.n_out
         kt = (k + 1) // 2          # transformed kernel size
         front = (self.pad_h + 1) // 2

@@ -120,12 +120,25 @@ class TestSpaceToDepthStem:
             lambda a, b: np.testing.assert_allclose(
                 np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-4), gp, gs)
 
-    def test_rejects_bad_kernel_and_odd_input(self):
+    def test_rejects_bad_kernel(self):
         with pytest.raises(ValueError):
             nn.SpaceToDepthStemConvolution(3, 8, 5)
-        m = nn.SpaceToDepthStemConvolution(3, 8, 7)
-        with pytest.raises(ValueError):
-            m.forward(jnp.ones((1, 15, 16, 3)))
+
+    def test_odd_input_falls_back_to_plain_stem(self):
+        """225x225-style inputs can't space-to-depth; the layer must fall
+        back to the mathematically identical plain stride-2 conv instead
+        of refusing (same params → same result as the plain stem)."""
+        plain = nn.SpatialConvolution(3, 8, 7, 7, 2, 2, pad_w=3, pad_h=3,
+                                      with_bias=False)
+        s2d = nn.SpaceToDepthStemConvolution(3, 8, 7)
+        params = plain.init(jax.random.PRNGKey(5))
+        x = jnp.asarray(np.random.RandomState(7).rand(1, 15, 17, 3),
+                        jnp.float32)
+        plain.set_params(params)
+        s2d.set_params(params)
+        np.testing.assert_allclose(np.asarray(s2d.forward(x)),
+                                   np.asarray(plain.forward(x)),
+                                   rtol=1e-5, atol=1e-5)
 
     def test_resnet_s2d_flag_equivalent(self):
         from bigdl_tpu.models.resnet import ResNet
