@@ -1,0 +1,19 @@
+"""`bigdl` — pyspark-BigDL API compatibility namespace.
+
+The reference framework promises that "the pyspark/bigdl Python API ...
+continue[s] to work unmodified" (BASELINE.json north star). This package
+keeps that contract over the TPU-native `bigdl_tpu` backend: the module
+paths, class names and signatures of the reference pyspark surface
+(`/root/reference/pyspark/bigdl`) delegate to `bigdl_tpu` in-process —
+no JVM, no py4j, no Spark driver. The one declared swap is the data
+hand-off: plain lists / ndarrays where the reference takes RDDs.
+
+    from bigdl.nn.layer import Sequential, SpatialConvolution
+    from bigdl.nn.criterion import ClassNLLCriterion
+    from bigdl.optim.optimizer import Optimizer, SGD, MaxEpoch
+    from bigdl.util.common import Sample, init_engine
+
+See docs/MIGRATION.md for the mapping to the richer native API.
+"""
+
+from bigdl.version import __version__

@@ -1,0 +1,83 @@
+"""pyspark-BigDL API compatibility: `bigdl.dataset.mnist`.
+
+Parity: reference pyspark/bigdl/dataset/mnist.py — IDX-format MNIST
+reader with the reference's normalization constants. The reference
+auto-downloads from yann.lecun.com; this environment has no egress, so
+`read_data_sets` reads pre-downloaded (optionally gzipped) IDX files from
+`train_dir` and raises with instructions when absent.
+"""
+
+from __future__ import annotations
+
+import gzip
+import os
+
+import numpy
+
+TRAIN_MEAN = 0.13066047740239506 * 255
+TRAIN_STD = 0.3081078 * 255
+TEST_MEAN = 0.13251460696903547 * 255
+TEST_STD = 0.31048024 * 255
+
+TRAIN_IMAGES = "train-images-idx3-ubyte.gz"
+TRAIN_LABELS = "train-labels-idx1-ubyte.gz"
+TEST_IMAGES = "t10k-images-idx3-ubyte.gz"
+TEST_LABELS = "t10k-labels-idx1-ubyte.gz"
+
+
+def _read32(bytestream):
+    dt = numpy.dtype(numpy.uint32).newbyteorder(">")
+    return numpy.frombuffer(bytestream.read(4), dtype=dt)[0]
+
+
+def extract_images(f):
+    """IDX images -> 4D uint8 ndarray [index, y, x, depth] (reference
+    extract_images)."""
+    with f:
+        magic = _read32(f)
+        if magic != 2051:
+            raise ValueError(f"Invalid magic number {magic} in MNIST image "
+                             f"file: {getattr(f, 'name', f)}")
+        num_images = _read32(f)
+        rows = _read32(f)
+        cols = _read32(f)
+        buf = f.read(int(rows) * int(cols) * int(num_images))
+        data = numpy.frombuffer(buf, dtype=numpy.uint8)
+        return data.reshape(int(num_images), int(rows), int(cols), 1)
+
+
+def extract_labels(f):
+    """IDX labels -> 1D uint8 ndarray (reference extract_labels)."""
+    with f:
+        magic = _read32(f)
+        if magic != 2049:
+            raise ValueError(f"Invalid magic number {magic} in MNIST label "
+                             f"file: {getattr(f, 'name', f)}")
+        num_items = _read32(f)
+        buf = f.read(int(num_items))
+        return numpy.frombuffer(buf, dtype=numpy.uint8)
+
+
+def _open(train_dir, gz_name):
+    gz = os.path.join(train_dir, gz_name)
+    raw = os.path.join(train_dir, gz_name[:-3])
+    if os.path.exists(gz):
+        return gzip.open(gz, "rb")
+    if os.path.exists(raw):
+        return open(raw, "rb")
+    raise FileNotFoundError(
+        f"MNIST file {gz_name} (or its uncompressed form) not found in "
+        f"{train_dir}; this build cannot download it (no network egress) — "
+        f"place the IDX files there first")
+
+
+def read_data_sets(train_dir, data_type="train"):
+    """(images [N,28,28,1] float ndarray, labels [N]) — reference
+    read_data_sets, minus the auto-download."""
+    if data_type == "train":
+        images = extract_images(_open(train_dir, TRAIN_IMAGES))
+        labels = extract_labels(_open(train_dir, TRAIN_LABELS))
+    else:
+        images = extract_images(_open(train_dir, TEST_IMAGES))
+        labels = extract_labels(_open(train_dir, TEST_LABELS))
+    return images, labels

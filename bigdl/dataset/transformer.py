@@ -1,0 +1,12 @@
+"""pyspark-BigDL API compatibility: `bigdl.dataset.transformer`.
+
+Parity: reference pyspark/bigdl/dataset/transformer.py.
+"""
+
+from bigdl.util.common import Sample  # noqa: F401  (re-export, as there)
+
+
+def normalizer(data, mean, std):
+    """Normalize features by standard deviation (reference verbatim
+    semantics: elementwise (data - mean) / std)."""
+    return (data - mean) / std

@@ -1,0 +1,91 @@
+"""pyspark-BigDL API compatibility: the LeNet-5 example.
+
+Parity: reference pyspark/bigdl/models/lenet/lenet5.py — the canonical
+"does the pyspark API still work" script. `build_model` is the same
+channel-first LeNet the reference builds; the `__main__` driver trains it
+through the compat `Optimizer` on local MNIST IDX files (lists instead of
+RDDs — the one declared swap; there is no spark-submit here).
+
+Run:  python -m bigdl.models.lenet.lenet5 -d /path/to/mnist -n 2
+"""
+
+from optparse import OptionParser
+import sys
+
+from bigdl.models.lenet.utils import (get_end_trigger, preprocess_mnist,
+                                      validate_optimizer)
+from bigdl.nn.layer import (Linear, LogSoftMax, Model, Reshape, Sequential,
+                            SpatialConvolution, SpatialMaxPooling, Tanh)
+from bigdl.nn.criterion import ClassNLLCriterion
+from bigdl.optim.optimizer import Optimizer, SGD, Top1Accuracy
+from bigdl.util.common import (Sample, create_spark_conf, init_engine,
+                               redire_spark_logs, show_bigdl_info_logs)
+from bigdl.dataset import mnist
+from bigdl.dataset.transformer import normalizer
+
+
+def build_model(class_num):
+    """The reference LeNet-5 topology (pyspark/bigdl/models/lenet/
+    lenet5.py build_model), channel-first as there."""
+    model = Sequential()
+    model.add(Reshape([1, 28, 28]))
+    model.add(SpatialConvolution(1, 6, 5, 5))
+    model.add(Tanh())
+    model.add(SpatialMaxPooling(2, 2, 2, 2))
+    model.add(SpatialConvolution(6, 12, 5, 5))
+    model.add(Tanh())
+    model.add(SpatialMaxPooling(2, 2, 2, 2))
+    model.add(Reshape([12 * 4 * 4]))
+    model.add(Linear(12 * 4 * 4, 100))
+    model.add(Tanh())
+    model.add(Linear(100, class_num))
+    model.add(LogSoftMax())
+    return model
+
+
+if __name__ == "__main__":
+    parser = OptionParser()
+    parser.add_option("-a", "--action", dest="action", default="train")
+    parser.add_option("-b", "--batchSize", type=int, dest="batchSize",
+                      default=128)
+    parser.add_option("-o", "--modelPath", dest="modelPath",
+                      default="/tmp/lenet5/model.470")
+    parser.add_option("-c", "--checkpointPath", dest="checkpointPath",
+                      default="/tmp/lenet5")
+    parser.add_option("-t", "--endTriggerType", dest="endTriggerType",
+                      default="epoch")
+    parser.add_option("-n", "--endTriggerNum", type=int,
+                      dest="endTriggerNum", default=20)
+    parser.add_option("-d", "--dataPath", dest="dataPath",
+                      default="/tmp/mnist")
+
+    (options, args) = parser.parse_args(sys.argv)
+
+    create_spark_conf()          # kept for script parity; no Spark here
+    redire_spark_logs()
+    show_bigdl_info_logs()
+    init_engine()
+
+    if options.action == "train":
+        (train_data, test_data) = preprocess_mnist(None, options)
+
+        optimizer = Optimizer(
+            model=build_model(10),
+            training_rdd=train_data,
+            criterion=ClassNLLCriterion(),
+            optim_method=SGD(learningrate=0.01, learningrate_decay=0.0002),
+            end_trigger=get_end_trigger(options),
+            batch_size=options.batchSize)
+        validate_optimizer(optimizer, test_data, options)
+        trained_model = optimizer.optimize()
+        parameters = trained_model.parameters()
+    elif options.action == "test":
+        (images, labels) = mnist.read_data_sets(options.dataPath, "test")
+        test_data = [Sample.from_ndarray(
+            normalizer(img, mnist.TEST_MEAN, mnist.TEST_STD),
+            label + 1) for img, label in zip(images, labels)]
+        model = Model.load(options.modelPath)
+        results = model.evaluate(test_data, options.batchSize,
+                                 [Top1Accuracy()])
+        for result in results:
+            print(result)

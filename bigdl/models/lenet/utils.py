@@ -1,0 +1,50 @@
+"""pyspark-BigDL API compatibility: LeNet example helpers.
+
+Parity: reference pyspark/bigdl/models/lenet/utils.py. `sc` parameters
+are kept in the signatures for script parity but ignored — data flows as
+plain lists instead of RDDs (the declared swap).
+"""
+
+from bigdl.dataset import mnist
+from bigdl.dataset.transformer import normalizer
+from bigdl.optim.optimizer import EveryEpoch, MaxEpoch, MaxIteration, \
+    Top1Accuracy
+from bigdl.util.common import Sample
+
+
+def get_mnist(sc, data_type="train", location="/tmp/mnist"):
+    """(features ndarray, 1-based label) records — reference get_mnist
+    without the RDD parallelize."""
+    (images, labels) = mnist.read_data_sets(location, data_type)
+    return list(zip(images, labels + 1))  # Target start from 1 in BigDL
+
+
+def preprocess_mnist(sc, options):
+    """Normalize and wrap into Samples (reference preprocess_mnist)."""
+    train_data = [
+        Sample.from_ndarray(normalizer(img, mnist.TRAIN_MEAN,
+                                       mnist.TRAIN_STD), label)
+        for img, label in get_mnist(sc, "train", options.dataPath)]
+    test_data = [
+        Sample.from_ndarray(normalizer(img, mnist.TEST_MEAN,
+                                       mnist.TEST_STD), label)
+        for img, label in get_mnist(sc, "test", options.dataPath)]
+    return train_data, test_data
+
+
+def get_end_trigger(options):
+    """Reference get_end_trigger."""
+    if options.endTriggerType.lower() == "epoch":
+        return MaxEpoch(options.endTriggerNum)
+    return MaxIteration(options.endTriggerNum)
+
+
+def validate_optimizer(optimizer, test_data, options):
+    """Reference validate_optimizer."""
+    optimizer.set_validation(
+        batch_size=options.batchSize,
+        val_rdd=test_data,
+        trigger=EveryEpoch(),
+        val_method=[Top1Accuracy()]
+    )
+    optimizer.set_checkpoint(EveryEpoch(), options.checkpointPath)

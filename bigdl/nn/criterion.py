@@ -1,0 +1,107 @@
+"""pyspark-BigDL API compatibility: `bigdl.nn.criterion`.
+
+Parity: reference pyspark/bigdl/nn/criterion.py — every class there
+forwards to a JVM createX factory; here each wraps the same-named
+`bigdl_tpu.nn` criterion (built from the same Scala surface, same
+snake_case arg names) in `.value`.
+
+`forward`/`backward` mirror the reference's debug-only single-shot
+evaluation (criterion.py:42-75): ndarray in, float / ndarray out, with
+the backward computed by autodiff instead of a hand-written gradient.
+"""
+
+from __future__ import annotations
+
+import sys
+
+import numpy as np
+
+import bigdl_tpu.nn as _nn
+from bigdl_tpu.nn.criterion import Criterion as _TpuCriterion
+from bigdl.util.common import JTensor, to_list
+
+
+def _jnp(x):
+    import jax.numpy as jnp
+    if isinstance(x, JTensor):
+        x = x.to_ndarray()
+    return jnp.asarray(np.asarray(x))
+
+
+class Criterion(object):
+    """Reference pyspark/bigdl/nn/criterion.py:31."""
+
+    def __init__(self, jvalue, bigdl_type="float", *args):
+        if jvalue is None:
+            raise ValueError(
+                f"{type(self).__name__}: compat criterions must pass the "
+                "constructed bigdl_tpu criterion as jvalue")
+        self.value = jvalue
+        self.bigdl_type = bigdl_type
+
+    @classmethod
+    def of(cls, jcriterion, bigdl_type="float"):
+        criterion = Criterion(jcriterion, bigdl_type)
+        return criterion
+
+    def forward(self, input, target):
+        ins = [_jnp(i) for i in to_list(input)]
+        tgt = [_jnp(t) for t in to_list(target)]
+        out = self.value.forward(ins[0] if len(ins) == 1 else ins,
+                                 tgt[0] if len(tgt) == 1 else tgt)
+        return float(out)
+
+    def backward(self, input, target):
+        import jax
+        ins = [_jnp(i) for i in to_list(input)]
+        tgt = [_jnp(t) for t in to_list(target)]
+        x = ins[0] if len(ins) == 1 else ins
+        t = tgt[0] if len(tgt) == 1 else tgt
+        grad = jax.grad(lambda xx: self.value.forward(xx, t))(x)
+        if isinstance(grad, (list, tuple)):
+            return [np.asarray(g) for g in grad]
+        return np.asarray(grad)
+
+    def __str__(self):
+        return str(self.value)
+
+
+def _passthrough(cls_name):
+    tpu_cls = getattr(_nn, cls_name)
+
+    def _unwrap(v):
+        if isinstance(v, Criterion):
+            return v.value
+        if isinstance(v, (list, tuple)):
+            return type(v)(_unwrap(x) for x in v)
+        if isinstance(v, JTensor):
+            return v.to_ndarray()
+        return v
+
+    def __init__(self, *args, bigdl_type="float", **kwargs):
+        kwargs.pop("bigdl_type", None)
+        args = tuple(_unwrap(a) for a in args)
+        kwargs = {k: _unwrap(v) for k, v in kwargs.items()}
+        Criterion.__init__(self, tpu_cls(*args, **kwargs), bigdl_type)
+
+    doc = (f"pyspark-compat passthrough for bigdl_tpu.nn.{cls_name} "
+           f"(reference pyspark/bigdl/nn/criterion.py {cls_name}).")
+    cls = type(cls_name, (Criterion,), {"__init__": __init__,
+                                        "__doc__": doc})
+    # MultiCriterion/ParallelCriterion compose via add() in the reference
+    if hasattr(tpu_cls, "add"):
+        def add(self, criterion, weight=1.0):
+            self.value.add(getattr(criterion, "value", criterion), weight)
+            return self
+        cls.add = add
+    return cls
+
+
+__all__ = ["Criterion"]
+_module = sys.modules[__name__]
+for _name in dir(_nn):
+    _obj = getattr(_nn, _name)
+    if isinstance(_obj, type) and issubclass(_obj, _TpuCriterion) and \
+            _obj is not _TpuCriterion:
+        setattr(_module, _name, _passthrough(_name))
+        __all__.append(_name)

@@ -1,0 +1,62 @@
+"""pyspark-BigDL API compatibility: `bigdl.nn.initialization_method`.
+
+Parity: reference pyspark/bigdl/nn/initialization_method.py — wraps the
+same-named `bigdl_tpu.nn.initialization` methods in `.value` for use with
+`layer.set_init_method(...)`.
+"""
+
+from __future__ import annotations
+
+from bigdl_tpu.nn import initialization as _init
+from bigdl.util.common import JavaValue
+
+
+class InitializationMethod(JavaValue):
+    """Reference initialization_method.py InitializationMethod."""
+
+    def __init__(self, tpu_method, bigdl_type="float"):
+        self.value = tpu_method
+        self.bigdl_type = bigdl_type
+
+
+class Zeros(InitializationMethod):
+    def __init__(self, bigdl_type="float"):
+        super().__init__(_init.Zeros(), bigdl_type)
+
+
+class Ones(InitializationMethod):
+    def __init__(self, bigdl_type="float"):
+        super().__init__(_init.Ones(), bigdl_type)
+
+
+class ConstInitMethod(InitializationMethod):
+    def __init__(self, value, bigdl_type="float"):
+        super().__init__(_init.ConstInitMethod(value), bigdl_type)
+
+
+class Xavier(InitializationMethod):
+    def __init__(self, bigdl_type="float"):
+        super().__init__(_init.Xavier(), bigdl_type)
+
+
+class MsraFiller(InitializationMethod):
+    def __init__(self, varianceNormAverage=True, bigdl_type="float"):
+        super().__init__(_init.MsraFiller(varianceNormAverage), bigdl_type)
+
+
+class RandomUniform(InitializationMethod):
+    def __init__(self, upper=None, lower=None, bigdl_type="float"):
+        if upper is not None and lower is not None:
+            super().__init__(_init.RandomUniform(lower, upper), bigdl_type)
+        else:
+            super().__init__(_init.RandomUniform(), bigdl_type)
+
+
+class RandomNormal(InitializationMethod):
+    def __init__(self, mean, stdv, bigdl_type="float"):
+        super().__init__(_init.RandomNormal(mean, stdv), bigdl_type)
+
+
+class BilinearFiller(InitializationMethod):
+    def __init__(self, bigdl_type="float"):
+        super().__init__(_init.BilinearFiller(), bigdl_type)

@@ -1,0 +1,738 @@
+"""pyspark-BigDL API compatibility: `bigdl.nn.layer`.
+
+Parity: reference pyspark/bigdl/nn/layer.py:118 (`Layer`), :671
+(`Container`), :696 (`Model`), :1112 (`Sequential`) plus the per-layer
+classes. In the reference each class forwards its constructor args over
+py4j to a JVM factory; here each class builds the equivalent
+`bigdl_tpu.nn` module in-process and stores it in `.value` (the same
+field the reference uses for the JVM handle).
+
+Semantics preserved from the pyspark surface:
+  - NCHW is the default data format (the reference's Torch heritage);
+    spatial layers pass `data_format="NCHW"` down to the TPU-native
+    modules, which transpose once at trace time.
+  - `init_weight` / `init_bias` ndarrays use the reference layouts
+    (Linear: (out, in); conv: (group, out, in, kh, kw)) and are
+    transposed into the native HWIO/(in,out) layouts.
+  - Regularizers attach per-layer as in the reference
+    (wRegularizer/bRegularizer).
+  - `propagate_back`, `init_grad_weight`, `init_grad_bias` are accepted
+    and ignored: autodiff owns the backward pass, and gradients are not
+    stateful buffers here.
+
+Layers with a pyspark-specific signature are defined explicitly below;
+every other `bigdl_tpu.nn` layer is exposed through a generated
+passthrough class with the same constructor (the native arg names match
+the pyspark ones — both were derived from the same Scala createX
+factories).
+"""
+
+from __future__ import annotations
+
+import sys
+from typing import Any, List, Optional
+
+import numpy as np
+
+import bigdl_tpu.nn as _nn
+from bigdl.util.common import JTensor, Sample, to_list
+
+__all__ = ["Layer", "Container", "Model", "Sequential", "Node", "Identity"]
+
+
+def _as_ndarray(x):
+    if isinstance(x, JTensor):
+        return x.to_ndarray()
+    return np.asarray(x)
+
+
+def _jnp(x):
+    import jax.numpy as jnp
+    return jnp.asarray(_as_ndarray(x))
+
+
+class Node(object):
+    """Reference pyspark/bigdl/nn/layer.py Node — a vertex in the graph
+    DSL. Wraps a `bigdl_tpu.nn.Node`."""
+
+    def __init__(self, tpu_node, bigdl_type="float"):
+        self.value = tpu_node
+        self.bigdl_type = bigdl_type
+
+    @classmethod
+    def of(cls, tpu_node, bigdl_type="float"):
+        return cls(tpu_node, bigdl_type)
+
+    def element(self):
+        return Layer.of(self.value.module)
+
+    def remove_pre_edges(self):
+        raise NotImplementedError(
+            "remove_pre_edges: rebuild the graph instead (functional DSL)")
+
+
+class Layer(object):
+    """Reference pyspark/bigdl/nn/layer.py:118 Layer — base wrapper.
+
+    `.value` is the in-process `bigdl_tpu.nn.Module` (where the reference
+    stores the py4j JVM handle).
+    """
+
+    def __init__(self, jvalue=None, bigdl_type="float", *args):
+        if jvalue is None:
+            raise ValueError(
+                f"{type(self).__name__}: no backing module. Compat layers "
+                "must pass the constructed bigdl_tpu module as jvalue.")
+        self.value = jvalue
+        self.bigdl_type = bigdl_type
+
+    # -- construction helpers -------------------------------------------
+    @classmethod
+    def of(cls, tpu_module, bigdl_type="float"):
+        """Wrap an existing bigdl_tpu module (reference Layer.of)."""
+        layer = Layer(tpu_module, bigdl_type)
+        return layer
+
+    # -- identity -------------------------------------------------------
+    def set_name(self, name):
+        self.value.name = name
+        return self
+
+    def name(self):
+        return self.value.name
+
+    def __str__(self):
+        return str(self.value)
+
+    def set_seed(self, seed=123):
+        """Reference setModelSeed: seeds the global init RNG."""
+        from bigdl_tpu.utils.random_generator import RNG as _rng
+        _rng.setSeed(seed)
+        return self
+
+    def get_dtype(self):
+        return "float32" if self.bigdl_type == "float" else "float64"
+
+    # -- compute --------------------------------------------------------
+    def _ensure_params(self):
+        self.value.ensure_params()
+
+    def forward(self, input):
+        """Debug-only single forward (reference modelForward)."""
+        inputs = [_jnp(i) for i in to_list(input)]
+        out = self.value.forward(inputs[0] if len(inputs) == 1 else inputs)
+        return self._convert_output(out)
+
+    def backward(self, input, grad_output):
+        """Debug-only backward: grad of <output, grad_output> w.r.t.
+        input, computed by autodiff (reference modelBackward)."""
+        import jax
+        inputs = [_jnp(i) for i in to_list(input)]
+        gouts = [_jnp(g) for g in to_list(grad_output)]
+        x = inputs[0] if len(inputs) == 1 else inputs
+        g = gouts[0] if len(gouts) == 1 else gouts
+
+        def fwd(xx):
+            return self.value.forward(xx)
+
+        _, vjp = jax.vjp(fwd, x)
+        gin = vjp(g)[0]
+        return self._convert_output(gin)
+
+    def zero_grad_parameters(self):
+        """Gradients are functional values, not stored buffers: no-op."""
+        return self
+
+    def update_parameters(self, learning_rate):
+        raise NotImplementedError(
+            "update_parameters: use an Optimizer / OptimMethod")
+
+    @staticmethod
+    def _convert_output(output):
+        if isinstance(output, (list, tuple)):
+            return [np.asarray(o) for o in output]
+        try:
+            from bigdl_tpu.utils.table import Table
+            if isinstance(output, Table):
+                return [np.asarray(o) for o in output.values()]
+        except Exception:
+            pass
+        return np.asarray(output)
+
+    # -- parameters -----------------------------------------------------
+    def parameters(self):
+        """Layer-name -> {'weight': ndarray, ...} (reference
+        modelGetParameters). Layouts are the native TPU ones (HWIO etc.);
+        see docs/MIGRATION.md."""
+        self._ensure_params()
+        tree = self.value.parameters()
+        flat = {}
+
+        def walk(prefix, node):
+            leaves = {k: v for k, v in node.items()
+                      if not isinstance(v, dict)}
+            if leaves:
+                flat[prefix or self.name()] = {
+                    k: np.asarray(v) for k, v in leaves.items()}
+            for k, v in node.items():
+                if isinstance(v, dict):
+                    walk(f"{prefix}.{k}" if prefix else k, v)
+
+        walk("", tree if isinstance(tree, dict) else {"weight": tree})
+        return flat
+
+    def get_weights(self):
+        """Flat list of parameter ndarrays in layer order (reference
+        getWeights). Native layouts."""
+        self._ensure_params()
+        import jax
+        leaves = jax.tree_util.tree_leaves(self.value.parameters())
+        return [np.asarray(l) for l in leaves]
+
+    def set_weights(self, weights):
+        """Inverse of get_weights (reference setWeights)."""
+        self._ensure_params()
+        import jax
+        tree = self.value.parameters()
+        leaves, treedef = jax.tree_util.tree_flatten(tree)
+        if len(weights) != len(leaves):
+            raise ValueError(
+                f"set_weights: expected {len(leaves)} arrays, got "
+                f"{len(weights)}")
+        import jax.numpy as jnp
+        new = [jnp.asarray(np.asarray(w), l.dtype).reshape(l.shape)
+               for w, l in zip(weights, leaves)]
+        self.value.set_params(jax.tree_util.tree_unflatten(treedef, new))
+        return self
+
+    # -- training-mode flags -------------------------------------------
+    def training(self, is_training=True):
+        if is_training:
+            self.value.training()
+        else:
+            self.value.evaluate()
+        return self
+
+    def evaluate(self, *args):
+        """With no args: switch to eval mode (reference evaluate()).
+        With (val_rdd, batch_size, val_methods): run validation and
+        return EvaluatedResult list (reference modelEvaluate)."""
+        if not args:
+            self.value.evaluate()
+            return self
+        val_rdd, batch_size, val_methods = args
+        from bigdl.util.common import EvaluatedResult
+        data = [s._to_tpu_sample() if isinstance(s, Sample) else s
+                for s in val_rdd]
+        self._ensure_params()
+        results = self.value.evaluate_on(data, [m.value for m in val_methods],
+                                         batch_size=batch_size)
+        out = []
+        for r, m in zip(results, val_methods):
+            value, total = r.result()  # native contract: (metric, count)
+            out.append(EvaluatedResult(float(value), int(total), str(m)))
+        return out
+
+    def is_training(self):
+        return bool(self.value.training_mode)
+
+    # -- prediction -----------------------------------------------------
+    def predict(self, data_rdd, batch_size=32):
+        """Predict over a list of Samples / ndarray (reference
+        predict/predict_distributed — one in-process path here)."""
+        return self.predict_local(data_rdd, batch_size)
+
+    predict_distributed = predict
+
+    def predict_local(self, X, batch_size=32):
+        self._ensure_params()
+        if isinstance(X, np.ndarray):
+            return np.asarray(self.value.predict(_jnp(X),
+                                                 batch_size=batch_size))
+        data = [s._to_tpu_sample() if isinstance(s, Sample) else s
+                for s in X]
+        return np.asarray(self.value.predict(data, batch_size=batch_size))
+
+    def predict_class(self, data_rdd, batch_size=32):
+        """Class prediction, 1-based as in the reference."""
+        self._ensure_params()
+        if isinstance(data_rdd, np.ndarray):
+            return np.asarray(self.value.predict_class(
+                _jnp(data_rdd), batch_size=batch_size))
+        data = [s._to_tpu_sample() if isinstance(s, Sample) else s
+                for s in data_rdd]
+        return np.asarray(self.value.predict_class(data,
+                                                   batch_size=batch_size))
+
+    predict_classes = predict_class
+
+    # -- persistence ----------------------------------------------------
+    def save(self, path, over_write=False):
+        import os
+        if not over_write and os.path.exists(path):
+            raise RuntimeError(f"file exists: {path} (over_write=False)")
+        self._ensure_params()
+        from bigdl_tpu.serialization.module_serializer import ModuleSerializer
+        ModuleSerializer.save(self.value, path)
+        return self
+
+    def saveModel(self, modelPath, weightPath=None, over_write=False):
+        return self.save(modelPath, over_write)
+
+    def save_caffe(self, prototxt_path, model_path, use_v2=True,
+                   overwrite=False):
+        from bigdl_tpu.interop.caffe import CaffePersister
+        CaffePersister.persist(prototxt_path, model_path, self.value,
+                               use_v2=use_v2, overwrite=overwrite)
+        return self
+
+    def save_tensorflow(self, inputs, path, byte_order="little_endian",
+                        data_format="nhwc"):
+        from bigdl_tpu.interop.tensorflow import TensorflowSaver
+        TensorflowSaver.save(self.value, inputs, path,
+                             byte_order=byte_order, data_format=data_format)
+        return self
+
+    # -- misc parity ----------------------------------------------------
+    def quantize(self):
+        return Layer.of(self.value.quantize())
+
+    def set_init_method(self, weight_init_method=None, bias_init_method=None):
+        m = self.value
+        if weight_init_method is not None:
+            m.weight_init = getattr(weight_init_method, "value",
+                                    weight_init_method)
+        if bias_init_method is not None:
+            m.bias_init = getattr(bias_init_method, "value", bias_init_method)
+        return self
+
+    def freeze(self, names=None):
+        raise NotImplementedError(
+            "freeze: pass per-submodule optim methods instead "
+            "(set_optim_methods with a zero-lr method)")
+
+    unfreeze = freeze
+
+    def __call__(self, x=None):
+        """Graph DSL: layer(node) -> Node (reference createNode). Native
+        spelling is `module.inputs(*nodes)` (the Scala `inputs` API)."""
+        xs = to_list(x) if x is not None else []
+        tpu_nodes = [n.value if isinstance(n, Node) else n for n in xs]
+        return Node.of(self.value.inputs(*tpu_nodes))
+
+
+class SharedStaticUtils(object):
+    """Static load/of utilities shared by Layer and Model in the reference
+    (pyspark/bigdl/nn/layer.py:49)."""
+
+    @staticmethod
+    def load(path, bigdl_type="float"):
+        from bigdl_tpu.serialization.module_serializer import ModuleSerializer
+        return Layer.of(ModuleSerializer.load(path), bigdl_type)
+
+
+# Layer inherits the statics the same way the reference mixes them in.
+Layer.load = staticmethod(SharedStaticUtils.load)
+
+
+class Container(Layer):
+    """Reference pyspark/bigdl/nn/layer.py:671."""
+
+    def add(self, model):
+        self.value.add(model.value)
+        return self
+
+    @property
+    def layers(self):
+        return [Layer.of(m) for m in self.value.children]
+
+    def flattened_layers(self, include_container=False):
+        out = []
+
+        def walk(m):
+            subs = getattr(m, "children", None)
+            if subs:
+                if include_container:
+                    out.append(m)
+                for s in subs:
+                    walk(s)
+            else:
+                out.append(m)
+
+        walk(self.value)
+        return [Layer.of(m) for m in out]
+
+
+class Sequential(Container):
+    """Reference pyspark/bigdl/nn/layer.py:1112."""
+
+    def __init__(self, jvalue=None, bigdl_type="float"):
+        super().__init__(jvalue or _nn.Sequential(), bigdl_type)
+
+
+class Model(Container):
+    """Graph container (reference pyspark/bigdl/nn/layer.py:696).
+
+    `Model(inputs, outputs)` over `Node`s from the `layer(node)` DSL.
+    """
+
+    def __init__(self, inputs=None, outputs=None, jvalue=None,
+                 bigdl_type="float", byte_order="little_endian",
+                 model_type="bigdl"):
+        if jvalue is not None:
+            super().__init__(jvalue, bigdl_type)
+            return
+        if model_type != "bigdl":
+            raise NotImplementedError(
+                "model_type='tensorflow': use Model.load_tensorflow")
+        ins = [n.value if isinstance(n, Node) else n for n in to_list(inputs)]
+        outs = [n.value if isinstance(n, Node) else n
+                for n in to_list(outputs)]
+        super().__init__(_nn.Graph(ins, outs), bigdl_type)
+
+    @staticmethod
+    def from_jvalue(jvalue, bigdl_type="float"):
+        return Model(jvalue=jvalue, bigdl_type=bigdl_type)
+
+    @staticmethod
+    def load(path, bigdl_type="float"):
+        return SharedStaticUtils.load(path, bigdl_type)
+
+    @staticmethod
+    def loadModel(modelPath, weightPath=None, bigdl_type="float"):
+        return SharedStaticUtils.load(modelPath, bigdl_type)
+
+    @staticmethod
+    def load_torch(path, bigdl_type="float"):
+        from bigdl_tpu.interop.torch_file import TorchFile
+        return Layer.of(TorchFile.load_module(path))
+
+    @staticmethod
+    def load_caffe(model, defPath, modelPath, match_all=True,
+                   bigdl_type="float"):
+        from bigdl_tpu.interop.caffe import CaffeLoader
+        return Layer.of(CaffeLoader.load(model.value if model else None,
+                                         defPath, modelPath,
+                                         match_all=match_all))
+
+    @staticmethod
+    def load_caffe_model(defPath, modelPath, bigdl_type="float"):
+        from bigdl_tpu.interop.caffe import CaffeLoader
+        return Layer.of(CaffeLoader.load_caffe(defPath, modelPath))
+
+    @staticmethod
+    def load_tensorflow(path, inputs, outputs, byte_order="little_endian",
+                        bin_file=None, bigdl_type="float"):
+        from bigdl_tpu.interop.tensorflow import TensorflowLoader
+        return Layer.of(TensorflowLoader.load(path, inputs, outputs,
+                                              byte_order=byte_order,
+                                              bin_file=bin_file))
+
+    @staticmethod
+    def load_keras(json_path=None, hdf5_path=None, by_name=False):
+        from bigdl_tpu.interop.keras_converter import load_keras
+        return Layer.of(load_keras(json_path, hdf5_path, by_name=by_name))
+
+    @staticmethod
+    def train(output, data, label, opt_method, criterion, batch_size,
+              end_when, session=None, bigdl_type="float"):
+        raise NotImplementedError(
+            "Model.train (TF-graph training): use bigdl_tpu.interop."
+            "tf_session.Session.train")
+
+    def stop_gradient(self, stop_layers, bigdl_type="float"):
+        raise NotImplementedError(
+            "stop_gradient: wrap the subgraph with jax.lax.stop_gradient "
+            "via bigdl_tpu.nn.StopGradient")
+
+    def node(self, name, bigdl_type="float"):
+        for n in self.value.exec_order:
+            if getattr(n.module, "name", None) == name:
+                return Node.of(n)
+        raise KeyError(name)
+
+    def save_graph_topology(self, log_path, bigdl_type="float"):
+        from bigdl_tpu.visualization import summary_writer
+        raise NotImplementedError(
+            "save_graph_topology: use bigdl_tpu.visualization")
+
+
+# ---------------------------------------------------------------------------
+# Explicit signatures: layers whose pyspark arg lists interleave
+# regularizers / init tensors / propagate_back with structural args, so a
+# positional passthrough would mis-bind.
+# ---------------------------------------------------------------------------
+
+def _set_initial_weights(module, mapping):
+    """Install explicit init ndarrays (reference init_weight/init_bias)
+    after transposing reference layouts into native ones."""
+    import jax
+    import jax.numpy as jnp
+    module.ensure_params()
+    params = dict(module.parameters())
+    for key, array in mapping.items():
+        if array is None:
+            continue
+        tgt = params[key]
+        arr = jnp.asarray(np.asarray(array), jnp.asarray(tgt).dtype)
+        if arr.shape != jnp.asarray(tgt).shape:
+            raise ValueError(
+                f"init {key}: shape {arr.shape} vs expected "
+                f"{jnp.asarray(tgt).shape}")
+        params[key] = arr
+    module.set_params(params)
+
+
+def _linear_weight_to_native(w):
+    """Reference Linear weight (out, in) -> native (in, out)."""
+    if w is None:
+        return None
+    return np.asarray(w).T
+
+
+def _conv_weight_to_native(w, n_group=1):
+    """Reference conv weight (group, out/group, in/group, kh, kw) or
+    (out, in, kh, kw) -> native HWIO (kh, kw, in/group, out)."""
+    if w is None:
+        return None
+    w = np.asarray(w)
+    if w.ndim == 5:
+        g, og, i, kh, kw = w.shape
+        w = w.reshape(g * og, i, kh, kw)
+    return np.transpose(w, (2, 3, 1, 0))
+
+
+class Linear(Layer):
+    """Reference pyspark/bigdl/nn/layer.py:905."""
+
+    def __init__(self, input_size, output_size, with_bias=True,
+                 wRegularizer=None, bRegularizer=None, init_weight=None,
+                 init_bias=None, init_grad_weight=None, init_grad_bias=None,
+                 bigdl_type="float"):
+        m = _nn.Linear(input_size, output_size, with_bias=with_bias)
+        super().__init__(m, bigdl_type)
+        _attach_regularizers(m, wRegularizer, bRegularizer)
+        if init_weight is not None or init_bias is not None:
+            _set_initial_weights(m, {
+                "weight": _linear_weight_to_native(init_weight),
+                "bias": init_bias})
+
+
+class SpatialConvolution(Layer):
+    """Reference pyspark/bigdl/nn/layer.py:1373. NCHW default."""
+
+    def __init__(self, n_input_plane, n_output_plane, kernel_w, kernel_h,
+                 stride_w=1, stride_h=1, pad_w=0, pad_h=0, n_group=1,
+                 propagate_back=True, wRegularizer=None, bRegularizer=None,
+                 init_weight=None, init_bias=None, init_grad_weight=None,
+                 init_grad_bias=None, with_bias=True, data_format="NCHW",
+                 bigdl_type="float"):
+        m = _nn.SpatialConvolution(
+            n_input_plane, n_output_plane, kernel_w, kernel_h, stride_w,
+            stride_h, pad_w=pad_w, pad_h=pad_h, n_group=n_group,
+            with_bias=with_bias, data_format=data_format)
+        super().__init__(m, bigdl_type)
+        _attach_regularizers(m, wRegularizer, bRegularizer)
+        if init_weight is not None or init_bias is not None:
+            _set_initial_weights(m, {
+                "weight": _conv_weight_to_native(init_weight, n_group),
+                "bias": init_bias})
+
+
+class SpatialMaxPooling(Layer):
+    """Reference pyspark/bigdl/nn/layer.py:1489. NCHW default."""
+
+    def __init__(self, kw, kh, dw=1, dh=1, pad_w=0, pad_h=0,
+                 to_ceil=False, format="NCHW", bigdl_type="float"):
+        super().__init__(_nn.SpatialMaxPooling(
+            kw, kh, dw, dh, pad_w=pad_w, pad_h=pad_h, ceil_mode=to_ceil,
+            data_format=format), bigdl_type)
+
+
+class SpatialAveragePooling(Layer):
+    """Reference pyspark SpatialAveragePooling. NCHW default."""
+
+    def __init__(self, kw, kh, dw=1, dh=1, pad_w=0, pad_h=0,
+                 global_pooling=False, ceil_mode=False,
+                 count_include_pad=True, divide=True, format="NCHW",
+                 bigdl_type="float"):
+        if global_pooling:
+            raise NotImplementedError(
+                "global_pooling=True: size the kernel to the feature map "
+                "(reference semantics) or use bigdl_tpu pooling directly")
+        super().__init__(_nn.SpatialAveragePooling(
+            kw, kh, dw, dh, pad_w=pad_w, pad_h=pad_h, ceil_mode=ceil_mode,
+            count_include_pad=count_include_pad, divide=divide,
+            data_format=format), bigdl_type)
+
+
+class SpatialBatchNormalization(Layer):
+    """Reference pyspark SpatialBatchNormalization. NCHW input."""
+
+    def __init__(self, n_output, eps=1e-5, momentum=0.1, affine=True,
+                 init_weight=None, init_bias=None, init_grad_weight=None,
+                 init_grad_bias=None, data_format="NCHW",
+                 bigdl_type="float"):
+        m = _nn.SpatialBatchNormalization(
+            n_output, eps=eps, momentum=momentum, affine=affine,
+            data_format=data_format)
+        super().__init__(m, bigdl_type)
+        if affine and (init_weight is not None or init_bias is not None):
+            _set_initial_weights(m, {"weight": init_weight,
+                                     "bias": init_bias})
+
+
+class BatchNormalization(Layer):
+    """Reference pyspark BatchNormalization (1-D features)."""
+
+    def __init__(self, n_output, eps=1e-5, momentum=0.1, affine=True,
+                 init_weight=None, init_bias=None, init_grad_weight=None,
+                 init_grad_bias=None, bigdl_type="float"):
+        m = _nn.BatchNormalization(n_output, eps=eps, momentum=momentum,
+                                   affine=affine)
+        super().__init__(m, bigdl_type)
+        if affine and (init_weight is not None or init_bias is not None):
+            _set_initial_weights(m, {"weight": init_weight,
+                                     "bias": init_bias})
+
+
+class LookupTable(Layer):
+    """Reference pyspark LookupTable."""
+
+    def __init__(self, n_index, n_output, padding_value=0.0, max_norm=1e20,
+                 norm_type=2.0, should_scale_grad_by_freq=False,
+                 wRegularizer=None, bigdl_type="float"):
+        m = _nn.LookupTable(n_index, n_output, padding_value=padding_value,
+                            max_norm=max_norm, norm_type=norm_type)
+        super().__init__(m, bigdl_type)
+        _attach_regularizers(m, wRegularizer, None)
+
+
+class Dropout(Layer):
+    """Reference pyspark Dropout."""
+
+    def __init__(self, init_p=0.5, inplace=False, scale=True,
+                 bigdl_type="float"):
+        super().__init__(_nn.Dropout(init_p, inplace=inplace, scale=scale),
+                         bigdl_type)
+
+
+class Reshape(Layer):
+    """Reference pyspark Reshape."""
+
+    def __init__(self, size, batch_mode=None, bigdl_type="float"):
+        super().__init__(_nn.Reshape(list(size), batch_mode=batch_mode
+                                     if batch_mode is not None else True),
+                         bigdl_type)
+
+
+class View(Layer):
+    def __init__(self, sizes, num_input_dims=0, bigdl_type="float"):
+        # num_input_dims only disambiguates batch handling in the
+        # reference; the native View already infers batch mode
+        super().__init__(_nn.View(to_list(sizes)), bigdl_type)
+
+
+class Echo(Layer):
+    def __init__(self, bigdl_type="float"):
+        super().__init__(_nn.Echo(), bigdl_type)
+
+
+class TemporalConvolution(Layer):
+    """Reference pyspark TemporalConvolution."""
+
+    def __init__(self, input_frame_size, output_frame_size, kernel_w,
+                 stride_w=1, propagate_back=True, weight_regularizer=None,
+                 bias_regularizer=None, init_weight=None, init_bias=None,
+                 init_grad_weight=None, init_grad_bias=None,
+                 bigdl_type="float"):
+        m = _nn.TemporalConvolution(input_frame_size, output_frame_size,
+                                    kernel_w, stride_w)
+        super().__init__(m, bigdl_type)
+        _attach_regularizers(m, weight_regularizer, bias_regularizer)
+        if init_weight is not None or init_bias is not None:
+            _set_initial_weights(m, {"weight": init_weight,
+                                     "bias": init_bias})
+
+
+class Input(Node):
+    """Reference pyspark/bigdl/nn/layer.py:2694 — note the reference's own
+    caveat: "the return is not a layer but a Node containing input layer".
+    Wraps the native `InputNode()`."""
+
+    def __init__(self, name=None, bigdl_type="float"):
+        super().__init__(_nn.InputNode(name), bigdl_type)
+
+
+class L1Penalty(Layer):
+    """Reference pyspark L1Penalty — an identity layer that adds an L1
+    activity penalty to the loss. Native analogue: ActivityRegularization
+    (the reference class lives in layer.py; the native one carries the
+    penalty through the functional loss context)."""
+
+    def __init__(self, l1weight, size_average=False, provide_output=True,
+                 bigdl_type="float"):
+        super().__init__(_nn.ActivityRegularization(l1=float(l1weight)),
+                         bigdl_type)
+
+
+def _attach_regularizers(module, w_reg, b_reg):
+    """Per-layer regularizers (reference wRegularizer/bRegularizer).
+    Compat objects wrap bigdl_tpu regularizers in `.value`."""
+    if w_reg is not None:
+        module.w_regularizer = getattr(w_reg, "value", w_reg)
+    if b_reg is not None:
+        module.b_regularizer = getattr(b_reg, "value", b_reg)
+
+
+# ---------------------------------------------------------------------------
+# Generated passthroughs: every other reference pyspark layer class whose
+# bigdl_tpu constructor uses the same (snake_case) parameter names — both
+# APIs were derived from the same Scala createX factories, so keyword and
+# prefix-positional calls bind identically. `bigdl_type` is stripped.
+# ---------------------------------------------------------------------------
+
+def _unwrap(v):
+    """Compat Layer/Node args -> the underlying bigdl_tpu object, so
+    passthroughs accept wrapped submodules (e.g. TimeDistributed(layer))."""
+    if isinstance(v, (Layer, Node)):
+        return v.value
+    if isinstance(v, (list, tuple)):
+        return type(v)(_unwrap(x) for x in v)
+    return v
+
+
+def _passthrough(cls_name):
+    tpu_cls = getattr(_nn, cls_name)
+
+    def __init__(self, *args, bigdl_type="float", **kwargs):
+        kwargs.pop("bigdl_type", None)
+        args = tuple(_unwrap(a) for a in args)
+        kwargs = {k: _unwrap(v) for k, v in kwargs.items()}
+        Layer.__init__(self, tpu_cls(*args, **kwargs), bigdl_type)
+
+    doc = (f"pyspark-compat passthrough for bigdl_tpu.nn.{cls_name} "
+           f"(reference pyspark/bigdl/nn/layer.py create{cls_name}).")
+    return type(cls_name, (Layer,), {"__init__": __init__, "__doc__": doc})
+
+
+_EXPLICIT = {
+    "Layer", "Container", "Model", "Sequential", "Node", "Linear",
+    "SpatialConvolution", "SpatialMaxPooling", "SpatialAveragePooling",
+    "SpatialBatchNormalization", "BatchNormalization", "LookupTable",
+    "Dropout", "Reshape", "View", "Echo", "TemporalConvolution",
+    "L1Penalty", "Input",
+}
+
+_module = sys.modules[__name__]
+for _name in dir(_nn):
+    if _name.startswith("_") or _name in _EXPLICIT:
+        continue
+    _obj = getattr(_nn, _name)
+    if isinstance(_obj, type) and issubclass(_obj, _nn.Module) and \
+            not getattr(_obj, "_is_criterion", False):
+        setattr(_module, _name, _passthrough(_name))
+        __all__.append(_name)
+
+__all__ += sorted(_EXPLICIT - {"Layer", "Container", "Model", "Sequential",
+                               "Node"})

@@ -1,0 +1,330 @@
+"""pyspark-BigDL API compatibility: `bigdl.util.common`.
+
+Parity: reference pyspark/bigdl/util/common.py:100 — the JavaValue /
+callBigDlFunc machinery there bridges Python to a JVM over py4j; in this
+TPU-native framework the "backend" is the in-process `bigdl_tpu` package,
+so `callBigDlFunc` dispatches to plain Python constructors and the
+Spark-context helpers become no-ops that keep reference scripts importable
+and runnable unmodified (minus the SparkContext itself — the one declared
+swap is RDD -> list/ndarray).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, List, Optional
+
+import numpy as np
+
+_log = logging.getLogger("bigdl.util")
+
+
+def get_dtype(bigdl_type: str = "float"):
+    """Reference pyspark/bigdl/util/common.py get_dtype: always float32."""
+    return "float32"
+
+
+def to_list(a):
+    """Reference pyspark/bigdl/util/common.py to_list."""
+    if isinstance(a, list):
+        return a
+    return [a]
+
+
+class SingletonMixin(object):
+    """Reference pyspark/bigdl/util/common.py SingletonMixin."""
+
+    _instance = None
+
+    @classmethod
+    def instance(cls, *args, **kwargs):
+        if cls._instance is None:
+            cls._instance = cls(*args, **kwargs)
+        return cls._instance
+
+
+class JavaValue(object):
+    """Reference pyspark/bigdl/util/common.py:100 JavaValue.
+
+    In the reference, `__init__` calls `callBigDlFunc(bigdl_type,
+    "create<ClassName>", *args)` through py4j and stores the resulting JVM
+    handle in `self.value`. Here `self.value` holds the in-process
+    `bigdl_tpu` object the subclass constructed — same field name, so code
+    that passes `.value` around keeps working.
+    """
+
+    def jvm_class_constructor(self):
+        return "create" + self.__class__.__name__
+
+    def __init__(self, jvalue, bigdl_type="float", *args):
+        self.value = jvalue if jvalue is not None else callBigDlFunc(
+            bigdl_type, self.jvm_class_constructor(), *args)
+        self.bigdl_type = bigdl_type
+
+    def __str__(self):
+        return str(self.value)
+
+
+def callBigDlFunc(bigdl_type: str, name: str, *args):
+    """In-process stand-in for the reference's py4j dispatch
+    (pyspark/bigdl/util/common.py callBigDlFunc).
+
+    Supports the `create<ClassName>` pattern by resolving the class in
+    `bigdl_tpu`'s nn / optim namespaces. Anything else raises with a
+    pointer to the native `bigdl_tpu` API, which covers the full surface.
+    """
+    if name.startswith("create"):
+        cls_name = name[len("create"):]
+        import bigdl_tpu.nn as _nn
+        import bigdl_tpu.optim as _optim
+        for ns in (_nn, _optim):
+            cls = getattr(ns, cls_name, None)
+            if cls is not None:
+                return cls(*args)
+    raise NotImplementedError(
+        f"callBigDlFunc({name!r}): no JVM here — use the equivalent "
+        f"bigdl_tpu API (see docs/MIGRATION.md)")
+
+
+def callJavaFunc(func, *args):
+    """Reference pyspark/bigdl/util/common.py callJavaFunc: direct call."""
+    return func(*args)
+
+
+class JTensor(object):
+    """Reference pyspark/bigdl/util/common.py JTensor: the ndarray wrapper
+    used to ship tensors across the py4j bridge. Kept bit-compatible
+    (storage + int32 shape (+ indices for sparse)) so user code that builds
+    or unpacks JTensors runs unmodified; `to_ndarray` is now free.
+    """
+
+    def __init__(self, storage, shape, bigdl_type="float", indices=None):
+        if isinstance(storage, bytes) and isinstance(shape, bytes):
+            self.storage = np.frombuffer(storage, dtype=get_dtype(bigdl_type))
+            self.shape = np.frombuffer(shape, dtype=np.int32)
+        else:
+            self.storage = np.array(storage, dtype=get_dtype(bigdl_type))
+            self.shape = np.array(shape, dtype=np.int32)
+        if indices is None:
+            self.indices = None
+        elif isinstance(indices, bytes):
+            self.indices = np.frombuffer(indices, dtype=np.int32)
+        else:
+            assert isinstance(indices, np.ndarray), \
+                f"indices should be a np.ndarray, not {type(indices)}"
+            self.indices = np.array(indices, dtype=np.int32)
+        self.bigdl_type = bigdl_type
+
+    @classmethod
+    def from_ndarray(cls, a_ndarray, bigdl_type="float"):
+        if a_ndarray is None:
+            return None
+        assert isinstance(a_ndarray, np.ndarray), \
+            f"input should be a np.ndarray, not {type(a_ndarray)}"
+        return cls(a_ndarray, a_ndarray.shape, bigdl_type)
+
+    @classmethod
+    def sparse(cls, a_ndarray, i_ndarray, shape, bigdl_type="float"):
+        """Sparse JTensor from values + indices (reference layout: the
+        indices array is the concatenation of one row per dimension)."""
+        assert isinstance(a_ndarray, np.ndarray)
+        assert isinstance(i_ndarray, np.ndarray)
+        assert i_ndarray.size == a_ndarray.size * shape.size, \
+            (f"size of values {a_ndarray.size} * shape {shape.size} != "
+             f"indices {i_ndarray.size}")
+        return cls(a_ndarray, shape, bigdl_type, i_ndarray)
+
+    def to_ndarray(self):
+        return np.asarray(self.storage, dtype=get_dtype(self.bigdl_type)
+                          ).reshape(tuple(int(s) for s in self.shape))
+
+    def __reduce__(self):
+        if self.indices is None:
+            return JTensor, (self.storage.tostring(), self.shape.tostring(),
+                             self.bigdl_type)
+        return JTensor, (self.storage.tostring(), self.shape.tostring(),
+                         self.bigdl_type, self.indices.tostring())
+
+    def __str__(self):
+        return (f"JTensor: storage: {self.storage}, shape: {self.shape}"
+                + (f", indices: {self.indices}" if self.indices is not None
+                   else ""))
+
+    def __repr__(self):
+        return self.__str__()
+
+
+class Sample(object):
+    """Reference pyspark/bigdl/util/common.py:291 Sample — features +
+    labels, each a list of JTensors."""
+
+    def __init__(self, features, labels, bigdl_type="float"):
+        self.feature = features[0]
+        self.features = features
+        self.label = labels[0]
+        self.labels = labels
+        self.bigdl_type = bigdl_type
+
+    @classmethod
+    def from_ndarray(cls, features, labels, bigdl_type="float"):
+        if isinstance(features, np.ndarray):
+            features = [features]
+        else:
+            assert all(isinstance(f, np.ndarray) for f in features), \
+                f"features should be a list of np.ndarray, not {type(features)}"
+        if np.isscalar(labels):
+            labels = [np.array(labels)]
+        elif isinstance(labels, np.ndarray):
+            labels = [labels]
+        else:
+            assert all(isinstance(l, np.ndarray) for l in labels), \
+                f"labels should be a list of np.ndarray, not {type(labels)}"
+        return cls(
+            features=[JTensor.from_ndarray(f) for f in features],
+            labels=[JTensor.from_ndarray(l) for l in labels],
+            bigdl_type=bigdl_type)
+
+    @classmethod
+    def from_jtensor(cls, features, labels, bigdl_type="float"):
+        if isinstance(features, JTensor):
+            features = [features]
+        else:
+            assert all(isinstance(f, JTensor) for f in features), \
+                f"features should be a list of JTensor, not {type(features)}"
+        if np.isscalar(labels):
+            labels = [JTensor.from_ndarray(np.array(labels))]
+        elif isinstance(labels, JTensor):
+            labels = [labels]
+        else:
+            assert all(isinstance(l, JTensor) for l in labels), \
+                f"labels should be a list of JTensor, not {type(labels)}"
+        return cls(features=features, labels=labels, bigdl_type=bigdl_type)
+
+    def _to_tpu_sample(self):
+        """Convert to the native `bigdl_tpu.dataset.Sample`."""
+        from bigdl_tpu.dataset import Sample as TpuSample
+        return TpuSample([f.to_ndarray() for f in self.features],
+                         [l.to_ndarray() for l in self.labels])
+
+    def __reduce__(self):
+        return Sample, (self.features, self.labels, self.bigdl_type)
+
+    def __str__(self):
+        return f"Sample: features: {self.features}, labels: {self.labels}"
+
+    def __repr__(self):
+        return self.__str__()
+
+
+class EvaluatedResult(object):
+    """Reference pyspark/bigdl/util/common.py EvaluatedResult."""
+
+    def __init__(self, result, total_num, method):
+        self.result = result
+        self.total_num = total_num
+        self.method = method
+
+    def __reduce__(self):
+        return EvaluatedResult, (self.result, self.total_num, self.method)
+
+    def __str__(self):
+        return (f"Evaluated result: {self.result}, total_num: "
+                f"{self.total_num}, method: {self.method}")
+
+
+class JActivity(object):
+    def __init__(self, value):
+        self.value = value
+
+
+class RNG:
+    """Reference pyspark/bigdl/util/common.py RNG — delegates to the
+    framework generator (bigdl_tpu RandomGenerator, MT-parity with the
+    reference's com.intel.analytics.bigdl.utils.RandomGenerator)."""
+
+    def __init__(self, bigdl_type="float"):
+        self.bigdl_type = bigdl_type
+
+    def set_seed(self, seed):
+        from bigdl_tpu.utils.random_generator import RNG as _rng
+        _rng.setSeed(seed)
+
+    def uniform(self, a, b, size):
+        from bigdl_tpu.utils.random_generator import RNG as _rng
+        return np.asarray(_rng.uniform(a, b, size=size))
+
+
+def init_engine(bigdl_type="float"):
+    """Reference pyspark/bigdl/util/common.py init_engine: initializes the
+    executor-side engine. Here: `bigdl_tpu.utils.engine.Engine.init`."""
+    from bigdl_tpu.utils.engine import Engine
+    Engine.init()
+
+
+def get_node_and_core_number(bigdl_type="float"):
+    """Reference: (node_number, core_number) from the Engine."""
+    from bigdl_tpu.utils.engine import Engine
+    import jax
+    return Engine.node_number(), jax.local_device_count()
+
+
+def init_executor_gateway(sc, bigdl_type="float"):
+    """No py4j gateway to start — kept importable for reference scripts."""
+    _log.info("init_executor_gateway: no-op (in-process backend)")
+
+
+def redire_spark_logs(bigdl_type="float", log_path=None):
+    """Reference redirects Spark logs into a file; here a no-op that keeps
+    reference driver scripts runnable."""
+    _log.debug("redire_spark_logs: no-op (no Spark JVM)")
+
+
+def show_bigdl_info_logs(bigdl_type="float"):
+    logging.getLogger("bigdl_tpu").setLevel(logging.INFO)
+    logging.getLogger("bigdl_tpu.optim").setLevel(logging.INFO)
+
+
+def get_spark_context(conf=None):
+    """Reference returns the active SparkContext. Without Spark there is no
+    context object; raise with the migration pointer instead of a silent
+    fake — reference scripts' `sc` usages are exactly the RDD swap sites."""
+    raise RuntimeError(
+        "No Spark runtime in bigdl-tpu: pass plain lists/ndarrays instead "
+        "of RDDs (see docs/MIGRATION.md, 'pyspark compatibility')")
+
+
+class SparkConf(dict):
+    """Minimal stand-in for pyspark.SparkConf so `create_spark_conf()`
+    keeps working in reference scripts; settings are recorded but unused."""
+
+    def set(self, key, value):
+        self[key] = value
+        return self
+
+    def setAppName(self, name):
+        return self.set("spark.app.name", name)
+
+    def setMaster(self, master):
+        return self.set("spark.master", master)
+
+    def get(self, key, default=None):  # dict.get already matches
+        return super().get(key, default)
+
+
+def create_spark_conf():
+    """Reference builds a SparkConf preloaded with BigDL properties
+    (pyspark/bigdl/util/common.py create_spark_conf). Returns the stub
+    conf; `Engine.config` is the real configuration surface."""
+    return SparkConf()
+
+
+def get_activities(activities):
+    return activities
+
+
+def _py2java(gateway, obj):  # pragma: no cover - compat shim
+    return obj
+
+
+def _java2py(gateway, r, encoding="bytes"):  # pragma: no cover - compat shim
+    return r

@@ -1,0 +1,44 @@
+"""pyspark-BigDL API compatibility: `bigdl.util.engine`.
+
+Parity: reference pyspark/bigdl/util/engine.py — classpath/SPARK_HOME
+bootstrap for the py4j bridge. There is no JVM here, so these are
+importable no-ops that keep reference launcher scripts working.
+"""
+
+from __future__ import annotations
+
+import logging
+
+_log = logging.getLogger("bigdl.util.engine")
+
+
+def exist_pyspark():
+    try:
+        import pyspark  # noqa: F401
+        return True
+    except ImportError:
+        return False
+
+
+def check_spark_source_conflict(spark_home, pyspark_path):
+    pass
+
+
+def compare_version(version1, version2):
+    """Reference engine.py compare_version: 1 / -1 / 0."""
+    v1 = [int(x) for x in version1.split(".") if x.isdigit()]
+    v2 = [int(x) for x in version2.split(".") if x.isdigit()]
+    return (v1 > v2) - (v1 < v2)
+
+
+def prepare_env():
+    _log.debug("prepare_env: no JVM/Spark classpath to prepare")
+
+
+def get_bigdl_classpath():
+    """No jar to locate; returns '' as the reference does pre-build."""
+    return ""
+
+
+def is_spark_below_2_2():
+    return False

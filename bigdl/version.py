@@ -1,0 +1,3 @@
+"""Parity: reference pyspark/bigdl/version.py."""
+
+__version__ = "0.14.0.dev0"
