@@ -368,6 +368,52 @@ def _cpu_pinned() -> bool:
         == "cpu"
 
 
+def _records_dir() -> str:
+    """Where validated TPU captures live. Overridable for tests."""
+    return os.environ.get("BIGDL_TPU_RECORDS_DIR",
+                          os.path.join(_repo_root(), "docs",
+                                       "bench_records"))
+
+
+_LATEST_CAPTURE = "latest_tpu_capture.json"
+
+
+def _load_last_validated():
+    """The most recent validated accelerator headline, or None.
+
+    Why: the round artifact (BENCH_rN.json) has twice recorded a bare CPU
+    fallback during multi-hour tunnel outages while the real TPU numbers
+    sat in archived captures nobody parses. Embedding the last validated
+    capture (marked stale) makes the artifact self-evidencing either way.
+    """
+    path = os.path.join(_records_dir(), _LATEST_CAPTURE)
+    try:
+        with open(path) as f:
+            cap = json.load(f)
+        return cap if isinstance(cap, dict) and "value" in cap else None
+    except (OSError, ValueError):
+        return None
+
+
+def _save_validated_capture(out: dict):
+    """Persist a successful accelerator headline as the new latest
+    capture AND an append-only timestamped archive copy."""
+    import time
+    rec_dir = _records_dir()
+    try:
+        os.makedirs(rec_dir, exist_ok=True)
+        cap = dict(out)
+        cap["captured_at"] = time.strftime("%Y-%m-%dT%H:%M:%S%z")
+        with open(os.path.join(rec_dir, _LATEST_CAPTURE), "w") as f:
+            json.dump(cap, f, indent=1)
+        stamp = time.strftime("%Y%m%d_%H%M%S")
+        with open(os.path.join(rec_dir,
+                               f"auto_headline_{stamp}.json"), "w") as f:
+            json.dump(cap, f, indent=1)
+    except OSError as e:
+        print(f"could not archive validated capture: {e}", file=sys.stderr)
+
+
 def _accel_responsive(timeout_s: float = 150.0, attempts: int = 6,
                       backoff_s: float = 90.0) -> bool:
     """Probe the accelerator in a SUBPROCESS with a hard timeout, retrying.
@@ -581,11 +627,12 @@ def main():
         else:
             print("accelerator unresponsive; falling back to CPU LeNet "
                   "bench", file=sys.stderr)
-            rec_dir = os.path.join(_repo_root(), "docs", "bench_records")
+            rec_dir = _records_dir()
             if os.path.isdir(rec_dir):
                 print("validated TPU captures for this build are archived "
-                      f"in {rec_dir} (latest headline: see "
-                      "r03_sync72_headline_*)", file=sys.stderr)
+                      f"in {rec_dir} (newest: latest_tpu_capture.json, "
+                      "also embedded in the JSON below as "
+                      "last_validated_tpu)", file=sys.stderr)
     # both headline variants run in WATCHDOGGED CHILDREN and this parent
     # never touches the backend: a tunnel that wedges AFTER a healthy
     # probe costs the child's timeout, never the round (observed live
@@ -649,6 +696,15 @@ def main():
     }
     if mfu is not None:
         out["mfu"] = round(mfu, 4)
+    if on_accel:
+        _save_validated_capture(out)
+    else:
+        # CPU fallback: carry the newest validated TPU capture inside the
+        # artifact so the round's JSON is never a bare CPU number
+        last = _load_last_validated()
+        if last is not None:
+            last["stale"] = True
+            out["last_validated_tpu"] = last
     # headline FIRST: if a driver kills the process mid-secondaries the
     # round's artifact is already on stdout
     print(json.dumps(out), flush=True)
