@@ -427,8 +427,8 @@ class BaseOptimizer(JavaValue):
     def set_checkpoint(self, checkpoint_trigger, checkpoint_path,
                        isOverWrite=True):
         # native signature is (path, trigger); isOverWrite is the native
-        # default behavior (checkpoints are versioned by iteration)
-        os.makedirs(checkpoint_path, exist_ok=True)
+        # default behavior (checkpoints are versioned by iteration), and
+        # save_checkpoint creates the (possibly remote-URI) dir itself
         self.value.set_checkpoint(checkpoint_path,
                                   getattr(checkpoint_trigger, "value",
                                           checkpoint_trigger))

@@ -140,10 +140,10 @@ class JTensor(object):
 
     def __reduce__(self):
         if self.indices is None:
-            return JTensor, (self.storage.tostring(), self.shape.tostring(),
+            return JTensor, (self.storage.tobytes(), self.shape.tobytes(),
                              self.bigdl_type)
-        return JTensor, (self.storage.tostring(), self.shape.tostring(),
-                         self.bigdl_type, self.indices.tostring())
+        return JTensor, (self.storage.tobytes(), self.shape.tobytes(),
+                         self.bigdl_type, self.indices.tobytes())
 
     def __str__(self):
         return (f"JTensor: storage: {self.storage}, shape: {self.shape}"

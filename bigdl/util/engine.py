@@ -25,9 +25,27 @@ def check_spark_source_conflict(spark_home, pyspark_path):
 
 
 def compare_version(version1, version2):
-    """Reference engine.py compare_version: 1 / -1 / 0."""
-    v1 = [int(x) for x in version1.split(".") if x.isdigit()]
-    v2 = [int(x) for x in version2.split(".") if x.isdigit()]
+    """Reference engine.py:128 compare_version: 1 / -1 / 0, zero-padding
+    to equal length so '2.4' == '2.4.0', with non-numeric leading chars
+    of a segment handled like the reference's int() of the digit prefix
+    ('1-SNAPSHOT' -> 1)."""
+
+    def parts(v):
+        out = []
+        for seg in v.split("."):
+            digits = ""
+            for ch in seg:
+                if ch.isdigit():
+                    digits += ch
+                else:
+                    break
+            out.append(int(digits) if digits else 0)
+        return out
+
+    v1, v2 = parts(version1), parts(version2)
+    n = max(len(v1), len(v2))
+    v1 += [0] * (n - len(v1))
+    v2 += [0] * (n - len(v2))
     return (v1 > v2) - (v1 < v2)
 
 

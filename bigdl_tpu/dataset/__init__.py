@@ -1,6 +1,7 @@
 from bigdl_tpu.dataset.sample import MiniBatch, PaddingParam, Sample
 from bigdl_tpu.dataset.dataset import DataSet, DistributedDataSet, LocalDataSet
-from bigdl_tpu.dataset.datasource import (DataSource, SparkDataFrameSource,
+from bigdl_tpu.dataset.datasource import (DataSource, RecordFileSource,
+                                          SparkDataFrameSource,
                                           SparkRDDSource, from_data_source)
 from bigdl_tpu.dataset.transformer import (SampleToMiniBatch, Transformer,
                                            chain)

@@ -107,16 +107,25 @@ class NativeTFRecordReader:
 
     def __init__(self, path: str, queue_capacity: int = 64):
         self.path = path
-        self._lib = _load()
         self._handle = None
         self._pyfile = None
+        from bigdl_tpu.utils import filesystem as fsys
+        if fsys.is_uri(path) and not str(path).startswith("file://"):
+            # remote store (hdfs://, s3://, gs://, memory://): the C++
+            # prefetcher only maps local files — stream through the
+            # scheme-dispatched Python framing path instead
+            self._lib = None
+            self._pyfile = fsys.open_file(path, "rb")
+            return
+        self._lib = _load()
         if self._lib is not None:
             self._handle = self._lib.bigdl_tfrecord_open(
-                path.encode(), queue_capacity)
+                str(path).replace("file://", "", 1).encode(),
+                queue_capacity)
             if not self._handle:
                 raise FileNotFoundError(path)
         else:
-            self._pyfile = open(path, "rb")
+            self._pyfile = fsys.open_file(path, "rb")
 
     def __iter__(self):
         return self

@@ -7,6 +7,11 @@ retry loop reloads the newest snapshot (getLatestFile:966). Here a
 checkpoint is a directory of .npz pytrees + a JSON manifest — all host-side
 numpy, so sharded device arrays are gathered once (the reference similarly
 gathers weight partitions in getModel:646).
+
+Paths may be URIs (file://, hdfs://, s3://, gs://, memory://): every IO
+goes through `bigdl_tpu.utils.filesystem`, matching the reference's
+hadoop-FS scheme resolution (DL/utils/File.scala, HdfsSpec.scala) —
+checkpointing to a remote store needs no code change, just the URI.
 """
 
 from __future__ import annotations
@@ -21,6 +26,8 @@ from typing import Any, Dict, Optional, Tuple
 import jax
 import numpy as np
 
+from bigdl_tpu.utils import filesystem as fsys
+
 
 def save_checkpoint(path: str, model, params, model_state, optim_method,
                     opt_slots=None, tag: str = "", overwrite: bool = True) -> str:
@@ -30,17 +37,17 @@ def save_checkpoint(path: str, model, params, model_state, optim_method,
     OptimMethod state Table, so resume must not reset moments. Returns the
     checkpoint dir."""
     name = tag or time.strftime("%Y%m%d_%H%M%S")
-    ckpt_dir = os.path.join(path, name)
-    if os.path.exists(ckpt_dir) and not overwrite:
+    ckpt_dir = fsys.join(path, name)
+    if fsys.exists(ckpt_dir) and not overwrite:
         raise FileExistsError(ckpt_dir)
-    os.makedirs(ckpt_dir, exist_ok=True)
+    fsys.makedirs(ckpt_dir, exist_ok=True)
 
     params_np = jax.tree_util.tree_map(np.asarray, jax.device_get(params))
-    with open(os.path.join(ckpt_dir, "params.pkl"), "wb") as f:
+    with fsys.open_file(fsys.join(ckpt_dir, "params.pkl"), "wb") as f:
         pickle.dump(params_np, f)
     state_np = {k: jax.tree_util.tree_map(np.asarray, v)
                 for k, v in (model_state or {}).items()}
-    with open(os.path.join(ckpt_dir, "state.pkl"), "wb") as f:
+    with fsys.open_file(fsys.join(ckpt_dir, "state.pkl"), "wb") as f:
         pickle.dump(state_np, f)
     optim_blob = {
         "class": type(optim_method).__name__,
@@ -50,7 +57,7 @@ def save_checkpoint(path: str, model, params, model_state, optim_method,
         "slots": (jax.tree_util.tree_map(np.asarray, jax.device_get(opt_slots))
                   if opt_slots is not None else None),
     }
-    with open(os.path.join(ckpt_dir, "optim.pkl"), "wb") as f:
+    with fsys.open_file(fsys.join(ckpt_dir, "optim.pkl"), "wb") as f:
         pickle.dump(optim_blob, f)
     manifest = {
         "format": "bigdl_tpu.checkpoint.v1",
@@ -58,33 +65,33 @@ def save_checkpoint(path: str, model, params, model_state, optim_method,
         "time": time.time(),
         "tag": name,
     }
-    with open(os.path.join(ckpt_dir, "manifest.json"), "w") as f:
+    with fsys.open_file(fsys.join(ckpt_dir, "manifest.json"), "w") as f:
         json.dump(manifest, f, indent=2)
     return ckpt_dir
 
 
 def latest_checkpoint(path: str) -> Optional[str]:
     """Newest checkpoint dir under path (reference getLatestFile:966)."""
-    if not os.path.isdir(path):
+    if not fsys.isdir(path):
         return None
     best, best_t = None, -1.0
-    for d in os.listdir(path):
-        mf = os.path.join(path, d, "manifest.json")
-        if os.path.exists(mf):
-            with open(mf) as f:
+    for d in fsys.listdir(path):
+        mf = fsys.join(path, d, "manifest.json")
+        if fsys.exists(mf):
+            with fsys.open_file(mf, "r") as f:
                 t = json.load(f).get("time", 0)
             if t > best_t:
-                best, best_t = os.path.join(path, d), t
+                best, best_t = fsys.join(path, d), t
     return best
 
 
 def load_checkpoint(ckpt_dir: str) -> Tuple[Any, Dict, Dict]:
     """Returns (params, model_state, optim_blob)."""
-    with open(os.path.join(ckpt_dir, "params.pkl"), "rb") as f:
+    with fsys.open_file(fsys.join(ckpt_dir, "params.pkl"), "rb") as f:
         params = pickle.load(f)
-    with open(os.path.join(ckpt_dir, "state.pkl"), "rb") as f:
+    with fsys.open_file(fsys.join(ckpt_dir, "state.pkl"), "rb") as f:
         model_state = pickle.load(f)
-    with open(os.path.join(ckpt_dir, "optim.pkl"), "rb") as f:
+    with fsys.open_file(fsys.join(ckpt_dir, "optim.pkl"), "rb") as f:
         optim_blob = pickle.load(f)
     return params, model_state, optim_blob
 

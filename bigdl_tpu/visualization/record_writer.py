@@ -34,7 +34,8 @@ class TFRecordFileWriter:
     """Standalone .tfrecord file writer (reference TFRecordWriter.scala)."""
 
     def __init__(self, path: str):
-        self._fh = open(path, "wb")
+        from bigdl_tpu.utils import filesystem as fsys
+        self._fh = fsys.open_file(path, "wb")
         self._writer = RecordWriter(self._fh)
 
     def write(self, record: bytes):
