@@ -96,6 +96,32 @@ class MoE(Module):
         h = jnp.maximum(tokens @ params["w1"][e] + params["b1"][e], 0.0)
         return h @ params["w2"][e] + params["b2"][e]
 
+    @staticmethod
+    def _dispatch_plan(experts, gates, E, cap):
+        """Capacity bookkeeping for one routing group, shared by the
+        expert-parallel dispatch and the dense capacity reference so both
+        drop EXACTLY the same units.
+
+        Units are the k-major flattening of (token, choice) pairs —
+        every token's first choice claims capacity before any second
+        choice (GShard dispatch priority). Returns (unit_expert [K*t],
+        unit_gate [K*t], pos_in_e [K*t] 0-based slot within the expert's
+        capacity buffer, keep [K*t])."""
+        unit_expert = experts.T.reshape(-1)
+        unit_gate = gates.T.reshape(-1)
+        onehot = jax.nn.one_hot(unit_expert, E, dtype=jnp.int32)
+        pos = jnp.cumsum(onehot, axis=0) * onehot            # 1-based
+        pos_in_e = jnp.sum(pos, axis=-1) - 1
+        keep = pos_in_e < cap
+        return unit_expert, unit_gate, pos_in_e, keep
+
+    def group_capacity(self, tokens_per_group: int) -> int:
+        """Per-expert capacity for one routing group (Switch §2.2:
+        tokens/experts * k * capacity_factor, per group)."""
+        return max(1, int(math.ceil(
+            tokens_per_group / self.E * self.top_k *
+            self.capacity_factor)))
+
     # -- dense single-device reference ----------------------------------
     def apply(self, params, input, ctx: ApplyContext):
         return self._dense(params, input)[0]
@@ -133,8 +159,57 @@ class MoE(Module):
                    "load_entropy": entropy,
                    "max_load": jnp.max(f)}
 
+    def dense_capacity_apply(self, params, x, n_groups: int = 1,
+                             return_mask: bool = False):
+        """Single-device reference WITH Switch capacity semantics.
+
+        Tokens split into `n_groups` routing groups matching the
+        per-device groups of `expert_parallel_apply` on an n_groups-wide
+        'expert' axis: same per-group capacity, same k-major dispatch
+        priority, same zero contribution for dropped units. This is the
+        oracle the EP path must match EXACTLY (kept units and outputs) at
+        ANY capacity_factor — unlike `apply`, which is capacity-free and
+        only matches when nothing drops.
+
+        Returns output, or (output, keep_mask [K, T]) with
+        `return_mask=True`.
+        """
+        shape = x.shape
+        x2d = x.reshape(-1, self.d)
+        T = x2d.shape[0]
+        if T % n_groups:
+            raise ValueError(f"token count {T} not divisible by "
+                             f"n_groups={n_groups}")
+        tg = T // n_groups
+        cap = self.group_capacity(tg)
+        E, K = self.E, self.top_k
+
+        def per_group(xl):
+            experts, gates, _ = self._gates(params, xl)
+            ue, ug, _, keep = MoE._dispatch_plan(experts, gates, E, cap)
+            unit_x = jnp.tile(xl, (K, 1))                     # [K*tg, d]
+            # per-unit expert FFN via gathered weights (reference-clear,
+            # memory-heavy — this is the oracle, not the fast path)
+            h = jnp.maximum(
+                jnp.einsum("td,tdh->th", unit_x, params["w1"][ue])
+                + params["b1"][ue], 0.0)
+            y_unit = jnp.einsum("th,thd->td", h, params["w2"][ue]) \
+                + params["b2"][ue]
+            y_unit = jnp.where(keep[:, None], ug[:, None] * y_unit, 0.0)
+            return jnp.sum(y_unit.reshape(K, tg, self.d), axis=0), \
+                keep.reshape(K, tg)
+
+        y, keep = jax.vmap(per_group)(x2d.reshape(n_groups, tg, self.d))
+        y = y.reshape(shape)
+        if return_mask:
+            # [n_groups, K, tg] -> [K, T] in token order
+            mask = jnp.moveaxis(keep, 1, 0).reshape(self.top_k, T)
+            return y, mask
+        return y
+
     # -- expert-parallel execution --------------------------------------
-    def expert_parallel_apply(self, mesh: Mesh, params, x):
+    def expert_parallel_apply(self, mesh: Mesh, params, x,
+                              return_mask: bool = False):
         """Run with experts sharded over mesh axis 'expert' (one or more
         experts per device; E divisible by the axis size). Tokens exchange
         with all_to_all; overflow beyond each expert's capacity drops to a
@@ -155,8 +230,7 @@ class MoE(Module):
                              f"'expert' axis size {n_dev}")
         # Switch/Mesh-TF capacity is PER GROUP (this device's tokens), so
         # buffers and all_to_all volume shrink as devices are added
-        cap = max(1, int(math.ceil(T / n_dev / E * K *
-                                   self.capacity_factor)))
+        cap = self.group_capacity(T // n_dev)
         moe = self
 
         def mapped(params_local, x_local):
@@ -165,17 +239,9 @@ class MoE(Module):
             t_local = x_local.shape[0]
             experts, gates, _ = moe._gates(
                 {"router": params_local["router"]}, x_local)
-            # flatten the k choices into routing units [t*K] (k-major so
-            # every token's first choice claims capacity before any
-            # second choice — matches GShard's dispatch priority)
-            unit_expert = experts.T.reshape(-1)         # [K*t]
-            unit_gate = gates.T.reshape(-1)             # [K*t]
+            unit_expert, unit_gate, pos_in_e, keep = MoE._dispatch_plan(
+                experts, gates, E, cap)
             unit_x = jnp.tile(x_local, (K, 1))          # [K*t, d]
-            # position of each unit within its expert's capacity buffer
-            onehot = jax.nn.one_hot(unit_expert, E, dtype=jnp.int32)
-            pos = jnp.cumsum(onehot, axis=0) * onehot            # 1-based
-            pos_in_e = jnp.sum(pos, axis=-1) - 1                 # [K*t]
-            keep = pos_in_e < cap
             # dispatch buffer [E, cap, d]
             disp = jnp.zeros((E, cap, moe.d), x_local.dtype)
             disp = disp.at[unit_expert,
@@ -199,7 +265,8 @@ class MoE(Module):
             y_unit = back[unit_expert, safe_pos]
             y_unit = jnp.where(keep[:, None], y_unit, 0.0)
             y_unit = unit_gate[:, None] * y_unit
-            return jnp.sum(y_unit.reshape(K, t_local, moe.d), axis=0)
+            return (jnp.sum(y_unit.reshape(K, t_local, moe.d), axis=0),
+                    keep.reshape(K, t_local))
 
         from bigdl_tpu.parallel.mesh import get_shard_map
         shard_map = get_shard_map()
@@ -211,5 +278,8 @@ class MoE(Module):
         mapped_fn = shard_map(
             mapped, mesh=mesh,
             in_specs=(param_specs, P("expert")),  # tokens split over axis
-            out_specs=P("expert"))
-        return mapped_fn(params, x2d).reshape(shape)
+            out_specs=(P("expert"), P(None, "expert")))
+        y, mask = mapped_fn(params, x2d)
+        if return_mask:
+            return y.reshape(shape), mask
+        return y.reshape(shape)
