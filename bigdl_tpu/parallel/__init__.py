@@ -4,5 +4,6 @@ from bigdl_tpu.parallel.sharding import (ShardingRules, infer_param_specs)
 from bigdl_tpu.parallel.sequence import (SequenceParallelAttention,
                                          make_sequence_parallel_attention,
                                          ring_attention, ulysses_attention)
-from bigdl_tpu.parallel.pipeline import GPipe
+from bigdl_tpu.parallel.pipeline import (GPipe, PipelineStages,
+                                         split_sequential)
 from bigdl_tpu.parallel.moe import MoE
