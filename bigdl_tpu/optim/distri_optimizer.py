@@ -187,16 +187,10 @@ class DistriOptimizer(BaseOptimizer):
                 logger.warning(
                     f"Optimization failed ({e!r}); retry {attempt}/"
                     f"{self.retry_times} from latest checkpoint")
-                from bigdl_tpu.serialization.checkpoint import (
-                    latest_checkpoint, load_checkpoint, restore_optim_method)
-                ck = latest_checkpoint(self.checkpoint_path)
-                if ck is not None:
-                    params, mstate, oblob = load_checkpoint(ck)
-                    self.model.set_params(params)
-                    self.model._state = mstate
-                    restore_optim_method(self.optim_method, oblob)
-                    # resume Adam moments / SGD velocity, not just counters
-                    self._resume_slots = oblob.get("slots")
+                # same loader as cold-start resume — handles both the
+                # pickle and the orbax-sharded checkpoint formats
+                if self.resume_from_latest_checkpoint():
+                    pass
                 elif self._pristine_params is not None:
                     # crashed before the first checkpoint: the jitted step
                     # DONATED the model's device arrays, so they are dead —
@@ -229,7 +223,8 @@ class DistriOptimizer(BaseOptimizer):
         num_hosts = getattr(self.dataset, "num_hosts", 1)
         epoch_size = getattr(self.dataset, "global_size", None) or \
             self.dataset.size() * num_hosts
-        data_iter = self.dataset.data(train=True)
+        data_iter = self._fast_forward_data(
+            self.dataset.data(train=True), driver_state)
         n_dev = int(np.prod(mesh.devices.shape))
 
         def fetch_and_place():

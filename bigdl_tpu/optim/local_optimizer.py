@@ -100,12 +100,90 @@ class BaseOptimizer:
 
     setEndWhen = set_end_when
 
-    def set_checkpoint(self, path: str, trigger: Trigger):
+    def set_checkpoint(self, path: str, trigger: Trigger,
+                       sharded: bool = False):
+        """`sharded=True` writes the array payload via orbax with every
+        process saving only its addressable shards (multi-host scale
+        path, serialization/sharded_checkpoint.py); default is the
+        host-side pickle format."""
         self.checkpoint_path = path
         self.checkpoint_trigger = trigger
+        self.checkpoint_sharded = sharded
         return self
 
     setCheckpoint = set_checkpoint
+
+    def resume_from_latest_checkpoint(self) -> bool:
+        """Cold-start resume: load the newest checkpoint under
+        `checkpoint_path` into the model/optim method before `optimize()`.
+
+        This is the reference's job-level recovery contract
+        (DL/optim/DistriOptimizer.scala:862-943 retries reload the newest
+        snapshot; a RESUBMITTED job with the same checkpoint dir does the
+        same through getLatestFile) at real process granularity: a fresh
+        process calls this after a crash/SIGKILL and continues the run —
+        params, optimizer slots (Adam moments / SGD velocity), epoch and
+        iteration counters, and the mid-epoch data position all resume.
+        Returns False when there is nothing to resume from."""
+        import json as _json
+
+        from bigdl_tpu.serialization.checkpoint import (latest_checkpoint,
+                                                        restore_optim_method)
+        from bigdl_tpu.utils import filesystem as fsys
+        if getattr(self, "checkpoint_path", None) is None:
+            return False
+        ck = latest_checkpoint(self.checkpoint_path)
+        if ck is None:
+            return False
+        with fsys.open_file(fsys.join(ck, "manifest.json"), "r") as f:
+            manifest = _json.load(f)
+        if manifest.get("sharded"):
+            from bigdl_tpu.serialization.sharded_checkpoint import (
+                load_checkpoint_sharded)
+            params, mstate, oblob = load_checkpoint_sharded(ck)
+        else:
+            from bigdl_tpu.serialization.checkpoint import load_checkpoint
+            params, mstate, oblob = load_checkpoint(ck)
+        self.model.set_params(params)
+        self.model._state = mstate or {}
+        restore_optim_method(self.optim_method, oblob)
+        if oblob.get("slots") is not None:
+            self._resume_slots = oblob["slots"]
+        return True
+
+    def _fast_forward_data(self, data_iter, driver_state):
+        """Replay the already-consumed data so a resumed run continues at
+        the position the checkpoint was taken at (reference
+        recordsProcessedThisEpoch semantics, DistriOptimizer.scala:130).
+
+        Completed epochs replay as full dataset passes with the same
+        `shuffle()` call the original run made at each boundary — the
+        iterator's per-pass permutations and the shuffles draw from the
+        SAME dataset-owned seeded rng, so a fresh process reproduces the
+        identical draw sequence. Then the current epoch's consumed
+        records are skipped. Exact within the current pass; a checkpoint
+        taken exactly at an epoch boundary can differ by the one
+        prefetched batch the original run drew before its shuffle."""
+        num_hosts = getattr(self.dataset, "num_hosts", 1)
+        epochs_done = max(0, driver_state.get("epoch", 1) - 1)
+        pass_items = self.dataset.size()
+        for _ in range(epochs_done):
+            seen = 0
+            while seen < pass_items:
+                b = next(data_iter, None)
+                if b is None:
+                    return data_iter
+                seen += 1
+            self.dataset.shuffle()
+        already = driver_state.get("recordsProcessedThisEpoch", 0) \
+            // max(num_hosts, 1)
+        skipped = 0
+        while skipped < already:
+            b = next(data_iter, None)
+            if b is None:
+                break
+            skipped += b.size()
+        return data_iter
 
     def set_validation(self, trigger: Trigger, dataset, methods: Sequence[ValidationMethod],
                        batch_size: Optional[int] = None):
@@ -258,6 +336,13 @@ class BaseOptimizer:
     def _save_checkpoint(self, params, model_state, tag, opt_slots=None):
         if self.checkpoint_path is None:
             return
+        if getattr(self, "checkpoint_sharded", False):
+            from bigdl_tpu.serialization.sharded_checkpoint import (
+                save_checkpoint_sharded)
+            save_checkpoint_sharded(self.checkpoint_path, self.model,
+                                    params, model_state, self.optim_method,
+                                    opt_slots=opt_slots, tag=tag)
+            return
         from bigdl_tpu.serialization.checkpoint import save_checkpoint
         save_checkpoint(self.checkpoint_path, self.model, params, model_state,
                         self.optim_method, opt_slots=opt_slots, tag=tag,
@@ -348,12 +433,20 @@ class LocalOptimizer(BaseOptimizer):
     def optimize(self) -> Module:
         params = self.model.ensure_params()
         model_state = self.model._state
-        opt_state = self.optim_method.init_state(params)
+        resume_slots = getattr(self, "_resume_slots", None)
+        if resume_slots is not None:
+            # checkpointed optimizer moments (Adam m/v, SGD velocity)
+            # from resume_from_latest_checkpoint
+            opt_state = jax.tree_util.tree_map(jnp.asarray, resume_slots)
+            self._resume_slots = None
+        else:
+            opt_state = self.optim_method.init_state(params)
         step = self._build_step()
         state = self.optim_method.state  # epoch/neval bookkeeping
         driver_state = state
         epoch_size = self.dataset.size()
-        data_iter = self.dataset.data(train=True)
+        data_iter = self._fast_forward_data(
+            self.dataset.data(train=True), driver_state)
 
         def fetch_and_place():
             """Next host batch + async device transfer; overlaps the

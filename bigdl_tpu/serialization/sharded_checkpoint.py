@@ -23,7 +23,9 @@ import jax
 def save_sharded(ckpt_dir: str, params) -> str:
     """Write a sharded pytree checkpoint (distributed-safe, atomic)."""
     import orbax.checkpoint as ocp
-    ckpt_dir = os.path.abspath(ckpt_dir)
+    from bigdl_tpu.utils import filesystem as fsys
+    if not fsys.is_uri(ckpt_dir):
+        ckpt_dir = os.path.abspath(ckpt_dir)
     with ocp.StandardCheckpointer() as ckptr:
         ckptr.save(ckpt_dir, params)
     return ckpt_dir
@@ -52,3 +54,78 @@ def restore_sharded(ckpt_dir: str, like, mesh=None, specs=None):
         target = jax.tree_util.tree_map(lambda l: abstract(l, None), like)
     with ocp.StandardCheckpointer() as ckptr:
         return ckptr.restore(ckpt_dir, target)
+
+
+def save_checkpoint_sharded(path: str, model, params, model_state,
+                            optim_method, opt_slots=None,
+                            tag: str = "") -> str:
+    """Optimizer-checkpoint variant of `checkpoint.py:save_checkpoint`
+    with the array payload written sharded via orbax: every process
+    participates in the collective save (each host writes only its
+    addressable shards); process 0 adds the host-side optim blob and the
+    manifest `checkpoint.py:latest_checkpoint` scans. Layout:
+
+        <path>/<tag>/arrays/   orbax pytree {params, slots?, mstate?}
+        <path>/<tag>/optim.pkl optim state/hyper (no slots - those are
+                               device arrays and live in arrays/)
+        <path>/<tag>/manifest.json  {..., "sharded": true}
+    """
+    import json
+    import pickle
+    import time
+
+    from bigdl_tpu.utils import filesystem as fsys
+
+    name = tag or time.strftime("%Y%m%d_%H%M%S")
+    # URI roots pass through untouched (orbax/tensorstore resolves gs://
+    # etc. natively); local paths are absolutized for orbax
+    root = path if fsys.is_uri(path) else os.path.abspath(path)
+    ckpt_dir = fsys.join(root, name)
+    arrays = {"params": params}
+    if opt_slots is not None:
+        arrays["slots"] = opt_slots
+    if model_state:
+        arrays["mstate"] = model_state
+    save_sharded(fsys.join(ckpt_dir, "arrays"), arrays)
+    if jax.process_index() == 0:
+        blob = {
+            "class": type(optim_method).__name__,
+            "state": dict(optim_method.state),
+            "hyper": {k: v for k, v in vars(optim_method).items()
+                      if isinstance(v, (int, float, bool, str))},
+        }
+        with fsys.open_file(fsys.join(ckpt_dir, "optim.pkl"), "wb") as f:
+            pickle.dump(blob, f)
+        manifest = {
+            "format": "bigdl_tpu.checkpoint.v1",
+            "model": getattr(model, "name", "model"),
+            "time": time.time(),
+            "tag": name,
+            "sharded": True,
+        }
+        with fsys.open_file(fsys.join(ckpt_dir, "manifest.json"),
+                            "w") as f:
+            json.dump(manifest, f, indent=2)
+    return ckpt_dir
+
+
+def load_checkpoint_sharded(ckpt_dir: str):
+    """Counterpart of `checkpoint.py:load_checkpoint` for sharded dirs.
+    Restores the orbax payload structure-as-saved (host arrays; the
+    optimizer re-places them on its mesh) and returns
+    (params, model_state, optim_blob) with slots folded into the blob
+    under "slots" — the same contract the pickle loader provides."""
+    import pickle
+
+    import orbax.checkpoint as ocp
+
+    from bigdl_tpu.utils import filesystem as fsys
+
+    if not fsys.is_uri(ckpt_dir):
+        ckpt_dir = os.path.abspath(ckpt_dir)
+    with ocp.StandardCheckpointer() as ckptr:
+        arrays = ckptr.restore(fsys.join(ckpt_dir, "arrays"))
+    with fsys.open_file(fsys.join(ckpt_dir, "optim.pkl"), "rb") as f:
+        blob = pickle.load(f)
+    blob["slots"] = arrays.get("slots")
+    return arrays["params"], arrays.get("mstate") or {}, blob
