@@ -110,7 +110,9 @@ from bigdl_tpu.nn.initialization import (BilinearFiller, ConstInitMethod,
 from bigdl_tpu.nn.quantized import (QuantizedLinear,
                                     QuantizedSpatialConvolution,
                                     QuantizedSpatialDilatedConvolution,
-                                    Quantizer)
+                                    Quantizer,
+                                    WeightOnlyQuantizedLinear,
+                                    WeightOnlyQuantizedSpatialConvolution)
 
 # name-parity aliases (reference DL/nn/RnnCell.scala is listed as "RNN" in
 # user docs; ClassSimplexCriterion export)

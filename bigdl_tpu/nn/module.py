@@ -186,11 +186,13 @@ class Module:
 
     __call__ = forward
 
-    def quantize(self) -> "Module":
+    def quantize(self, weight_only: bool = False) -> "Module":
         """Post-training int8 quantization of supported layers (reference
-        `AbstractModule.quantize` -> nn/quantized/Quantizer.scala)."""
+        `AbstractModule.quantize` -> nn/quantized/Quantizer.scala).
+        `weight_only=True` keeps bf16/f32 compute with int8-stored
+        weights — the TPU-favored serving mode."""
         from bigdl_tpu.nn.quantized import Quantizer
-        return Quantizer.quantize(self)
+        return Quantizer.quantize(self, weight_only=weight_only)
 
     def training(self):
         self.training_mode = True

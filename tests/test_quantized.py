@@ -146,3 +146,68 @@ def test_quantize_leaves_original_intact():
     # original still produces identical fp32 outputs
     np.testing.assert_array_equal(np.asarray(m.forward(x, training=False)),
                                   want)
+
+
+class TestWeightOnly:
+    """Weight-only int8 serving (VERDICT r3 #8): bf16/f32 compute with
+    int8-stored weights — tighter accuracy than full int8 (no activation
+    quantization error), same 4x weight size."""
+
+    def test_linear_tighter_than_full_int8(self):
+        rng = np.random.RandomState(3)
+        m = nn.Linear(64, 32)
+        x = jnp.asarray(rng.randn(8, 64), jnp.float32)
+        want = m.forward(x)
+        wo = nn.WeightOnlyQuantizedLinear.from_float(m, m.parameters())
+        full = QuantizedLinear.from_float(m, m.parameters())
+        err_wo = rel_err(wo.forward(x), want)
+        err_full = rel_err(full.forward(x), want)
+        assert err_wo < 0.01
+        assert err_wo <= err_full
+
+    def test_conv_close_to_fp32(self):
+        rng = np.random.RandomState(4)
+        m = nn.SpatialConvolution(8, 16, 3, 3, 1, 1, 1, 1)
+        x = jnp.asarray(rng.randn(2, 10, 10, 8), jnp.float32)
+        wo = nn.WeightOnlyQuantizedSpatialConvolution.from_float(
+            m, m.parameters())
+        assert rel_err(wo.forward(x), m.forward(x)) < 0.01
+
+    def test_compute_dtype_follows_input(self):
+        """bf16 serving: activations stay bf16 end to end; weights are
+        stored int8."""
+        m = nn.Linear(16, 8)
+        wo = nn.WeightOnlyQuantizedLinear.from_float(m, m.parameters())
+        assert wo.parameters()["weight"].dtype == jnp.int8
+        out = wo.forward(jnp.ones((2, 16), jnp.bfloat16))
+        assert out.dtype == jnp.bfloat16
+
+    def test_quantizer_weight_only_walk(self):
+        rng = np.random.RandomState(5)
+        m = nn.Sequential()
+        m.add(nn.SpatialConvolution(3, 8, 3, 3, 1, 1, 1, 1))
+        m.add(nn.ReLU())
+        m.add(nn.Reshape([8 * 6 * 6]))
+        m.add(nn.Linear(8 * 6 * 6, 10))
+        m.forward(jnp.zeros((1, 6, 6, 3)))  # init
+        q = Quantizer.quantize(m, weight_only=True)
+        kinds = [type(c).__name__ for c in q.children]
+        assert kinds[0] == "WeightOnlyQuantizedSpatialConvolution"
+        assert kinds[-1] == "WeightOnlyQuantizedLinear"
+        x = jnp.asarray(rng.randn(2, 6, 6, 3), jnp.float32)
+        assert rel_err(q.forward(x), m.forward(x)) < 0.01
+
+    def test_module_quantize_kwarg(self):
+        m = nn.Linear(8, 4)
+        m.ensure_params()
+        q = m.quantize(weight_only=True)
+        assert type(q).__name__ == "WeightOnlyQuantizedLinear"
+        # original is untouched and still full precision
+        assert type(m).__name__ == "Linear"
+
+    def test_weight_bytes_4x_smaller(self):
+        m = nn.Linear(256, 256)
+        wo = nn.WeightOnlyQuantizedLinear.from_float(m, m.parameters())
+        fp32_bytes = np.asarray(m.parameters()["weight"]).nbytes
+        int8_bytes = np.asarray(wo.parameters()["weight"]).nbytes
+        assert fp32_bytes == 4 * int8_bytes

@@ -563,6 +563,10 @@ SPECS["QuantizedSpatialConvolution"] = (
     lambda: nn.QuantizedSpatialConvolution(3, 4, 3, 3), IMG)
 SPECS["QuantizedSpatialDilatedConvolution"] = (
     lambda: nn.QuantizedSpatialDilatedConvolution(3, 4, 3, 3), IMG)
+SPECS["WeightOnlyQuantizedLinear"] = (
+    lambda: nn.WeightOnlyQuantizedLinear(4, 3), MAT)
+SPECS["WeightOnlyQuantizedSpatialConvolution"] = (
+    lambda: nn.WeightOnlyQuantizedSpatialConvolution(3, 4, 3, 3), IMG)
 
 # ------------------------------------------------------------- skip list
 # name -> justification. Only infrastructure that is not itself a
