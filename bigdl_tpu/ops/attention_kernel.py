@@ -28,6 +28,10 @@ from jax import lax
 
 NEG_INF = -1e30
 
+# test hook: run the Pallas kernels in interpreter mode (CPU) when True —
+# lets the full custom_vjp fwd+bwd path run off-TPU in the suite
+INTERPRET = False
+
 
 def _ceil_to(x: int, m: int) -> int:
     return (x + m - 1) // m * m
@@ -155,11 +159,11 @@ def blockwise_attention(q, k, v, causal: bool = False,
 # Pallas flash forward (TPU fast path)
 # --------------------------------------------------------------------------- #
 
-def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int,
+def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k: int,
                       sm_scale: float, causal: bool, seq_k: int):
-    """One program = one (batch*head, q-block). K/V blocks stream via the
-    grid's last dimension? No — streamed with fori_loop over VMEM-resident
-    refs sliced dynamically."""
+    """One program = one (batch*head, q-block); K/V streamed with
+    fori_loop over VMEM-resident refs sliced dynamically. Also emits the
+    per-row logsumexp the backward kernels reconstruct softmax from."""
     from jax.experimental import pallas as pl
 
     q = q_ref[0].astype(jnp.float32)          # [block_q, d]
@@ -203,17 +207,24 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int,
         acc, m, l = jax.lax.fori_loop(0, n_kb, body, (acc, m, l))
     den = jnp.where(l == 0.0, 1.0, l)
     o_ref[0] = (acc / den[:, None]).astype(o_ref.dtype)
+    # logsumexp per row; fully-masked rows get shift=0, den=1 -> lse=0,
+    # and the backward's exp(NEG_INF - 0) correctly vanishes
+    shift = jnp.where(m <= NEG_INF / 2, 0.0, m)
+    lse_ref[0] = shift + jnp.log(den)
 
 
 def flash_attention_forward(q, k, v, causal: bool = False,
                             sm_scale: Optional[float] = None,
                             block_q: int = 256, block_k: int = 512,
-                            interpret: bool = False):
+                            interpret: Optional[bool] = None,
+                            return_lse: bool = False):
     """Pallas flash-attention forward. q,k,v: [B,H,T,D]; T must be padded to
-    the block sizes by the caller (`flash_attention` handles it)."""
+    the block sizes by the caller (`flash_attention` handles it).
+    `return_lse=True` also returns the [B,H,T] logsumexp (backward input)."""
     from jax.experimental import pallas as pl
-    from jax.experimental.pallas import tpu as pltpu
 
+    if interpret is None:
+        interpret = INTERPRET
     b, h, tq, d = q.shape
     tk = k.shape[2]
     sm_scale = sm_scale or d ** -0.5
@@ -227,7 +238,7 @@ def flash_attention_forward(q, k, v, causal: bool = False,
 
     kernel = functools.partial(_flash_fwd_kernel, block_k=block_k,
                                sm_scale=sm_scale, causal=causal, seq_k=tk)
-    out = pl.pallas_call(
+    out, lse = pl.pallas_call(
         kernel,
         grid=(bh, tq // block_q),
         in_specs=[
@@ -235,30 +246,203 @@ def flash_attention_forward(q, k, v, causal: bool = False,
             pl.BlockSpec((1, tk, d), lambda i, j: (i, 0, 0)),
             pl.BlockSpec((1, tk, d), lambda i, j: (i, 0, 0)),
         ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((1, block_q), lambda i, j: (i, j)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, tq, d), q.dtype),
+            jax.ShapeDtypeStruct((bh, tq), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qr, kr, vr)
+    out = out.reshape(b, h, tq, d)
+    if return_lse:
+        return out, lse.reshape(b, h, tq)
+    return out
+
+
+# --------------------------------------------------------------------------- #
+# Pallas flash backward (TPU fast path for training)
+# --------------------------------------------------------------------------- #
+
+def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                         dq_ref, *, block_k: int, sm_scale: float,
+                         causal: bool, seq_k: int):
+    """dq for one (batch*head, q-block): stream K/V blocks, rebuild the
+    softmax rows from the saved logsumexp (no [T,T] materialization), and
+    accumulate dq = sum_k (p * (dO V^T - delta)) K * scale."""
+    from jax.experimental import pallas as pl
+
+    q = q_ref[0].astype(jnp.float32)            # [bq, d]
+    do = do_ref[0].astype(jnp.float32)          # [bq, d]
+    lse = lse_ref[0].astype(jnp.float32)        # [bq]
+    delta = delta_ref[0].astype(jnp.float32)    # [bq]
+    block_q, d = q.shape
+    q_off = pl.program_id(1) * block_q
+    n_kb = seq_k // block_k
+
+    def body(ib, dq):
+        k_blk = k_ref[0, pl.ds(ib * block_k, block_k), :].astype(jnp.float32)
+        v_blk = v_ref[0, pl.ds(ib * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k_blk, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        s = s * sm_scale
+        if causal:
+            gq = jax.lax.broadcasted_iota(jnp.int32, s.shape, 0) + q_off
+            gk = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1) \
+                + ib * block_k
+            s = jnp.where(gq >= gk, s, NEG_INF)
+        p = jnp.exp(s - lse[:, None])           # [bq, bk]
+        dp = jax.lax.dot_general(do, v_blk, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[:, None]) * sm_scale
+        return dq + jax.lax.dot_general(ds, k_blk, (((1,), (0,)), ((), ())),
+                                        preferred_element_type=jnp.float32)
+
+    dq = jnp.zeros((block_q, d), jnp.float32)
+    if causal:
+        n_needed = jnp.minimum(n_kb, (q_off + block_q + block_k - 1)
+                               // block_k)
+        dq = jax.lax.fori_loop(0, n_needed, body, dq)
+    else:
+        dq = jax.lax.fori_loop(0, n_kb, body, dq)
+    dq_ref[0] = dq.astype(dq_ref.dtype)
+
+
+def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                          dk_ref, dv_ref, *, block_q: int, sm_scale: float,
+                          causal: bool, seq_q: int):
+    """dk and dv for one (batch*head, k-block): stream Q/dO blocks.
+    dv = sum_q p^T dO;   dk = sum_q (p * (dO V^T - delta))^T Q * scale."""
+    from jax.experimental import pallas as pl
+
+    k_blk = k_ref[0].astype(jnp.float32)        # [bk, d]
+    v_blk = v_ref[0].astype(jnp.float32)        # [bk, d]
+    block_k, d = k_blk.shape
+    k_off = pl.program_id(1) * block_k
+    n_qb = seq_q // block_q
+
+    def body(ib, carry):
+        dk, dv = carry
+        q = q_ref[0, pl.ds(ib * block_q, block_q), :].astype(jnp.float32)
+        do = do_ref[0, pl.ds(ib * block_q, block_q), :].astype(jnp.float32)
+        lse = lse_ref[0, pl.ds(ib * block_q, block_q)].astype(jnp.float32)
+        delta = delta_ref[0, pl.ds(ib * block_q, block_q)].astype(
+            jnp.float32)
+        s = jax.lax.dot_general(q, k_blk, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        s = s * sm_scale                        # [bq, bk]
+        if causal:
+            gq = jax.lax.broadcasted_iota(jnp.int32, s.shape, 0) \
+                + ib * block_q
+            gk = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1) + k_off
+            s = jnp.where(gq >= gk, s, NEG_INF)
+        p = jnp.exp(s - lse[:, None])           # [bq, bk]
+        dv = dv + jax.lax.dot_general(p, do, (((0,), (0,)), ((), ())),
+                                      preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(do, v_blk, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[:, None]) * sm_scale
+        dk = dk + jax.lax.dot_general(ds, q, (((0,), (0,)), ((), ())),
+                                      preferred_element_type=jnp.float32)
+        return dk, dv
+
+    dk = jnp.zeros((block_k, d), jnp.float32)
+    dv = jnp.zeros((block_k, d), jnp.float32)
+    if causal:
+        # only q-blocks whose END reaches past this k-block participate
+        start = k_off // block_q
+        dk, dv = jax.lax.fori_loop(start, n_qb, body, (dk, dv))
+    else:
+        dk, dv = jax.lax.fori_loop(0, n_qb, body, (dk, dv))
+    dk_ref[0] = dk.astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
+def flash_attention_backward(q, k, v, out, lse, g, causal: bool = False,
+                             sm_scale: Optional[float] = None,
+                             block_q: int = 256, block_k: int = 512,
+                             interpret: Optional[bool] = None):
+    """Pallas flash-attention backward: (dq, dk, dv) from the saved
+    forward logsumexp — two kernels (dq over q-blocks; dk/dv over
+    k-blocks), each rebuilding its softmax tile on the fly, so the
+    training path never materializes [T, T] either."""
+    from jax.experimental import pallas as pl
+
+    if interpret is None:
+        interpret = INTERPRET
+    b, h, tq, d = q.shape
+    tk = k.shape[2]
+    sm_scale = sm_scale or d ** -0.5
+    block_q = min(block_q, tq)
+    block_k = min(block_k, tk)
+    assert tq % block_q == 0 and tk % block_k == 0
+    bh = b * h
+    qr, kr, vr = (x.reshape(bh, -1, d) for x in (q, k, v))
+    dor = g.reshape(bh, tq, d)
+    lser = lse.reshape(bh, tq)
+    # delta_i = rowsum(dO * O): tiny elementwise reduce, XLA fuses it
+    delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32),
+                    axis=-1).reshape(bh, tq)
+
+    dq_kernel = functools.partial(_flash_bwd_dq_kernel, block_k=block_k,
+                                  sm_scale=sm_scale, causal=causal,
+                                  seq_k=tk)
+    dq = pl.pallas_call(
+        dq_kernel,
+        grid=(bh, tq // block_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((1, tk, d), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((1, tk, d), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((1, block_q), lambda i, j: (i, j)),
+            pl.BlockSpec((1, block_q), lambda i, j: (i, j)),
+        ],
         out_specs=pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
         out_shape=jax.ShapeDtypeStruct((bh, tq, d), q.dtype),
         interpret=interpret,
-    )(qr, kr, vr)
-    return out.reshape(b, h, tq, d)
+    )(qr, kr, vr, dor, lser, delta)
+
+    dkv_kernel = functools.partial(_flash_bwd_dkv_kernel, block_q=block_q,
+                                   sm_scale=sm_scale, causal=causal,
+                                   seq_q=tq)
+    dk, dv = pl.pallas_call(
+        dkv_kernel,
+        grid=(bh, tk // block_k),
+        in_specs=[
+            pl.BlockSpec((1, tq, d), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((1, block_k, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((1, tq, d), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((1, tq), lambda i, j: (i, 0)),
+            pl.BlockSpec((1, tq), lambda i, j: (i, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_k, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda i, j: (i, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, tk, d), k.dtype),
+            jax.ShapeDtypeStruct((bh, tk, d), v.dtype),
+        ],
+        interpret=interpret,
+    )(qr, kr, vr, dor, lser, delta)
+    return (dq.reshape(b, h, tq, d), dk.reshape(b, h, tk, d),
+            dv.reshape(b, h, tk, d))
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
-def flash_attention(q, k, v, causal: bool = False,
-                    sm_scale: Optional[float] = None,
-                    use_pallas: Optional[bool] = None):
-    """Flash attention: Pallas forward on TPU, blockwise-XLA backward.
-
-    `use_pallas=None` auto-detects (TPU backend -> pallas kernel)."""
-    return _flash_impl(q, k, v, causal, sm_scale, use_pallas)
-
-
-def _flash_impl(q, k, v, causal, sm_scale, use_pallas):
+def _flash_plan(q_shape, k_shape, causal, use_pallas):
+    """Static routing shared by forward and backward: (pallas?, bq, bk,
+    pad_q, pad_k). Deterministic in shapes + static args, so the vjp
+    rules recompute it instead of smuggling Python values through
+    residuals."""
     if use_pallas is None:
-        use_pallas = jax.default_backend() == "tpu"
+        use_pallas = jax.default_backend() == "tpu" or INTERPRET
+    t, tk = q_shape[2], k_shape[2]
     if not use_pallas:
-        return blockwise_attention(q, k, v, causal=causal, sm_scale=sm_scale)
-    b, h, t, d = q.shape
-    tk = k.shape[2]
+        return False, 0, 0, 0, 0
     # block_k 1024: +7% at 16k tokens vs 512 on v5e (neutral at 8k),
     # measured 2026-07-31 block sweep (docs/bench_records). Prefer it only
     # when it divides tk — padding would push non-causal odd-multiple-of-512
@@ -270,30 +454,68 @@ def _flash_impl(q, k, v, causal, sm_scale, use_pallas):
     else:
         bk = min(512, _ceil_to(tk, 8))
     pq, pk = _ceil_to(t, bq) - t, _ceil_to(tk, bk) - tk
-    qp = jnp.pad(q, ((0, 0), (0, 0), (0, pq), (0, 0))) if pq else q
-    kp = jnp.pad(k, ((0, 0), (0, 0), (0, pk), (0, 0))) if pk else k
-    vp = jnp.pad(v, ((0, 0), (0, 0), (0, pk), (0, 0))) if pk else v
     if pk and (not causal or t > tk):
         # padded keys must never receive weight; the causal mask only hides
         # them when every query position is < tk (self-attention). Otherwise
         # fall back to the XLA path, which masks the ragged tail exactly.
+        return False, 0, 0, 0, 0
+    return True, bq, bk, pq, pk
+
+
+def _pad_t(x, pad):
+    return jnp.pad(x, ((0, 0), (0, 0), (0, pad), (0, 0))) if pad else x
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def flash_attention(q, k, v, causal: bool = False,
+                    sm_scale: Optional[float] = None,
+                    use_pallas: Optional[bool] = None):
+    """Flash attention: Pallas forward AND backward on TPU (blockwise-XLA
+    path elsewhere). `use_pallas=None` auto-detects the backend."""
+    return _flash_impl(q, k, v, causal, sm_scale, use_pallas)
+
+
+def _flash_impl(q, k, v, causal, sm_scale, use_pallas):
+    pallas, bq, bk, pq, pk = _flash_plan(q.shape, k.shape, causal,
+                                         use_pallas)
+    if not pallas:
         return blockwise_attention(q, k, v, causal=causal, sm_scale=sm_scale)
-    out = flash_attention_forward(qp, kp, vp, causal=causal,
+    t = q.shape[2]
+    out = flash_attention_forward(_pad_t(q, pq), _pad_t(k, pk),
+                                  _pad_t(v, pk), causal=causal,
                                   sm_scale=sm_scale, block_q=bq, block_k=bk)
     return out[:, :, :t]
 
 
 def _flash_fwd_rule(q, k, v, causal, sm_scale, use_pallas):
-    out = _flash_impl(q, k, v, causal, sm_scale, use_pallas)
-    return out, (q, k, v)
+    pallas, bq, bk, pq, pk = _flash_plan(q.shape, k.shape, causal,
+                                         use_pallas)
+    if not pallas:
+        out = blockwise_attention(q, k, v, causal=causal, sm_scale=sm_scale)
+        return out, (q, k, v, None, None)
+    t = q.shape[2]
+    out_p, lse = flash_attention_forward(
+        _pad_t(q, pq), _pad_t(k, pk), _pad_t(v, pk), causal=causal,
+        sm_scale=sm_scale, block_q=bq, block_k=bk, return_lse=True)
+    return out_p[:, :, :t], (q, k, v, out_p, lse)
 
 
 def _flash_bwd_rule(causal, sm_scale, use_pallas, res, g):
-    q, k, v = res
-    _, vjp = jax.vjp(
-        lambda q_, k_, v_: blockwise_attention(q_, k_, v_, causal=causal,
-                                               sm_scale=sm_scale), q, k, v)
-    return vjp(g)
+    q, k, v, out_p, lse = res
+    pallas, bq, bk, pq, pk = _flash_plan(q.shape, k.shape, causal,
+                                         use_pallas)
+    if not pallas or lse is None:
+        _, vjp = jax.vjp(
+            lambda q_, k_, v_: blockwise_attention(q_, k_, v_, causal=causal,
+                                                   sm_scale=sm_scale),
+            q, k, v)
+        return vjp(g)
+    t, tk = q.shape[2], k.shape[2]
+    dq, dk, dv = flash_attention_backward(
+        _pad_t(q, pq), _pad_t(k, pk), _pad_t(v, pk), out_p, lse,
+        _pad_t(g, pq), causal=causal, sm_scale=sm_scale,
+        block_q=bq, block_k=bk)
+    return dq[:, :, :t], dk[:, :, :tk], dv[:, :, :tk]
 
 
 flash_attention.defvjp(_flash_fwd_rule, _flash_bwd_rule)

@@ -229,10 +229,35 @@ def bench_attention():
               f"({fl / dt / 1e12:.1f} TFLOP/s fwd)", file=sys.stderr)
         return dt
 
+    def timed_bwd(qkv, t_len, n=10):
+        """Fwd+bwd step time through the custom_vjp (Pallas both ways on
+        TPU) — the training-path figure the r3 verdict asked for."""
+        # all three cotangents, or XLA dead-code-eliminates the dk/dv
+        # kernel and the 7-matmul FLOP count below over-reports
+        f = jax.jit(jax.grad(
+            lambda q, k, v: jnp.sum(
+                flash_attention(q, k, v, True).astype(jnp.float32)),
+            argnums=(0, 1, 2)))
+
+        def drain(gs):
+            return float(sum(jnp.sum(g.astype(jnp.float32)) for g in gs))
+
+        drain(f(*qkv))  # compile + drain
+        t0 = time.perf_counter()
+        for _ in range(n):
+            gs = f(*qkv)
+        drain(gs)
+        dt = (time.perf_counter() - t0) / n
+        # fwd 2 matmuls + bwd 5 matmuls of the same shape, half masked
+        fl = 7 * B * H * t_len * t_len * D * 2 / 2
+        print(f"attention fwd+bwd T={t_len}: {dt * 1e3:.1f} ms "
+              f"({fl / dt / 1e12:.1f} TFLOP/s)", file=sys.stderr)
+
     for t_len in (8192, 16384):
         qkv = [jax.random.normal(k, (B, H, t_len, D), jnp.bfloat16)
                for k in jax.random.split(key, 3)]
         ft = timed(flash_attention, qkv, "flash(pallas)", t_len)
+        timed_bwd(qkv, t_len)
         # naive materializes the [T, T] score matrix — 0.5-2 GiB in bf16
         # at these lengths; keep it to 8k so the comparison fits HBM
         if t_len <= 8192:
@@ -273,6 +298,66 @@ def bench_attention():
     dt = float(np.median(np.diff(times[1:]))) / 12
     print(f"transformer-LM train (T={seq}, 512d x 4L, flash): "
           f"{bs * seq / dt:.0f} tokens/sec", file=sys.stderr)
+
+
+def bench_int8_serving():
+    """Serving A/B (stderr): ResNet-50 inference throughput, bf16 vs
+    weight-only int8 vs full int8, plus weight bytes — answers the
+    whitepaper's 2x-int8-serving claim (docs/docs/whitepaper.md:192-196)
+    with the TPU-honest result: compute stays bf16 (the r03 capture
+    showed full int8 losing on convs); the int8 win is 4x weight
+    memory/bandwidth, taken by the weight-only path."""
+    import jax
+    import jax.numpy as jnp
+
+    from bigdl_tpu.models.resnet import ResNet
+    from bigdl_tpu.nn.quantized import Quantizer
+
+    rs = np.random.RandomState(0)
+    if os.environ.get("BIGDL_TPU_SERVING_MODEL", "resnet50") == "lenet":
+        # CPU smoke-test scale (full-int8 R50 convs compile for minutes
+        # on the CPU backend); same code path, tiny model
+        from bigdl_tpu.models.lenet import LeNet5
+        model = LeNet5(10)
+        in_shape = (28, 28)
+    else:
+        model = ResNet(class_num=1000, depth=50)
+        in_shape = (224, 224, 3)
+    model.ensure_params()
+    variants = {
+        "bf16": model,
+        "weight-only int8": Quantizer.quantize(model, weight_only=True),
+        "full int8": Quantizer.quantize(model),
+    }
+    bs = int(_env_num("BIGDL_TPU_SERVING_BATCH", int, 256))
+    x = jnp.asarray(rs.rand(bs, *in_shape), jnp.bfloat16)
+
+    for name, m in variants.items():
+        m.evaluate()
+        params = jax.tree_util.tree_map(
+            lambda l: l if l.dtype == jnp.int8 or
+            not jnp.issubdtype(l.dtype, jnp.floating)
+            else l.astype(jnp.bfloat16) if name != "full int8" else l,
+            m.ensure_params())
+        from bigdl_tpu.nn.module import functional_apply
+
+        @jax.jit
+        def fwd(p, xx):
+            out, _ = functional_apply(m, p, xx, training=False)
+            return jnp.sum(out.astype(jnp.float32))
+
+        float(fwd(params, x))   # compile + drain
+        n = 10
+        t0 = time.perf_counter()
+        for _ in range(n):
+            s = fwd(params, x)
+        float(s)                # scalar fetch = completion barrier
+        dt = (time.perf_counter() - t0) / n
+        wbytes = sum(np.asarray(l).nbytes for l in
+                     jax.tree_util.tree_leaves(params)
+                     if hasattr(l, "nbytes"))
+        print(f"serving {name}: {bs / dt:.1f} imgs/sec (b{bs}), "
+              f"params {wbytes / 1e6:.2f} MB", file=sys.stderr)
 
 
 def bench_baseline_configs():
@@ -559,6 +644,8 @@ def _secondary_main(name: str):
         bench_attention()
     elif name == "configs":
         bench_baseline_configs()
+    elif name == "int8_serving":
+        bench_int8_serving()
     elif name == "host_pipeline":
         # secondary figure: fresh host batches + H2D every step
         import jax
@@ -720,6 +807,7 @@ def main():
         _run_secondary("host_pipeline", sec_budget)
         _run_secondary("attention", sec_budget)
         _run_secondary("configs", sec_budget)
+        _run_secondary("int8_serving", sec_budget)
 
 
 if __name__ == "__main__":

@@ -90,6 +90,91 @@ class TestPallasFlash:
         np.testing.assert_allclose(g, gr, atol=1e-4)
 
 
+class TestPallasFlashBackward:
+    """The Pallas backward kernels (VERDICT r3 #2): dq/dk/dv from the
+    saved forward logsumexp must match autodiff of the naive reference —
+    the training path no longer leaves Pallas."""
+
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_bwd_kernels_match_naive_vjp(self, causal):
+        rs = np.random.RandomState(0)
+        B, H, T, D = 1, 2, 256, 32
+        q, k, v = (jnp.asarray(rs.randn(B, H, T, D), jnp.float32) * 0.3
+                   for _ in range(3))
+        g = jnp.asarray(rs.randn(B, H, T, D), jnp.float32)
+        from bigdl_tpu.ops.attention_kernel import flash_attention_backward
+        out, lse = flash_attention_forward(q, k, v, causal=causal,
+                                           block_q=64, block_k=64,
+                                           interpret=True, return_lse=True)
+        dq, dk, dv = flash_attention_backward(q, k, v, out, lse, g,
+                                              causal=causal, block_q=64,
+                                              block_k=64, interpret=True)
+        _, vjp = jax.vjp(lambda a, b, c: naive_attention(a, b, c,
+                                                         causal=causal),
+                         q, k, v)
+        for got, want, name in zip((dq, dk, dv), vjp(g),
+                                   ("dq", "dk", "dv")):
+            np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                       rtol=2e-4, atol=2e-4, err_msg=name)
+
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_custom_vjp_pallas_path(self, causal, monkeypatch):
+        """grad through the public flash_attention with the Pallas path
+        forced (interpret mode): the full fwd(lse)+bwd pipeline."""
+        from bigdl_tpu.ops import attention_kernel as ak
+        monkeypatch.setattr(ak, "INTERPRET", True)
+        rs = np.random.RandomState(1)
+        q, k, v = (jnp.asarray(rs.randn(1, 2, 512, 32), jnp.float32) * 0.3
+                   for _ in range(3))
+
+        def loss(q_, k_, v_):
+            return jnp.sum(ak.flash_attention(q_, k_, v_, causal) ** 2)
+
+        gq, gk, gv = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+        rq, rk, rv = jax.grad(
+            lambda a, b, c: jnp.sum(naive_attention(a, b, c,
+                                                    causal=causal) ** 2),
+            argnums=(0, 1, 2))(q, k, v)
+        for got, want, name in zip((gq, gk, gv), (rq, rk, rv),
+                                   ("dq", "dk", "dv")):
+            np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                       rtol=3e-4, atol=3e-4, err_msg=name)
+
+    def test_torch_sdpa_golden_fwd_bwd(self):
+        """Cross-library oracle: torch scaled_dot_product_attention
+        forward AND input gradients."""
+        torch = pytest.importorskip("torch")
+        rs = np.random.RandomState(2)
+        B, H, T, D = 1, 2, 128, 16
+        qn, kn, vn = (rs.randn(B, H, T, D).astype(np.float32) * 0.4
+                      for _ in range(3))
+        gn = rs.randn(B, H, T, D).astype(np.float32)
+
+        qt, kt, vt = (torch.tensor(x, requires_grad=True)
+                      for x in (qn, kn, vn))
+        ot = torch.nn.functional.scaled_dot_product_attention(
+            qt, kt, vt, is_causal=True)
+        ot.backward(torch.tensor(gn))
+
+        from bigdl_tpu.ops.attention_kernel import flash_attention_backward
+        q, k, v = (jnp.asarray(x) for x in (qn, kn, vn))
+        out, lse = flash_attention_forward(q, k, v, causal=True,
+                                           block_q=32, block_k=32,
+                                           interpret=True, return_lse=True)
+        np.testing.assert_allclose(np.asarray(out), ot.detach().numpy(),
+                                   rtol=2e-4, atol=2e-4)
+        dq, dk, dv = flash_attention_backward(q, k, v, out, lse,
+                                              jnp.asarray(gn), causal=True,
+                                              block_q=32, block_k=32,
+                                              interpret=True)
+        np.testing.assert_allclose(np.asarray(dq), qt.grad.numpy(),
+                                   rtol=3e-4, atol=3e-4)
+        np.testing.assert_allclose(np.asarray(dk), kt.grad.numpy(),
+                                   rtol=3e-4, atol=3e-4)
+        np.testing.assert_allclose(np.asarray(dv), vt.grad.numpy(),
+                                   rtol=3e-4, atol=3e-4)
+
+
 class TestLayers:
     def test_mha_self_attention_shapes_and_grad(self):
         m = nn.MultiHeadAttention(32, 4, causal=True)
