@@ -150,6 +150,7 @@ class SpaceToDepthStemConvolution(SpatialConvolution):
                  kernel: int = 7, with_bias: bool = False,
                  weight_init: Optional[InitializationMethod] = None,
                  bias_init: Optional[InitializationMethod] = None,
+                 pallas_stem: Optional[bool] = None,
                  name: Optional[str] = None, dtype=jnp.float32):
         if kernel % 4 != 3:
             raise ValueError(
@@ -158,6 +159,9 @@ class SpaceToDepthStemConvolution(SpatialConvolution):
                          2, 2, pad_w=(kernel - 1) // 2, pad_h=(kernel - 1) // 2,
                          with_bias=with_bias, weight_init=weight_init,
                          bias_init=bias_init, name=name, dtype=dtype)
+        # None = auto (Pallas fused stem on TPU); False forces the XLA
+        # conv restatement; True forces the kernel (tests/interpret)
+        self.pallas_stem = pallas_stem
 
     def apply(self, params, input, ctx):
         x = input
@@ -179,6 +183,24 @@ class SpaceToDepthStemConvolution(SpatialConvolution):
         wk = jnp.pad(params["weight"], ((1, 0), (1, 0), (0, 0), (0, 0)))
         wk = wk.reshape(kt, 2, kt, 2, c, o).transpose(0, 2, 1, 3, 4, 5)
         wk = wk.reshape(kt, kt, 4 * c, o)
+        use_pallas = self.pallas_stem
+        if use_pallas is None:
+            # auto: opt in via env until a live A/B on the real chip
+            # validates the kernel beating the XLA restatement
+            # (scripts/ab_stem.py); tests force it through INTERPRET
+            import os as _os
+            from bigdl_tpu.ops import stem_kernel as _sk
+            use_pallas = _sk.INTERPRET or (
+                jax.default_backend() == "tpu"
+                and _os.environ.get("BIGDL_TPU_PALLAS_STEM", "").lower()
+                in ("1", "true", "yes"))
+        if use_pallas:
+            # Pallas fused stem: on-the-fly im2col in VMEM + one deep
+            # GEMM per row tile; XLA-conv gradients (ops/stem_kernel.py)
+            from bigdl_tpu.ops.stem_kernel import stem_conv
+            return stem_conv(x2, wk,
+                             params["bias"] if self.with_bias else None,
+                             front, rear)
         y = lax.conv_general_dilated(
             x2, wk, window_strides=(1, 1),
             padding=((front, rear), (front, rear)),

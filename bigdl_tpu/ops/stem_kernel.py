@@ -1,0 +1,145 @@
+"""Pallas kernel for the space-to-depth ResNet stem convolution.
+
+The round-3 perf work (docs/PERF.md) identified the stem as the last
+memory-bound MXU-hostile stage: after the 2x2 space-to-depth restatement
+(nn/conv.py SpaceToDepthStemConvolution) the op is a stride-1 kt x kt
+conv over C2 = 4*C_in channels — for ResNet-50, 4x4 over 12 channels at
+112x112 — whose reduction depth (12) starves the 128-lane MXU when
+expressed as a plain conv.
+
+This kernel restates it once more, as an im2col GEMM assembled ON THE
+FLY in VMEM: each program owns a (batch, row-tile) cell, gathers its
+kt*kt taps from the VMEM-resident padded image into a
+[tile_h * W, kt*kt*C2] patch tile (192-deep for ResNet-50 — 1.5 MXU
+passes instead of 16 shallow 12-deep accumulations), and runs a single
+[tile, 192] @ [192, C_out] matmul, with the bias fused. No patch matrix
+ever exists in HBM (the XLA `conv_general_dilated_patches` fallback in
+nn/conv.py materializes it per microbatch).
+
+Forward-only by design: the stem backward is a small share of the step
+(PERF.md), so `stem_conv` wraps the kernel in `jax.custom_vjp` with the
+mathematically-identical XLA conv supplying the gradients.
+
+No reference counterpart (the reference's CPU im2col is
+layout-insensitive; this exists because of the MXU's tiling rules).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+# test hook, same convention as ops/attention_kernel.py
+INTERPRET = False
+
+
+def _stem_kernel(x_ref, w_ref, b_ref, o_ref, *, kt: int, c2: int,
+                 tile_h: int, out_w: int, n_out: int):
+    """One program = one (batch, row-tile): assemble the patch tile and
+    run the fused GEMM + bias."""
+    from jax.experimental import pallas as pl
+
+    j = pl.program_id(1)
+    # padded rows this tile reads: [tile_h + kt - 1, Wpad, c2]
+    rows = x_ref[0, pl.ds(j * tile_h, tile_h + kt - 1), :, :]
+    rows = rows.astype(jnp.float32)
+    taps = []
+    for dy in range(kt):            # static tap loop -> fused VMEM copies
+        for dx in range(kt):
+            taps.append(rows[dy:dy + tile_h, dx:dx + out_w, :])
+    patches = jnp.concatenate(taps, axis=-1)        # [tile_h, W, kt*kt*c2]
+    patches = patches.reshape(tile_h * out_w, kt * kt * c2)
+    acc = jax.lax.dot_general(
+        patches, w_ref[...].astype(jnp.float32),
+        (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+    acc = acc + b_ref[...].astype(jnp.float32)
+    o_ref[0] = acc.reshape(tile_h, out_w, n_out).astype(o_ref.dtype)
+
+
+def stem_conv_forward(x2, wk, bias, pad_front: int, pad_rear: int,
+                      tile_h: int = 8,
+                      interpret: Optional[bool] = None):
+    """Pallas forward for the s2d stem.
+
+    x2:  [B, H, W, C2] space-to-depth input (H = W = 112 for R50)
+    wk:  [kt, kt, C2, O] transformed kernel (nn/conv.py re-blocking)
+    bias: [O] or None
+    pad_front/pad_rear: the stem's asymmetric padding.
+    """
+    from jax.experimental import pallas as pl
+
+    if interpret is None:
+        interpret = INTERPRET
+    b, h, w, c2 = x2.shape
+    kt, _, _, n_out = wk.shape
+    assert pad_front + pad_rear == kt - 1, (pad_front, pad_rear, kt)
+    xp = jnp.pad(x2, ((0, 0), (pad_front, pad_rear),
+                      (pad_front, pad_rear), (0, 0)))
+    hp, wp = xp.shape[1], xp.shape[2]
+    while h % tile_h:
+        tile_h //= 2               # h is even for every real stem input
+    w2 = wk.reshape(-1, n_out)     # [kt*kt*c2, O] — tap-major like taps
+    # nn/conv.py kernel layout is (dy, dx, c) tap order; taps list above
+    # concatenates channels per (dy, dx) in the same order, so a plain
+    # reshape lines up.
+    bvec = bias if bias is not None else jnp.zeros((n_out,), x2.dtype)
+
+    kernel = functools.partial(_stem_kernel, kt=kt, c2=c2, tile_h=tile_h,
+                               out_w=w, n_out=n_out)
+    out = pl.pallas_call(
+        kernel,
+        grid=(b, h // tile_h),
+        in_specs=[
+            pl.BlockSpec((1, hp, wp, c2), lambda i, j: (i, 0, 0, 0)),
+            pl.BlockSpec((kt * kt * c2, n_out), lambda i, j: (0, 0)),
+            pl.BlockSpec((n_out,), lambda i, j: (0,)),
+        ],
+        out_specs=pl.BlockSpec((1, tile_h, w, n_out),
+                               lambda i, j: (i, j, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((b, h, w, n_out), x2.dtype),
+        interpret=interpret,
+    )(xp, w2, bvec)
+    return out
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def stem_conv(x2, wk, bias, pad_front: int, pad_rear: int):
+    """s2d stem conv: Pallas forward, XLA-conv gradients (identical math
+    — lax.conv_general_dilated with the same padding).
+
+    The caller (nn/conv.py) owns the routing decision; calling this IS
+    choosing the kernel, so off-TPU it runs in interpreter mode rather
+    than silently substituting the XLA path (which would make A/B
+    comparisons meaningless)."""
+    interpret = jax.default_backend() != "tpu"
+    return stem_conv_forward(x2, wk, bias, pad_front, pad_rear,
+                             interpret=interpret)
+
+
+def _stem_xla(x2, wk, bias, pad_front, pad_rear):
+    y = lax.conv_general_dilated(
+        x2, wk, window_strides=(1, 1),
+        padding=((pad_front, pad_rear), (pad_front, pad_rear)),
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))
+    if bias is not None:
+        y = y + bias
+    return y
+
+
+def _stem_fwd_rule(x2, wk, bias, pad_front, pad_rear):
+    return stem_conv(x2, wk, bias, pad_front, pad_rear), (x2, wk, bias)
+
+
+def _stem_bwd_rule(pad_front, pad_rear, res, g):
+    x2, wk, bias = res
+    _, vjp = jax.vjp(
+        lambda a, b, c: _stem_xla(a, b, c, pad_front, pad_rear),
+        x2, wk, bias)
+    return vjp(g)
+
+
+stem_conv.defvjp(_stem_fwd_rule, _stem_bwd_rule)

@@ -124,6 +124,50 @@ class TestSpaceToDepthStem:
         with pytest.raises(ValueError):
             nn.SpaceToDepthStemConvolution(3, 8, 5)
 
+    def test_pallas_stem_kernel_parity(self, monkeypatch):
+        """The Pallas fused stem (ops/stem_kernel.py) must equal the XLA
+        restatement bit-close in outputs AND gradients — it is a compute
+        restatement of a compute restatement."""
+        from bigdl_tpu.ops import stem_kernel as sk
+        monkeypatch.setattr(sk, "INTERPRET", True)
+        from bigdl_tpu.nn.module import functional_apply
+        plain = nn.SpatialConvolution(3, 16, 7, 7, 2, 2, pad_w=3, pad_h=3,
+                                      with_bias=True)
+        s2d_pl = nn.SpaceToDepthStemConvolution(3, 16, 7, with_bias=True,
+                                                pallas_stem=True)
+        params = plain.init(jax.random.PRNGKey(11))
+        plain.set_params(params)
+        s2d_pl.set_params(params)
+        x = jnp.asarray(np.random.RandomState(12).rand(2, 32, 32, 3),
+                        jnp.float32)
+        np.testing.assert_allclose(np.asarray(s2d_pl.forward(x)),
+                                   np.asarray(plain.forward(x)),
+                                   rtol=1e-4, atol=1e-4)
+
+        def loss(mod, p):
+            return jnp.sum(functional_apply(mod, p, x)[0] ** 2)
+
+        gp = jax.grad(lambda p: loss(plain, p))(params)
+        gs = jax.grad(lambda p: loss(s2d_pl, p))(params)
+        jax.tree_util.tree_map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-4),
+            gp, gs)
+
+    def test_pallas_stem_no_bias(self, monkeypatch):
+        from bigdl_tpu.ops import stem_kernel as sk
+        monkeypatch.setattr(sk, "INTERPRET", True)
+        xla = nn.SpaceToDepthStemConvolution(3, 8, 7, pallas_stem=False)
+        pallas = nn.SpaceToDepthStemConvolution(3, 8, 7, pallas_stem=True)
+        params = xla.init(jax.random.PRNGKey(13))
+        xla.set_params(params)
+        pallas.set_params(params)
+        x = jnp.asarray(np.random.RandomState(14).rand(1, 16, 16, 3),
+                        jnp.float32)
+        np.testing.assert_allclose(np.asarray(pallas.forward(x)),
+                                   np.asarray(xla.forward(x)),
+                                   rtol=1e-4, atol=1e-4)
+
     def test_odd_input_falls_back_to_plain_stem(self):
         """225x225-style inputs can't space-to-depth; the layer must fall
         back to the mathematically identical plain stride-2 conv instead
