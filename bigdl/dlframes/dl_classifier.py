@@ -1,0 +1,131 @@
+"""pyspark-BigDL API compatibility: `bigdl.dlframes.dl_classifier`.
+
+Parity: reference pyspark/bigdl/dlframes/dl_classifier.py — the Spark-ML
+Estimator/Model/Classifier pipeline stages. Here they delegate to the
+TPU-native `bigdl_tpu.dlframes` stages, which implement the same
+fit/transform contract over pandas DataFrames (sklearn-compatible; the
+declared design delta — no Spark ML runtime exists in this stack, the
+DataFrame hand-off is the same RDD -> local swap as everywhere else in
+the compat namespace).
+
+The pyspark-style Param setters (setFeaturesCol, setBatchSize, ...) are
+kept so reference pipeline-construction code runs unmodified.
+"""
+
+from __future__ import annotations
+
+from bigdl.util.common import JavaValue
+
+
+def _unwrap(v):
+    return getattr(v, "value", v)
+
+
+class _ParamsMixin:
+    """The HasFeaturesCol/HasLabelCol/HasPredictionCol/HasBatchSize/
+    HasMaxEpoch/HasLearningRate surface (reference dl_classifier.py
+    Params classes), as plain fluent setters."""
+
+    def setFeaturesCol(self, v):
+        self.value.features_col = v
+        return self
+
+    def getFeaturesCol(self):
+        return self.value.features_col
+
+    def setLabelCol(self, v):
+        self.value.label_col = v
+        return self
+
+    def getLabelCol(self):
+        return self.value.label_col
+
+    def setPredictionCol(self, v):
+        self.value.prediction_col = v
+        return self
+
+    def getPredictionCol(self):
+        return getattr(self.value, "prediction_col", "prediction")
+
+    def setBatchSize(self, v):
+        self.value.set_batch_size(v)
+        return self
+
+    def setMaxEpoch(self, v):
+        self.value.set_max_epoch(v)
+        return self
+
+    def setLearningRate(self, v):
+        self.value.set_learning_rate(v)
+        return self
+
+
+class DLEstimator(_ParamsMixin, JavaValue):
+    """Reference dl_classifier.py:97."""
+
+    def __init__(self, model, criterion, feature_size, label_size,
+                 jvalue=None, bigdl_type="float"):
+        from bigdl_tpu.dlframes import DLEstimator as _E
+        self.value = jvalue or _E(_unwrap(model), _unwrap(criterion),
+                                  feature_size, label_size)
+        self.bigdl_type = bigdl_type
+        self.featureSize = feature_size
+
+    def fit(self, dataset):
+        """dataset: pandas DataFrame (the DataFrame swap). Returns a
+        DLModel wrapping the trained network."""
+        return DLModel.of(self.value.fit(dataset), self.featureSize,
+                          self.bigdl_type)
+
+    _fit = fit
+
+
+class DLModel(_ParamsMixin, JavaValue):
+    """Reference dl_classifier.py:113."""
+
+    def __init__(self, model, featureSize, jvalue=None,
+                 bigdl_type="float"):
+        if jvalue is None:
+            from bigdl_tpu.dlframes import DLModel as _M
+            jvalue = _M(_unwrap(model), featureSize)
+        self.value = jvalue
+        self.bigdl_type = bigdl_type
+        self.featureSize = featureSize
+
+    def setFeatureSize(self, v):
+        self.value.feature_size = v
+        self.featureSize = v
+        return self
+
+    def getFeatureSize(self):
+        return self.featureSize
+
+    def transform(self, dataset):
+        return self.value.transform(dataset)
+
+    _transform = transform
+
+    @classmethod
+    def of(cls, jvalue, feature_size=None, bigdl_type="float"):
+        return cls(model=None, featureSize=feature_size, jvalue=jvalue,
+                   bigdl_type=bigdl_type)
+
+
+class DLClassifier(DLEstimator):
+    """Reference dl_classifier.py:130 — label_size fixed to [1]."""
+
+    def __init__(self, model, criterion, feature_size, bigdl_type="float"):
+        from bigdl_tpu.dlframes import DLClassifier as _C
+        JavaValue.__init__(self, _C(_unwrap(model), _unwrap(criterion),
+                                    feature_size), bigdl_type)
+        self.featureSize = feature_size
+
+    def fit(self, dataset):
+        return DLClassifierModel.of(self.value.fit(dataset),
+                                    self.featureSize, self.bigdl_type)
+
+    _fit = fit
+
+
+class DLClassifierModel(DLModel):
+    """Reference dl_classifier.py:140."""

@@ -1,0 +1,76 @@
+"""pyspark-BigDL API compatibility: `bigdl.nn.keras.layer`.
+
+Parity: reference pyspark/bigdl/nn/keras/layer.py — the Keras-1.2.2-
+style layer classes. Every class delegates to the same-named
+`bigdl_tpu.keras` layer (both surfaces were derived from the same Scala
+keras package, same constructor arg names), wrapped so `.value` holds
+the native layer, matching the rest of the compat namespace.
+"""
+
+from __future__ import annotations
+
+import sys
+
+import bigdl_tpu.keras as _keras
+from bigdl_tpu.keras import KerasLayer as _TpuKerasLayer
+
+
+class KerasLayer:
+    """Base wrapper (reference keras/layer.py KerasLayer)."""
+
+    def __init__(self, tpu_layer, bigdl_type="float"):
+        self.value = tpu_layer
+        self.bigdl_type = bigdl_type
+
+    def set_name(self, name):
+        self.value.name = name
+        return self
+
+    def name(self):
+        return self.value.name
+
+    def __call__(self, x=None):
+        from bigdl.util.common import to_list
+        xs = [getattr(i, "value", i) for i in to_list(x)] if x is not None \
+            else []
+        out = self.value(xs[0] if len(xs) == 1 else xs)
+        return _Node(out)
+
+
+class _Node:
+    def __init__(self, tpu_node):
+        self.value = tpu_node
+
+
+def _passthrough(cls_name):
+    tpu_cls = getattr(_keras, cls_name)
+
+    def _unwrap(v):
+        if isinstance(v, (KerasLayer, _Node)):
+            return v.value
+        if isinstance(v, (list, tuple)):
+            return type(v)(_unwrap(x) for x in v)
+        return v
+
+    def __init__(self, *args, bigdl_type="float", **kwargs):
+        kwargs.pop("bigdl_type", None)
+        args = tuple(_unwrap(a) for a in args)
+        kwargs = {k: _unwrap(v) for k, v in kwargs.items()}
+        KerasLayer.__init__(self, tpu_cls(*args, **kwargs), bigdl_type)
+
+    doc = (f"pyspark-compat passthrough for bigdl_tpu.keras.{cls_name} "
+           f"(reference pyspark/bigdl/nn/keras/layer.py {cls_name}).")
+    return type(cls_name, (KerasLayer,), {"__init__": __init__,
+                                          "__doc__": doc})
+
+
+__all__ = ["KerasLayer"]
+_module = sys.modules[__name__]
+for _name in dir(_keras):
+    if _name.startswith("_") or _name in ("KerasLayer", "KerasModel",
+                                          "Sequential", "Model"):
+        continue
+    _obj = getattr(_keras, _name)
+    if isinstance(_obj, type) and issubclass(_obj, _TpuKerasLayer):
+        setattr(_module, _name, _passthrough(_name))
+        __all__.append(_name)

@@ -1,0 +1,42 @@
+"""pyspark-BigDL API compatibility: `bigdl.nn.keras.topology`.
+
+Parity: reference pyspark/bigdl/nn/keras/topology.py — the Keras-style
+Sequential/Model containers. Delegates to `bigdl_tpu.keras`, which
+carries the full Keras-1.2.2-style surface (compile/fit/evaluate/
+predict) natively; data is lists/ndarrays instead of RDDs.
+"""
+
+from __future__ import annotations
+
+import bigdl_tpu.keras as _keras
+
+
+class KerasModelWrapper:
+    def __init__(self, tpu_model, bigdl_type="float"):
+        self.value = tpu_model
+        self.bigdl_type = bigdl_type
+
+    def __getattr__(self, name):
+        return getattr(self.value, name)
+
+
+class Sequential(KerasModelWrapper):
+    """Reference topology.py Sequential."""
+
+    def __init__(self, name=None, bigdl_type="float"):
+        super().__init__(_keras.Sequential(name=name) if name
+                         else _keras.Sequential(), bigdl_type)
+
+    def add(self, layer):
+        self.value.add(getattr(layer, "value", layer))
+        return self
+
+
+class Model(KerasModelWrapper):
+    """Reference topology.py Model (graph-style)."""
+
+    def __init__(self, input, output, name=None, bigdl_type="float"):
+        from bigdl.util.common import to_list
+        ins = [getattr(i, "value", i) for i in to_list(input)]
+        outs = [getattr(o, "value", o) for o in to_list(output)]
+        super().__init__(_keras.Model(ins, outs), bigdl_type)

@@ -1,0 +1,41 @@
+"""pyspark-BigDL API compatibility: `bigdl.util.tf_utils`.
+
+Parity: reference pyspark/bigdl/util/tf_utils.py — TensorFlow graph
+import/export helpers. The heavy lifting lives in
+`bigdl_tpu.interop.tensorflow` (GraphDef loader/saver, 161-op surface);
+these wrappers keep the reference entry points importable and delegate.
+"""
+
+from __future__ import annotations
+
+
+def convert(input_ops, output_ops, byte_order="little_endian",
+            bigdl_type="float"):
+    """Reference tf_utils.convert: TF session graph -> BigDL model.
+    Requires a live TF session in the reference; here use
+    `Model.load_tensorflow(pb_path, inputs, outputs)` on a frozen
+    GraphDef instead."""
+    raise NotImplementedError(
+        "convert(live TF session): export the graph to a .pb and use "
+        "bigdl.nn.layer.Model.load_tensorflow(path, inputs, outputs) "
+        "(bigdl_tpu.interop.tensorflow.TensorflowLoader)")
+
+
+def get_path(output_name, sess=None):
+    raise NotImplementedError(
+        "get_path needs a live TF session; freeze the graph to .pb and "
+        "load it with Model.load_tensorflow")
+
+
+def export_checkpoint(checkpoint_path):
+    raise NotImplementedError(
+        "export_checkpoint reads TF V1 checkpoints; use "
+        "bigdl_tpu.interop.tensorflow.TensorflowLoader with a frozen "
+        "GraphDef (bin_file) instead")
+
+
+def merge_checkpoint(input_graph, checkpoint, output_node_names,
+                     output_graph, sess=None):
+    raise NotImplementedError(
+        "merge_checkpoint (freeze_graph) requires TensorFlow; freeze "
+        "offline and load the .pb via Model.load_tensorflow")

@@ -442,3 +442,58 @@ class TestLenetExample:
         assert top1 > 0.3, f"LeNet compat path failed to learn: {top1}"
         # checkpoints were written by set_checkpoint(EveryEpoch(), ...)
         assert os.listdir(options.checkpointPath)
+
+
+class TestKerasCompat:
+    def test_sequential_mnist_style(self):
+        from bigdl.nn.keras.layer import Dense, Activation, Flatten
+        from bigdl.nn.keras.topology import Sequential
+        m = Sequential()
+        m.add(Flatten(input_shape=(28, 28)))
+        m.add(Dense(32, activation="relu"))
+        m.add(Dense(10, activation="softmax"))
+        out = m.forward(np.ones((2, 28, 28), np.float32))
+        assert np.asarray(out).shape == (2, 10)
+
+    def test_layer_surface(self):
+        import bigdl.nn.keras.layer as L
+        for name in ["Dense", "Convolution2D", "MaxPooling2D", "LSTM",
+                     "GRU", "Embedding", "Dropout", "BatchNormalization",
+                     "Flatten", "Activation", "ZeroPadding2D",
+                     "GlobalAveragePooling2D", "TimeDistributed",
+                     "Bidirectional", "Merge", "Highway", "SeparableConvolution2D"]:
+            assert hasattr(L, name), f"missing keras layer {name}"
+
+
+class TestDLFramesCompat:
+    def test_classifier_fit_transform(self):
+        pd = pytest.importorskip("pandas")
+        from bigdl.dlframes.dl_classifier import (DLClassifier,
+                                                  DLClassifierModel)
+        from bigdl.nn.layer import Linear, LogSoftMax, Sequential
+        from bigdl.nn.criterion import ClassNLLCriterion
+
+        rs = np.random.RandomState(0)
+        n = 128
+        y = rs.randint(0, 2, size=n)
+        X = rs.rand(n, 4).astype(np.float32) + y[:, None] * 1.5
+        df = pd.DataFrame({
+            "features": [row.tolist() for row in X],
+            "label": (y + 1).astype(np.float64),
+        })
+        model = Sequential().add(Linear(4, 2)).add(LogSoftMax())
+        est = DLClassifier(model, ClassNLLCriterion(), [4]) \
+            .setBatchSize(16).setMaxEpoch(20).setLearningRate(0.5)
+        fitted = est.fit(df)
+        assert isinstance(fitted, DLClassifierModel)
+        pred = fitted.transform(df)
+        acc = float((pred["prediction"].to_numpy() == y + 1).mean())
+        assert acc > 0.9, acc
+
+    def test_param_setters_roundtrip(self):
+        from bigdl.dlframes.dl_classifier import DLEstimator
+        from bigdl.nn.layer import Linear
+        from bigdl.nn.criterion import MSECriterion
+        est = DLEstimator(Linear(4, 1), MSECriterion(), [4], [1])
+        est.setFeaturesCol("f").setLabelCol("l")
+        assert est.getFeaturesCol() == "f" and est.getLabelCol() == "l"
