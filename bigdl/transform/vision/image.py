@@ -1,0 +1,231 @@
+"""pyspark-BigDL API compatibility: `bigdl.transform.vision.image`.
+
+Parity: reference pyspark/bigdl/transform/vision/image.py — the
+ImageFrame/FeatureTransformer vision pipeline. Delegates to
+`bigdl_tpu.transform.vision`, which carries the full reference
+augmentation set natively; `DistributedImageFrame` folds into the local
+one (the RDD -> local swap, like everywhere in the compat namespace).
+"""
+
+from __future__ import annotations
+
+import sys
+
+import bigdl_tpu.transform.vision as _V
+
+
+class FeatureTransformer:
+    """Reference image.py:27 — base wrapper; `.value` holds the native
+    transformer. `transform` applies to one ImageFeature; calling the
+    object applies to an ImageFrame."""
+
+    def __init__(self, tpu_transformer, bigdl_type="float"):
+        self.value = tpu_transformer
+        self.bigdl_type = bigdl_type
+
+    def transform(self, image_feature, bigdl_type="float"):
+        native = self.value(getattr(image_feature, "value", image_feature))
+        if isinstance(image_feature, ImageFeature):
+            # reference transform mutates and returns the SAME wrapper
+            # (reference image.py:36-41)
+            image_feature.value = native
+            return image_feature
+        return native
+
+    def __call__(self, image_frame, bigdl_type="float"):
+        return ImageFrame.of(
+            _unwrap(image_frame).transform(self.value))
+
+
+def _unwrap(v):
+    return getattr(v, "value", v)
+
+
+def _img(f):
+    """ImageFeature.image is a method on raw features and a plain array
+    once MatToTensor/transforms have materialized it."""
+    im = f.image
+    return im() if callable(im) else im
+
+
+def _lbl(f):
+    lb = f.label
+    return lb() if callable(lb) else lb
+
+
+class Pipeline(FeatureTransformer):
+    """Reference image.py:51 — chained transformers."""
+
+    def __init__(self, transformers, bigdl_type="float"):
+        from bigdl_tpu.dataset import chain
+        super().__init__(chain(*[_unwrap(t) for t in transformers]),
+                         bigdl_type)
+
+
+class ImageFeature:
+    """Reference image.py:62 — one image + metadata."""
+
+    def __init__(self, image=None, label=None, path=None,
+                 bigdl_type="float"):
+        self.value = _V.ImageFeature(image, label=label, uri=path)
+        self.bigdl_type = bigdl_type
+
+    def get_image(self, float_key="floats", to_chw=True):
+        import numpy as np
+        img = _img(self.value)
+        if to_chw and img.ndim == 3:
+            img = np.transpose(img, (2, 0, 1))
+        return img
+
+    def get_label(self):
+        return _lbl(self.value)
+
+    def keys(self):
+        return self.value.keys()
+
+
+class ImageFrame:
+    """Reference image.py:100 — a collection of ImageFeatures."""
+
+    def __init__(self, jvalue, bigdl_type="float"):
+        self.value = jvalue
+        self.bigdl_type = bigdl_type
+
+    @classmethod
+    def of(cls, native):
+        return cls(native)
+
+    @classmethod
+    def read(cls, path, sc=None, min_partitions=1, bigdl_type="float"):
+        """Read images from a local path or glob (the reference's
+        HDFS/RDD read folds into the local frame)."""
+        return cls(_V.ImageFrame.read(path))
+
+    def transform(self, transformer, bigdl_type="float"):
+        return ImageFrame.of(self.value.transform(_unwrap(transformer)))
+
+    def get_image(self, float_key="floats", to_chw=True):
+        import numpy as np
+        imgs = [_img(f) for f in self.value.features]
+        if to_chw:
+            imgs = [np.transpose(i, (2, 0, 1)) if i.ndim == 3 else i
+                    for i in imgs]
+        return imgs
+
+    def get_label(self):
+        return [_lbl(f) for f in self.value.features]
+
+    def is_local(self):
+        return True
+
+    def is_distributed(self):
+        return False
+
+
+class LocalImageFrame(ImageFrame):
+    """Reference image.py:209 — built from a list of images (+labels)."""
+
+    def __init__(self, image_list, label_list=None, bigdl_type="float"):
+        feats = []
+        for i, img in enumerate(image_list):
+            label = label_list[i] if label_list is not None else None
+            feats.append(_V.ImageFeature(img, label=label))
+        super().__init__(_V.LocalImageFrame(feats), bigdl_type)
+
+
+class DistributedImageFrame(ImageFrame):
+    """Reference image.py:257 — RDD-backed; here the declared swap makes
+    it the local frame over a plain list."""
+
+    def __init__(self, image_rdd, label_rdd=None, bigdl_type="float"):
+        images = list(image_rdd)
+        labels = list(label_rdd) if label_rdd is not None else None
+        frame = LocalImageFrame(images, labels, bigdl_type)
+        super().__init__(frame.value, bigdl_type)
+
+
+def _passthrough(cls_name):
+    """STRICT passthrough: reference args that do not exist on the native
+    class raise instead of silently landing in trailing params (e.g. the
+    native rng `seed`) — a mis-bound augmentation corrupts data with no
+    error, the worst failure mode a compat layer can have."""
+    import inspect as _inspect
+    tpu_cls = getattr(_V, cls_name)
+    _params = [p.name for p in
+               list(_inspect.signature(tpu_cls.__init__)
+                    .parameters.values())[1:] if p.name != "seed"]
+
+    def __init__(self, *args, bigdl_type="float", **kwargs):
+        if len(args) > len(_params) or set(kwargs) - set(_params):
+            raise TypeError(
+                f"{cls_name}: arguments beyond the native surface "
+                f"{_params} are not silently absorbed — see "
+                f"bigdl_tpu.transform.vision.{cls_name} for the "
+                f"supported parameters")
+        FeatureTransformer.__init__(self, tpu_cls(*args, **kwargs),
+                                    bigdl_type)
+
+    doc = (f"pyspark-compat passthrough for bigdl_tpu.transform.vision."
+           f"{cls_name} (reference pyspark/bigdl/transform/vision/"
+           f"image.py {cls_name}); strict about unsupported args.")
+    return type(cls_name, (FeatureTransformer,), {"__init__": __init__,
+                                                  "__doc__": doc})
+
+
+class ChannelNormalize(FeatureTransformer):
+    """Reference image.py:377 — note the arg-ORDER delta: the reference
+    takes R, G, B means/stds; the native class takes B, G, R (BGR images,
+    reference pipeline heritage). Mapped here so reference calls like
+    ChannelNormalize(123, 117, 104) normalize the right channels."""
+
+    def __init__(self, mean_r, mean_g, mean_b, std_r=1.0, std_g=1.0,
+                 std_b=1.0, bigdl_type="float"):
+        super().__init__(_V.ChannelNormalize(
+            mean_b=mean_b, mean_g=mean_g, mean_r=mean_r,
+            std_b=std_b, std_g=std_g, std_r=std_r), bigdl_type)
+
+
+class AspectScale(FeatureTransformer):
+    """Reference image.py:608. scale_multiple_of/resize_mode variants are
+    not in the native surface; non-default values raise loudly."""
+
+    def __init__(self, min_size, scale_multiple_of=1, max_size=1000,
+                 resize_mode=1, use_scale_factor=True, min_scale=-1.0,
+                 bigdl_type="float"):
+        if scale_multiple_of != 1 or resize_mode != 1:
+            raise NotImplementedError(
+                "AspectScale: scale_multiple_of/resize_mode variants are "
+                "not supported; use bigdl_tpu.transform.vision.AspectScale")
+        super().__init__(_V.AspectScale(min_size, max_size=max_size),
+                         bigdl_type)
+
+
+class Resize(FeatureTransformer):
+    """Reference image.py Resize(resize_h, resize_w, resize_mode,
+    use_scale_factor); only the default interpolation is native."""
+
+    def __init__(self, resize_h, resize_w, resize_mode=1,
+                 use_scale_factor=True, bigdl_type="float"):
+        if resize_mode != 1:
+            raise NotImplementedError(
+                "Resize: resize_mode != 1 (random interpolation) is not "
+                "supported; use bigdl_tpu.transform.vision.Resize")
+        super().__init__(_V.Resize(resize_h, resize_w), bigdl_type)
+
+
+_EXPLICIT = {"FeatureTransformer", "Pipeline", "ImageFeature",
+             "ImageFrame", "LocalImageFrame", "DistributedImageFrame",
+             "ChannelNormalize", "AspectScale", "Resize"}
+__all__ = sorted(_EXPLICIT)
+_module = sys.modules[__name__]
+for _name in ("HFlip", "Brightness", "ChannelOrder", "Contrast",
+              "Saturation", "Hue", "RandomCrop",
+              "CenterCrop", "FixedCrop", "Expand", "Filler",
+              "RandomTransformer", "ColorJitter", "RoiHFlip", "RoiResize",
+              "RoiNormalize", "MatToFloats", "MatToTensor",
+              "ImageFrameToSample", "ChannelScaledNormalizer",
+              "RandomAlterAspect", "RandomCropper", "RandomResize",
+              "Lighting"):
+    if hasattr(_V, _name):
+        setattr(_module, _name, _passthrough(_name))
+        __all__.append(_name)

@@ -497,3 +497,69 @@ class TestDLFramesCompat:
         est = DLEstimator(Linear(4, 1), MSECriterion(), [4], [1])
         est.setFeaturesCol("f").setLabelCol("l")
         assert est.getFeaturesCol() == "f" and est.getLabelCol() == "l"
+
+
+class TestVisionCompat:
+    def test_local_image_frame_pipeline(self):
+        from bigdl.transform.vision.image import (CenterCrop, HFlip,
+                                                  LocalImageFrame,
+                                                  MatToTensor, Pipeline)
+        rs = np.random.RandomState(0)
+        imgs = [(rs.rand(16, 16, 3) * 255).astype(np.uint8)
+                for _ in range(4)]
+        frame = LocalImageFrame(imgs, [1.0, 2.0, 1.0, 2.0])
+        out = frame.transform(Pipeline([HFlip(), CenterCrop(8, 8)]))
+        got = out.get_image(to_chw=True)
+        assert len(got) == 4 and got[0].shape[0] == 3
+        assert got[0].shape[1:] == (8, 8)
+        assert out.get_label() == [1.0, 2.0, 1.0, 2.0]
+        assert frame.is_local() and not frame.is_distributed()
+
+    def test_transformer_call_on_frame(self):
+        from bigdl.transform.vision.image import LocalImageFrame, Resize
+        rs = np.random.RandomState(1)
+        frame = LocalImageFrame([(rs.rand(10, 12, 3) * 255)
+                                 .astype(np.uint8)])
+        out = Resize(6, 6)(frame)
+        assert out.get_image()[0].shape == (3, 6, 6)
+
+    def test_surface(self):
+        import bigdl.transform.vision.image as I
+        for name in ["HFlip", "Resize", "Brightness", "Contrast",
+                     "Saturation", "Hue", "ChannelNormalize", "RandomCrop",
+                     "CenterCrop", "FixedCrop", "Expand", "ColorJitter",
+                     "MatToTensor", "AspectScale", "ImageFrameToSample",
+                     "ChannelScaledNormalizer", "RandomAlterAspect",
+                     "Pipeline", "ImageFrame", "LocalImageFrame",
+                     "DistributedImageFrame"]:
+            assert hasattr(I, name), f"missing vision transform {name}"
+
+    def test_channel_normalize_rgb_order_mapped(self):
+        """Reference arg order is R,G,B; native is B,G,R — the shim must
+        map, not pass through positionally."""
+        from bigdl.transform.vision.image import (ChannelNormalize,
+                                                  LocalImageFrame,
+                                                  MatToTensor)
+        img = np.zeros((2, 2, 3), np.uint8)
+        img[..., 0] = 10   # B plane (BGR storage)
+        img[..., 2] = 200  # R plane
+        frame = LocalImageFrame([img])
+        out = frame.transform(ChannelNormalize(200.0, 0.0, 10.0))  # R,G,B
+        got = out.get_image(to_chw=False)[0]
+        # R channel (index 2) minus mean_r=200 -> 0; B minus mean_b=10 -> 0
+        np.testing.assert_allclose(got[..., 2], 0.0, atol=1e-5)
+        np.testing.assert_allclose(got[..., 0], 0.0, atol=1e-5)
+
+    def test_strict_passthrough_rejects_unmapped_args(self):
+        from bigdl.transform.vision.image import AspectScale, CenterCrop
+        with pytest.raises(TypeError):
+            CenterCrop(8, 8, False)     # reference is_clip arg
+        with pytest.raises(NotImplementedError):
+            AspectScale(600, 32)        # scale_multiple_of variant
+
+    def test_transform_returns_wrapper(self):
+        from bigdl.transform.vision.image import HFlip, ImageFeature
+        f = ImageFeature(np.zeros((4, 4, 3), np.uint8))
+        res = HFlip().transform(f)
+        assert res is f
+        assert res.get_image().shape == (3, 4, 4)
