@@ -462,6 +462,16 @@ class PipelineStages:
             stacked = params
             unravels, sizes, _ = self._spec_cache
         pmax = stacked.shape[1]
+        # memoize the traced step: rebuilding the shard_map function per
+        # call would retrace (and recompile) every training step
+        fn_key = (id(mesh), x.shape, str(x.dtype), y.shape, str(y.dtype),
+                  id(loss_fn), training, pmax)
+        cached = getattr(self, "_1f1b_fn_cache", None)
+        if cached is not None and cached[0] == fn_key:
+            mapped = cached[1]
+            gpad, loss_sum = mapped(stacked, micro_x, micro_y)
+            grads = [unravels[s](gpad[s, :sizes[s]]) for s in range(S)]
+            return loss_sum / M, grads
         ctx = ApplyContext(training=training)
         pipeline = self
 
@@ -604,9 +614,10 @@ class PipelineStages:
 
         from bigdl_tpu.parallel.mesh import get_shard_map
         shard_map = get_shard_map()
-        mapped = shard_map(staged, mesh=mesh,
-                           in_specs=(P("pipe"), P(), P()),
-                           out_specs=(P("pipe"), P()))
+        mapped = jax.jit(shard_map(staged, mesh=mesh,
+                                   in_specs=(P("pipe"), P(), P()),
+                                   out_specs=(P("pipe"), P())))
+        self._1f1b_fn_cache = (fn_key, mapped)
         gpad, loss_sum = mapped(stacked, micro_x, micro_y)
         grads = [unravels[s](gpad[s, :sizes[s]])
                  for s in range(S)]

@@ -129,3 +129,9 @@ class TestExamples:
         from examples.dlframes_image_pipeline import main
         acc = main(["--n-per-class", "25", "--max-epoch", "4"])
         assert acc > 0.8
+
+    def test_pipeline_resnet(self):
+        """Hetero pipeline + 1F1B example: trains and converges with
+        gradient parity asserted inside the example itself."""
+        from examples.pipeline_resnet import main
+        main(["--steps", "3", "--micro", "4", "--batch-size", "16"])
