@@ -1,0 +1,27 @@
+"""pyspark-BigDL API compatibility: `bigdl.dataset.base`.
+
+Parity: reference pyspark/bigdl/dataset/base.py — the dataset download
+helper. This environment has no network egress, so `maybe_download`
+only resolves already-present files and raises with instructions
+otherwise (the same contract `bigdl.dataset.mnist` follows).
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def maybe_download(filename, work_directory, source_url):
+    """Return the path of `filename` under `work_directory` if present;
+    the reference downloads from `source_url` otherwise — impossible
+    here (no egress), so the error says what to stage where."""
+    filepath = os.path.join(work_directory, filename)
+    if os.path.exists(filepath):
+        return filepath
+    raise FileNotFoundError(
+        f"{filepath} not found and this build cannot download "
+        f"{source_url} (no network egress) — place the file there first")
+
+
+class Resource:
+    """Placeholder for the reference's download-progress helper."""
