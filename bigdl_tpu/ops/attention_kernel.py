@@ -159,6 +159,30 @@ def blockwise_attention(q, k, v, causal: bool = False,
 # Pallas flash forward (TPU fast path)
 # --------------------------------------------------------------------------- #
 
+def _kernel_block_update(q, k_blk, v_blk, acc, m, l, sm_scale, causal,
+                         q_off, k_off):
+    """One online-softmax update inside a Pallas kernel — the single
+    numerics body shared by the dense forward and the ring-hop carry
+    kernels (they must stay provably identical)."""
+    s = jax.lax.dot_general(q, k_blk, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32)
+    s = s * sm_scale
+    if causal:
+        gq = jax.lax.broadcasted_iota(jnp.int32, s.shape, 0) + q_off
+        gk = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1) + k_off
+        s = jnp.where(gq >= gk, s, NEG_INF)
+    m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+    shift = jnp.where(m_new <= NEG_INF / 2, 0.0, m_new)
+    p = jnp.exp(s - shift[:, None])
+    scale_old = jnp.where(m <= NEG_INF / 2, 0.0, jnp.exp(m - shift))
+    l_new = l * scale_old + jnp.sum(p, axis=-1)
+    acc_new = acc * scale_old[:, None] + jax.lax.dot_general(
+        p, v_blk, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    return (_match_vma(acc_new, acc), _match_vma(m_new, m),
+            _match_vma(l_new, l))
+
+
 def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k: int,
                       sm_scale: float, causal: bool, seq_k: int):
     """One program = one (batch*head, q-block); K/V streamed with
@@ -177,23 +201,8 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k: int,
         acc, m, l = carry
         k_blk = k_ref[0, pl.ds(ib * block_k, block_k), :].astype(jnp.float32)
         v_blk = v_ref[0, pl.ds(ib * block_k, block_k), :].astype(jnp.float32)
-        s = jax.lax.dot_general(q, k_blk, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32)
-        s = s * sm_scale                        # [block_q, block_k]
-        if causal:
-            gq = jax.lax.broadcasted_iota(jnp.int32, s.shape, 0) + q_off
-            gk = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1) \
-                + ib * block_k
-            s = jnp.where(gq >= gk, s, NEG_INF)
-        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
-        shift = jnp.where(m_new <= NEG_INF / 2, 0.0, m_new)
-        p = jnp.exp(s - shift[:, None])
-        scale_old = jnp.where(m <= NEG_INF / 2, 0.0, jnp.exp(m - shift))
-        l_new = l * scale_old + jnp.sum(p, axis=-1)
-        acc_new = acc * scale_old[:, None] + jax.lax.dot_general(
-            p, v_blk, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
-        return acc_new, m_new, l_new
+        return _kernel_block_update(q, k_blk, v_blk, acc, m, l, sm_scale,
+                                    causal, q_off, ib * block_k)
 
     acc = jnp.zeros((block_q, d), jnp.float32)
     m = jnp.full((block_q,), NEG_INF, jnp.float32)
@@ -260,6 +269,150 @@ def flash_attention_forward(q, k, v, causal: bool = False,
     if return_lse:
         return out, lse.reshape(b, h, tq)
     return out
+
+
+def _flash_carry_kernel(q_ref, k_ref, v_ref, acc_ref, m_ref, l_ref,
+                        off_ref, oacc_ref, om_ref, ol_ref, *, block_k: int,
+                        sm_scale: float, causal: bool, seq_k: int):
+    """Online-softmax update of carried (acc, m, l) with this device's
+    KV shard — the ring-attention hop, in Pallas. Offsets arrive as data
+    (off_ref = [q_offset, k_offset]) because ring hops compute them from
+    lax.axis_index, a traced value."""
+    from jax.experimental import pallas as pl
+
+    q = q_ref[0].astype(jnp.float32)            # [bq, d]
+    block_q, d = q.shape
+    acc = acc_ref[0].astype(jnp.float32)
+    m = m_ref[0].astype(jnp.float32)
+    l = l_ref[0].astype(jnp.float32)
+    q_off = off_ref[0] + pl.program_id(1) * block_q
+    k_off = off_ref[1]
+    n_kb = seq_k // block_k
+
+    def body(ib, carry):
+        acc, m, l = carry
+        k_blk = k_ref[0, pl.ds(ib * block_k, block_k), :].astype(jnp.float32)
+        v_blk = v_ref[0, pl.ds(ib * block_k, block_k), :].astype(jnp.float32)
+        return _kernel_block_update(q, k_blk, v_blk, acc, m, l, sm_scale,
+                                    causal, q_off, k_off + ib * block_k)
+
+    if causal:
+        # dynamic bound: offsets are traced; blocks fully in the masked
+        # future contribute nothing — skip them
+        n_needed = jnp.clip(
+            (q_off + block_q - k_off + block_k - 1) // block_k, 0, n_kb)
+        acc, m, l = jax.lax.fori_loop(0, n_needed, body, (acc, m, l))
+    else:
+        acc, m, l = jax.lax.fori_loop(0, n_kb, body, (acc, m, l))
+    oacc_ref[0] = acc
+    om_ref[0] = m
+    ol_ref[0] = l
+
+
+def _match_vma(val, like):
+    """pcast `val` to carry `like`'s varying-manual-axes type (interpret
+    mode inside shard_map can drop vma through reductions); no-op
+    elsewhere."""
+    try:
+        want = jax.typeof(like).vma
+        have = jax.typeof(val).vma
+        missing = tuple(set(want) - set(have))
+        if missing:
+            return lax.pcast(val, missing, to="varying")
+    except (AttributeError, TypeError):
+        pass
+    return val
+
+
+def _offs_spec(interpret):
+    from jax.experimental import pallas as pl
+    if interpret:
+        return pl.BlockSpec((2,), lambda i, j: (0,))
+    from jax.experimental.pallas import tpu as pltpu
+    return pl.BlockSpec(memory_space=pltpu.SMEM)
+
+
+def _struct_like(shape, dtype, like):
+    """ShapeDtypeStruct carrying `like`'s varying-manual-axes type, so the
+    kernel works both at top level and inside shard_map (check_vma)."""
+    try:
+        vma = jax.typeof(like).vma
+        if vma:
+            return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
+    except (AttributeError, TypeError):
+        pass
+    return jax.ShapeDtypeStruct(shape, dtype)
+
+
+def flash_attention_carry(q, k, v, carry, causal: bool = False,
+                          sm_scale: Optional[float] = None,
+                          q_offset=0, k_offset=0, block_q: int = 256,
+                          block_k: int = 512,
+                          interpret: Optional[bool] = None):
+    """One ring-attention hop through the Pallas kernel: continue the
+    online softmax carried in `carry` (= attention_state_init shapes)
+    with this KV shard. Returns the updated (acc, m, l) — call
+    `attention_state_finish` after the last hop. Falls back to the XLA
+    blockwise step when shapes don't tile the kernel blocks."""
+    if interpret is None:
+        interpret = INTERPRET
+    from jax.experimental import pallas as pl
+
+    b, h, tq, d = q.shape
+    tk = k.shape[2]
+    sm_scale = sm_scale or d ** -0.5
+    block_q = min(block_q, tq)
+    block_k = min(block_k, tk)
+    if tq % block_q or tk % block_k:
+        return blockwise_attention(q, k, v, causal=causal,
+                                   sm_scale=sm_scale, block_k=block_k,
+                                   q_offset=q_offset, k_offset=k_offset,
+                                   carry=carry, finish=False)
+    bh = b * h
+    acc, m, l = carry
+    offs = jnp.stack([jnp.asarray(q_offset, jnp.int32),
+                      jnp.asarray(k_offset, jnp.int32)])
+    kernel = functools.partial(_flash_carry_kernel, block_k=block_k,
+                               sm_scale=sm_scale, causal=causal, seq_k=tk)
+    try:
+        oacc, om, ol = pl.pallas_call(
+            kernel,
+            grid=(bh, tq // block_q),
+            in_specs=[
+                pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
+                pl.BlockSpec((1, tk, d), lambda i, j: (i, 0, 0)),
+                pl.BlockSpec((1, tk, d), lambda i, j: (i, 0, 0)),
+                pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
+                pl.BlockSpec((1, block_q), lambda i, j: (i, j)),
+                pl.BlockSpec((1, block_q), lambda i, j: (i, j)),
+                # offsets feed control flow (the causal loop bound):
+                # Mosaic requires such scalars in SMEM; interpret mode
+                # ignores the memory space
+                _offs_spec(interpret),
+            ],
+            out_specs=[
+                pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
+                pl.BlockSpec((1, block_q), lambda i, j: (i, j)),
+                pl.BlockSpec((1, block_q), lambda i, j: (i, j)),
+            ],
+            out_shape=[
+                _struct_like((bh, tq, d), jnp.float32, q),
+                _struct_like((bh, tq), jnp.float32, q),
+                _struct_like((bh, tq), jnp.float32, q),
+            ],
+            interpret=interpret,
+        )(q.reshape(bh, tq, d), k.reshape(bh, tk, d), v.reshape(bh, tk, d),
+          acc.reshape(bh, tq, d), m.reshape(bh, tq), l.reshape(bh, tq),
+          offs)
+    except TypeError:
+        # varying-axes typing rejected the kernel on this backend/version:
+        # the XLA blockwise step is the same math
+        return blockwise_attention(q, k, v, causal=causal,
+                                   sm_scale=sm_scale, block_k=block_k,
+                                   q_offset=q_offset, k_offset=k_offset,
+                                   carry=carry, finish=False)
+    return (oacc.reshape(b, h, tq, d), om.reshape(b, h, tq),
+            ol.reshape(b, h, tq))
 
 
 # --------------------------------------------------------------------------- #

@@ -39,7 +39,23 @@ def ring_attention(q, k, v, axis_name: str, causal: bool = False,
     the ring. Each device computes its Q block against every KV shard as the
     shards rotate; causal masking uses global offsets so semantics match the
     unsharded computation exactly.
+
+    On TPU each hop runs the Pallas carry kernel
+    (ops/attention_kernel.flash_attention_carry — same online softmax,
+    MXU-tiled); the backward recomputes through the XLA blockwise ring
+    via custom_vjp (Pallas calls are not auto-differentiable).
     """
+    from bigdl_tpu.ops import attention_kernel as ak
+    if jax.default_backend() == "tpu" or ak.INTERPRET:
+        return _ring_pallas(q, k, v, axis_name, causal, sm_scale, block_k,
+                            axis_size)
+    return _ring_impl(q, k, v, False, axis_name, causal, sm_scale,
+                      block_k, axis_size)
+
+
+def _ring_impl(q, k, v, use_pallas, axis_name, causal, sm_scale, block_k,
+               axis_size):
+    from bigdl_tpu.ops import attention_kernel as ak
     n = axis_size if axis_size is not None else int(lax.psum(1, axis_name))
     idx = lax.axis_index(axis_name)
     t_local = q.shape[2]
@@ -48,22 +64,53 @@ def ring_attention(q, k, v, axis_name: str, causal: bool = False,
     q_offset = idx * t_local
     perm = [(i, (i + 1) % n) for i in range(n)]
 
-    from bigdl_tpu.ops.attention_kernel import attention_state_init
-    state = attention_state_init(q.astype(jnp.float32))
+    state = ak.attention_state_init(q.astype(jnp.float32))
     k_cur, v_cur = k, v
     # unrolled python loop: n is static (the mesh size), which keeps each
     # ppermute visible to XLA's collective scheduler for compute/comm overlap
     for i in range(n):
         src = (idx - i) % n  # device where the held KV shard originated
-        state = blockwise_attention(
-            q, k_cur, v_cur, causal=causal, sm_scale=sm_scale,
-            block_k=block_k, q_offset=q_offset, k_offset=src * t_local,
-            carry=state, finish=False)
+        if use_pallas:
+            # offsets are traced (axis_index); the kernel takes them as data
+            state = ak.flash_attention_carry(
+                q, k_cur, v_cur, state, causal=causal, sm_scale=sm_scale,
+                q_offset=q_offset, k_offset=src * t_local, block_k=block_k)
+        else:
+            state = blockwise_attention(
+                q, k_cur, v_cur, causal=causal, sm_scale=sm_scale,
+                block_k=block_k, q_offset=q_offset, k_offset=src * t_local,
+                carry=state, finish=False)
         if i + 1 < n:  # last hop needs no rotation
             k_cur = lax.ppermute(k_cur, axis_name, perm)
             v_cur = lax.ppermute(v_cur, axis_name, perm)
     out = attention_state_finish(*state)
     return out.astype(q.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _ring_pallas(q, k, v, axis_name, causal, sm_scale, block_k, axis_size):
+    return _ring_impl(q, k, v, True, axis_name, causal, sm_scale, block_k,
+                      axis_size)
+
+
+def _ring_pallas_fwd(q, k, v, axis_name, causal, sm_scale, block_k,
+                     axis_size):
+    out = _ring_impl(q, k, v, True, axis_name, causal, sm_scale, block_k,
+                     axis_size)
+    return out, (q, k, v)
+
+
+def _ring_pallas_bwd(axis_name, causal, sm_scale, block_k, axis_size, res,
+                     g):
+    q, k, v = res
+    _, vjp = jax.vjp(
+        lambda q_, k_, v_: _ring_impl(q_, k_, v_, False, axis_name, causal,
+                                      sm_scale, block_k, axis_size),
+        q, k, v)
+    return vjp(g)
+
+
+_ring_pallas.defvjp(_ring_pallas_fwd, _ring_pallas_bwd)
 
 
 def ulysses_attention(q, k, v, axis_name: str, causal: bool = False,
@@ -129,8 +176,17 @@ def make_sequence_parallel_attention(mesh: Mesh, scheme: str = "ring",
                                causal=causal)
     spec = P(None, None, axis_name, None)
 
+    kw = {}
+    from bigdl_tpu.ops import attention_kernel as ak
+    if scheme == "ring" and ak.INTERPRET:
+        # interpret-mode Pallas drops varying-axes types inside the carry
+        # kernel's loop (CPU test hook only; the real-TPU path keeps full
+        # vma checking). Older shard_map predates the kwarg.
+        import inspect as _inspect
+        if "check_vma" in _inspect.signature(shard_map).parameters:
+            kw["check_vma"] = False
     mapped = shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
-                       out_specs=spec)
+                       out_specs=spec, **kw)
     return mapped
 
 

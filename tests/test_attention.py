@@ -140,6 +140,55 @@ class TestPallasFlashBackward:
             np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                        rtol=3e-4, atol=3e-4, err_msg=name)
 
+    def test_carry_kernel_continues_softmax_across_shards(self):
+        """flash_attention_carry must continue ONE online softmax across
+        KV shards — the ring-attention hop — exactly matching dense
+        attention after the final normalize."""
+        from bigdl_tpu.ops.attention_kernel import (
+            attention_state_finish, attention_state_init,
+            flash_attention_carry)
+        rs = np.random.RandomState(3)
+        B, H, T, D = 1, 2, 256, 32
+        for causal in (False, True):
+            q, k, v = (jnp.asarray(rs.randn(B, H, T, D), jnp.float32) * 0.3
+                       for _ in range(3))
+            half = T // 2
+            state = attention_state_init(q)
+            for k_off in (0, half):
+                state = flash_attention_carry(
+                    q, k[:, :, k_off:k_off + half],
+                    v[:, :, k_off:k_off + half], state, causal=causal,
+                    k_offset=k_off, block_q=64, block_k=64,
+                    interpret=True)
+            out = attention_state_finish(*state)
+            ref = naive_attention(q, k, v, causal=causal)
+            np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                       rtol=3e-5, atol=3e-5)
+
+    def test_ring_attention_pallas_path(self, monkeypatch):
+        """Ring attention with the Pallas hop kernel (forced via
+        INTERPRET): forward parity vs dense AND gradients through the
+        custom_vjp (blockwise-recompute backward)."""
+        from bigdl_tpu.ops import attention_kernel as ak
+        monkeypatch.setattr(ak, "INTERPRET", True)
+        from jax.sharding import Mesh
+        from bigdl_tpu.parallel.sequence import (
+            make_sequence_parallel_attention)
+        mesh = Mesh(np.array(jax.devices()[:4]), ("data",))
+        rs = np.random.RandomState(4)
+        q, k, v = (jnp.asarray(rs.randn(1, 2, 256, 32), jnp.float32) * 0.3
+                   for _ in range(3))
+        attn = make_sequence_parallel_attention(mesh, "ring", causal=True)
+        out = attn(q, k, v)
+        ref = naive_attention(q, k, v, causal=True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=3e-5, atol=3e-5)
+        g = jax.grad(lambda q_: jnp.sum(attn(q_, k, v) ** 2))(q)
+        gr = jax.grad(lambda q_: jnp.sum(
+            naive_attention(q_, k, v, causal=True) ** 2))(q)
+        np.testing.assert_allclose(np.asarray(g), np.asarray(gr),
+                                   rtol=3e-4, atol=3e-4)
+
     def test_torch_sdpa_golden_fwd_bwd(self):
         """Cross-library oracle: torch scaled_dot_product_attention
         forward AND input gradients."""
