@@ -160,24 +160,39 @@ class BaseOptimizer:
         `shuffle()` call the original run made at each boundary — the
         iterator's per-pass permutations and the shuffles draw from the
         SAME dataset-owned seeded rng, so a fresh process reproduces the
-        identical draw sequence. Then the current epoch's consumed
-        records are skipped. Exact within the current pass; a checkpoint
-        taken exactly at an epoch boundary can differ by the one
-        prefetched batch the original run drew before its shuffle."""
+        identical draw sequence.
+
+        Interleaving detail that makes the replay EXACT: the live loops
+        prefetch one batch (the next iteration's) right after dispatching
+        a step, i.e. BEFORE the epoch-boundary bookkeeping runs
+        `dataset.shuffle()`. So at every boundary the original run drew
+        the next pass's permutation from the rng before the shuffle — the
+        replay peels that one batch ahead of each shuffle() to reproduce
+        the draw order, then credits it against the next epoch's consumed
+        records (chaining it back into the stream if the checkpoint
+        landed exactly on the boundary, where the prefetched batch was
+        never trained on)."""
         num_hosts = getattr(self.dataset, "num_hosts", 1)
-        epochs_done = max(0, driver_state.get("epoch", 1) - 1)
+        # driver_state["epoch"] is the live loops' 0-based completed-epoch
+        # counter (starts 0, +1 per boundary)
+        epochs_done = max(0, driver_state.get("epoch", 0))
         pass_items = self.dataset.size()
+        pending = None  # the boundary-prefetched batch, not yet credited
         for _ in range(epochs_done):
-            seen = 0
+            seen = pending.size() if pending is not None else 0
             while seen < pass_items:
                 b = next(data_iter, None)
                 if b is None:
                     return data_iter
-                seen += 1
+                seen += b.size()
+            pending = next(data_iter, None)  # live prefetch pre-shuffle
             self.dataset.shuffle()
         already = driver_state.get("recordsProcessedThisEpoch", 0) \
             // max(num_hosts, 1)
-        skipped = 0
+        skipped = pending.size() if pending is not None else 0
+        if pending is not None and skipped > already:
+            import itertools
+            return itertools.chain([pending], data_iter)
         while skipped < already:
             b = next(data_iter, None)
             if b is None:

@@ -302,9 +302,22 @@ class PipelineStages:
         """(padded [S, P_max] array, per-stage unravel fns, sizes).
         The unravel fns and sizes depend only on the param STRUCTURE, so
         they are cached — repeat calls with a pre-raveled array skip the
-        host-side ravel entirely (see train_step_1f1b)."""
+        host-side ravel entirely (see train_step_1f1b).
+
+        Constraint: the pipelined paths carry every stage's params and
+        grads through one padded float32 [S, P_max] array, so leaves
+        must round-trip float32 exactly (f32/bf16/f16). Wider or
+        integer leaves would silently lose precision — refuse them."""
         flats, unravels = [], []
         for p in params:
+            for leaf in jax.tree_util.tree_leaves(p):
+                d = jnp.asarray(leaf).dtype
+                if d not in (jnp.float32, jnp.bfloat16, jnp.float16):
+                    raise TypeError(
+                        f"PipelineStages params must be f32-compatible "
+                        f"(f32/bf16/f16); got leaf dtype {d}. Cast "
+                        f"integer buffers out of the param tree or use "
+                        f"the sequential apply() path.")
             flat, unravel = ravel_pytree(p)
             flats.append(flat)
             unravels.append(unravel)
@@ -464,10 +477,14 @@ class PipelineStages:
         pmax = stacked.shape[1]
         # memoize the traced step: rebuilding the shard_map function per
         # call would retrace (and recompile) every training step
-        fn_key = (id(mesh), x.shape, str(x.dtype), y.shape, str(y.dtype),
-                  id(loss_fn), training, pmax)
+        # the cache entry retains the mesh and loss_fn objects so the
+        # identity check below can never hit a recycled id() of a
+        # garbage-collected original
+        fn_key = (x.shape, str(x.dtype), y.shape, str(y.dtype),
+                  training, pmax)
         cached = getattr(self, "_1f1b_fn_cache", None)
-        if cached is not None and cached[0] == fn_key:
+        if (cached is not None and cached[0] == fn_key
+                and cached[2] is mesh and cached[3] is loss_fn):
             mapped = cached[1]
             gpad, loss_sum = mapped(stacked, micro_x, micro_y)
             grads = [unravels[s](gpad[s, :sizes[s]]) for s in range(S)]
@@ -617,7 +634,7 @@ class PipelineStages:
         mapped = jax.jit(shard_map(staged, mesh=mesh,
                                    in_specs=(P("pipe"), P(), P()),
                                    out_specs=(P("pipe"), P())))
-        self._1f1b_fn_cache = (fn_key, mapped)
+        self._1f1b_fn_cache = (fn_key, mapped, mesh, loss_fn)
         gpad, loss_sum = mapped(stacked, micro_x, micro_y)
         grads = [unravels[s](gpad[s, :sizes[s]])
                  for s in range(S)]

@@ -66,13 +66,17 @@ def save_checkpoint_sharded(path: str, model, params, model_state,
     manifest `checkpoint.py:latest_checkpoint` scans. Layout:
 
         <path>/<tag>/arrays/   orbax pytree {params, slots?, mstate?}
-        <path>/<tag>/optim.pkl optim state/hyper (no slots - those are
-                               device arrays and live in arrays/)
-        <path>/<tag>/manifest.json  {..., "sharded": true}
+        <path>/<tag>/optim.json       optim class/hyper/scalar state (no
+                                      slots - those are device arrays and
+                                      live in arrays/)
+        <path>/<tag>/optim_state.npz  array-valued optim state, if any
+        <path>/<tag>/manifest.json    {..., "sharded": true}
     """
+    import io
     import json
-    import pickle
     import time
+
+    import numpy as np
 
     from bigdl_tpu.utils import filesystem as fsys
 
@@ -88,14 +92,42 @@ def save_checkpoint_sharded(path: str, model, params, model_state,
         arrays["mstate"] = model_state
     save_sharded(fsys.join(ckpt_dir, "arrays"), arrays)
     if jax.process_index() == 0:
-        blob = {
+        state = dict(optim_method.state)
+        # the optim blob is only class name + scalar hypers + state
+        # counters/arrays, so it serializes as JSON + npz — unlike
+        # pickle this stays safe when the checkpoint root is a remote
+        # (possibly writable-by-others) bucket
+        state_arrays = {k: np.asarray(v) for k, v in state.items()
+                        if hasattr(v, "shape") and np.asarray(v).ndim > 0}
+        state_scalars = {}
+        for k, v in state.items():
+            if k in state_arrays:
+                continue
+            v = v.item() if hasattr(v, "item") else v
+            try:
+                json.dumps(v)  # scalars, lists, dicts — anything JSON
+                state_scalars[k] = v
+            except (TypeError, ValueError):
+                import warnings
+                warnings.warn(
+                    f"sharded checkpoint: optim state key {k!r} "
+                    f"({type(v).__name__}) is not JSON/npz-serializable "
+                    f"and will not survive resume")
+        blob_doc = {
             "class": type(optim_method).__name__,
-            "state": dict(optim_method.state),
+            "state": state_scalars,
+            "state_array_keys": sorted(state_arrays),
             "hyper": {k: v for k, v in vars(optim_method).items()
                       if isinstance(v, (int, float, bool, str))},
         }
-        with fsys.open_file(fsys.join(ckpt_dir, "optim.pkl"), "wb") as f:
-            pickle.dump(blob, f)
+        with fsys.open_file(fsys.join(ckpt_dir, "optim.json"), "w") as f:
+            json.dump(blob_doc, f, indent=2)
+        if state_arrays:
+            buf = io.BytesIO()
+            np.savez(buf, **state_arrays)
+            with fsys.open_file(fsys.join(ckpt_dir, "optim_state.npz"),
+                                "wb") as f:
+                f.write(buf.getvalue())
         manifest = {
             "format": "bigdl_tpu.checkpoint.v1",
             "model": getattr(model, "name", "model"),
@@ -115,8 +147,10 @@ def load_checkpoint_sharded(ckpt_dir: str):
     optimizer re-places them on its mesh) and returns
     (params, model_state, optim_blob) with slots folded into the blob
     under "slots" — the same contract the pickle loader provides."""
-    import pickle
+    import io
+    import json
 
+    import numpy as np
     import orbax.checkpoint as ocp
 
     from bigdl_tpu.utils import filesystem as fsys
@@ -125,7 +159,19 @@ def load_checkpoint_sharded(ckpt_dir: str):
         ckpt_dir = os.path.abspath(ckpt_dir)
     with ocp.StandardCheckpointer() as ckptr:
         arrays = ckptr.restore(fsys.join(ckpt_dir, "arrays"))
-    with fsys.open_file(fsys.join(ckpt_dir, "optim.pkl"), "rb") as f:
-        blob = pickle.load(f)
+    json_path = fsys.join(ckpt_dir, "optim.json")
+    if fsys.exists(json_path):
+        with fsys.open_file(json_path, "r") as f:
+            blob = json.load(f)
+        akeys = blob.pop("state_array_keys", [])
+        if akeys:
+            with fsys.open_file(fsys.join(ckpt_dir, "optim_state.npz"),
+                                "rb") as f:
+                npz = np.load(io.BytesIO(f.read()))
+            blob["state"].update({k: npz[k] for k in akeys})
+    else:  # pre-v5 checkpoints wrote the blob as a pickle
+        import pickle
+        with fsys.open_file(fsys.join(ckpt_dir, "optim.pkl"), "rb") as f:
+            blob = pickle.load(f)
     blob["slots"] = arrays.get("slots")
     return arrays["params"], arrays.get("mstate") or {}, blob

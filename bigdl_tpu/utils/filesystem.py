@@ -113,10 +113,30 @@ def remove(path: str) -> None:
 
 
 def glob(pattern: str) -> List[str]:
-    """Scheme-aware glob; remote results keep their scheme prefix."""
+    """Scheme-aware glob; remote results keep their scheme prefix.
+
+    fsspec's fs.glob strips the protocol and, for authority-based
+    schemes (hdfs://namenode:8020/...), the authority too — so the
+    authority from the input pattern is restored on the way out.
+    Bucket-based schemes (s3/gs) keep the bucket as the first path
+    component and need only the scheme re-prefixed.
+    """
     scheme, local = _split(pattern)
     if scheme is None:
         import glob as _glob
         return sorted(_glob.glob(local))
     fs = _fs(scheme)
-    return sorted(f"{scheme}://{p.lstrip('/')}" for p in fs.glob(pattern))
+    from urllib.parse import urlsplit
+    parts = urlsplit(pattern)
+    stripped = fs._strip_protocol(pattern)
+    authority_stripped = (parts.netloc
+                          and not stripped.lstrip("/").startswith(parts.netloc))
+    if authority_stripped:
+        prefix = f"{scheme}://{parts.netloc}/"
+    elif not parts.netloc and parts.path.startswith("/"):
+        # empty-authority form (hdfs:///user/...): keep the triple slash
+        # so the first path segment is not promoted to a host
+        prefix = f"{scheme}:///"
+    else:
+        prefix = f"{scheme}://"
+    return sorted(prefix + p.lstrip("/") for p in fs.glob(pattern))

@@ -763,6 +763,44 @@ class TestFailureRecovery:
         with pytest.raises(RuntimeError, match="persistent failure"):
             o.optimize()
 
+    @pytest.mark.parametrize("ck_iter", [6, 4, 9])
+    def test_resume_across_epoch_boundary_exact(self, tmp_path, ck_iter):
+        """Resuming a checkpoint taken AFTER >=1 epoch boundary must land
+        at the exact data position: _fast_forward_data replays completed
+        epochs in records (not batches), reproduces the live loop's
+        prefetch-before-shuffle rng draw order at each boundary, and
+        hands back the boundary-prefetched batch when the checkpoint sat
+        exactly on the boundary (ck_iter=4). Resumed params must equal
+        the uninterrupted oracle bit-for-bit."""
+        from bigdl_tpu.utils.random_generator import RNG
+        rs = np.random.RandomState(3)
+        X = rs.rand(64, 8).astype(np.float32)
+        Y = ((X @ (rs.rand(8) - 0.5) > 0).astype(np.int32) + 1)
+
+        def run(end_iter, ck=None, resume=False):
+            RNG.setSeed(42)  # identical init across runs
+            m = (nn.Sequential().add(nn.Linear(8, 16)).add(nn.ReLU())
+                 .add(nn.Linear(16, 2)).add(nn.LogSoftMax()))
+            o = optim.Optimizer(m, (X, Y), nn.ClassNLLCriterion(),
+                                batch_size=16, local=True)
+            o.set_optim_method(optim.SGD(learning_rate=0.1))
+            o.set_end_when(optim.max_iteration(end_iter))
+            if ck:
+                o.set_checkpoint(ck, optim.several_iteration(ck_iter))
+                if resume:
+                    assert o.resume_from_latest_checkpoint()
+            o.optimize()
+            return jax.tree_util.tree_leaves(m.ensure_params())
+
+        # 64 samples / batch 16 = 4 iters per epoch; ck_iter=6 is epoch 2
+        # mid-pass, 4 is the exact boundary, 9 is two boundaries deep
+        oracle = run(11)
+        ckdir = str(tmp_path / "ck")
+        run(ck_iter, ck=ckdir)
+        resumed = run(11, ck=ckdir, resume=True)
+        for a, b in zip(oracle, resumed):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
 
 class TestGradientAccumulation:
     """set_gradient_accumulation(n): n micro-batches inside the jitted
