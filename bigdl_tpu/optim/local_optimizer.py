@@ -149,6 +149,10 @@ class BaseOptimizer:
         restore_optim_method(self.optim_method, oblob)
         if oblob.get("slots") is not None:
             self._resume_slots = oblob["slots"]
+        # tells the next optimize()'s _fast_forward_data that completed
+        # epochs must be replayed (fresh process, dataset rng at origin) —
+        # a warm re-optimize() on a live instance must NOT replay
+        self._resumed = True
         return True
 
     def _fast_forward_data(self, data_iter, driver_state):
@@ -173,9 +177,17 @@ class BaseOptimizer:
         landed exactly on the boundary, where the prefetched batch was
         never trained on)."""
         num_hosts = getattr(self.dataset, "num_hosts", 1)
-        # driver_state["epoch"] is the live loops' 0-based completed-epoch
-        # counter (starts 0, +1 per boundary)
-        epochs_done = max(0, driver_state.get("epoch", 0))
+        # Completed-epoch replay applies only to a COLD resume (fresh
+        # process, dataset rng at its origin). A warm re-optimize() on a
+        # live instance continues with an already-advanced dataset rng —
+        # replaying there would burn a pass of host fetches and shuffle
+        # the stream out from under epoch 2. driver_state["epoch"] is the
+        # live loops' 0-based completed-epoch counter (starts 0, +1 per
+        # boundary).
+        cold_resume = getattr(self, "_resumed", False)
+        self._resumed = False
+        epochs_done = max(0, driver_state.get("epoch", 0)) if cold_resume \
+            else 0
         pass_items = self.dataset.size()
         pending = None  # the boundary-prefetched batch, not yet credited
         for _ in range(epochs_done):

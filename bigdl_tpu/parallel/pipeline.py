@@ -311,7 +311,7 @@ class PipelineStages:
         flats, unravels = [], []
         for p in params:
             for leaf in jax.tree_util.tree_leaves(p):
-                d = jnp.asarray(leaf).dtype
+                d = jnp.result_type(leaf)  # no device materialization
                 if d not in (jnp.float32, jnp.bfloat16, jnp.float16):
                     raise TypeError(
                         f"PipelineStages params must be f32-compatible "

@@ -129,8 +129,8 @@ def glob(pattern: str) -> List[str]:
     from urllib.parse import urlsplit
     parts = urlsplit(pattern)
     stripped = fs._strip_protocol(pattern)
-    authority_stripped = (parts.netloc
-                          and not stripped.lstrip("/").startswith(parts.netloc))
+    first_component = stripped.lstrip("/").split("/", 1)[0]
+    authority_stripped = bool(parts.netloc) and first_component != parts.netloc
     if authority_stripped:
         prefix = f"{scheme}://{parts.netloc}/"
     elif not parts.netloc and parts.path.startswith("/"):

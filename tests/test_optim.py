@@ -801,6 +801,47 @@ class TestFailureRecovery:
         for a, b in zip(oracle, resumed):
             np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
 
+    def test_warm_reoptimize_does_not_replay(self):
+        """Calling optimize() again on a live instance (warm
+        continuation: extend the end trigger and keep going) must NOT
+        run the cold-resume epoch replay — that would burn a full pass
+        of host fetches and an extra shuffle per completed epoch."""
+        from bigdl_tpu.dataset.dataset import LocalDataSet
+        from bigdl_tpu.dataset.sample import Sample
+
+        rs = np.random.RandomState(5)
+        X = rs.rand(64, 4).astype(np.float32)
+        Y = (rs.randint(0, 2, size=64) + 1).astype(np.int32)
+
+        class CountingDataSet(LocalDataSet):
+            drawn = 0
+
+            def data(self, train):
+                base = super().data(train)
+
+                def counted():
+                    for s in base:
+                        CountingDataSet.drawn += 1
+                        yield s
+                return counted() if train else base
+
+        ds = CountingDataSet([Sample(X[i], Y[i]) for i in range(64)]) \
+            .transform(SampleToMiniBatch(16))
+        m = nn.Sequential().add(nn.Linear(4, 2)).add(nn.LogSoftMax())
+        from bigdl_tpu.optim.local_optimizer import LocalOptimizer
+        o = LocalOptimizer(m, ds, nn.ClassNLLCriterion(), batch_size=16)
+        o.set_optim_method(optim.SGD(learning_rate=0.1))
+        o.set_end_when(optim.max_epoch(1))
+        o.optimize()
+        after_first = CountingDataSet.drawn
+        o.set_end_when(optim.max_epoch(2))
+        o.optimize()  # warm continuation: 1 more epoch
+        drawn_second = CountingDataSet.drawn - after_first
+        # one epoch = 64 samples over 4 batches, plus at most 2 batches
+        # of prefetch lookahead; a replay bug would add a full 64 more
+        assert drawn_second <= 6 * 16, drawn_second
+        assert o.optim_method.state["epoch"] >= 2
+
 
 class TestGradientAccumulation:
     """set_gradient_accumulation(n): n micro-batches inside the jitted
