@@ -14,8 +14,8 @@ the mutation exactly like Torch storage sharing.
 
 from bigdl_tpu.tensor.numeric import TensorNumeric
 from bigdl_tpu.tensor.tensor import Storage, Tensor
-from bigdl_tpu.tensor.sparse import SparseTensor
+from bigdl_tpu.tensor.sparse import SparseTensor, SparseTensorMath
 from bigdl_tpu.tensor.quantized import QuantizedTensor
 
-__all__ = ["Tensor", "Storage", "SparseTensor", "QuantizedTensor",
-           "TensorNumeric"]
+__all__ = ["Tensor", "Storage", "SparseTensor", "SparseTensorMath",
+           "QuantizedTensor", "TensorNumeric"]

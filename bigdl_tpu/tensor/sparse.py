@@ -2,8 +2,15 @@
 
 Parity: `SparseTensor` (DL/tensor/SparseTensor.scala, 1463 LoC) — COO sparse
 tensor backing `nn.SparseLinear` / `LookupTableSparse` / `SparseJoinTable`
-(the Wide&Deep building blocks), with `SparseTensorMath.addmm` for
-sparse-matrix x dense-matrix products.
+(the Wide&Deep building blocks), with the full `SparseTensorMath` /
+`SparseTensorBLAS` entry surface (vdot, addmv/coomv, addmm/coomm in BOTH
+orderings: sparse x dense and dense x sparse, SparseTensorBLAS.scala:232,348).
+
+Scope note: of the reference SparseTensor's ~130 overrides, 108 throw
+UnsupportedOperationException — the REAL surface is ~24 methods (apply,
+applyFun, cast, concat, dim, dot, equals, nElement, narrow,
+numNonZeroByRow, resize, set, size, storage, sum, toTensor, ...) plus the
+three BLAS products. That is the surface implemented here.
 
 TPU-first: values/indices are dense jax arrays (one int32 array per dim), so
 every op lowers to gather/segment_sum — XLA-friendly, static-shaped when nnz
@@ -124,18 +131,212 @@ class SparseTensor:
         if self.dim() != 2:
             raise ValueError("addmm needs a 2-D sparse tensor")
         rows, cols = self.indices
-        dense = dense_mat if isinstance(dense_mat, jnp.ndarray) else \
-            jnp.asarray(getattr(dense_mat, "to_jax", lambda: dense_mat)())
+        dense = _as_jax(dense_mat)
+        if dense.ndim != 2 or dense.shape[0] != self.shape[1]:
+            raise ValueError(
+                f"dense {dense.shape} incompatible with sparse "
+                f"{self.shape}")
         contrib = self.values[:, None] * dense[cols]  # [nnz, out_dim]
         prod = jax.ops.segment_sum(contrib, rows, num_segments=self.shape[0])
         if out is not None and beta != 0.0:
-            base = out if isinstance(out, jnp.ndarray) else out.to_jax()
-            return beta * base + alpha * prod
+            return beta * _as_jax(out) + alpha * prod
         return alpha * prod
+
+    def addmv(self, dense_vec, beta: float = 0.0, alpha: float = 1.0,
+              out=None) -> jnp.ndarray:
+        """alpha * (self @ vec) + beta * out for a 2-D sparse self
+        (SparseTensorMath.addmv -> SparseTensorBLAS.coomv)."""
+        if self.dim() != 2:
+            raise ValueError("addmv needs a 2-D sparse tensor")
+        vec = _as_jax(dense_vec)
+        if vec.ndim != 1 or vec.shape[0] != self.shape[1]:
+            raise ValueError(
+                f"vec shape {vec.shape} incompatible with {self.shape}")
+        rows, cols = self.indices
+        contrib = self.values * vec[cols]  # [nnz]
+        prod = jax.ops.segment_sum(contrib, rows, num_segments=self.shape[0])
+        if out is not None and beta != 0.0:
+            return beta * _as_jax(out) + alpha * prod
+        return alpha * prod
+
+    def dot(self, dense_vec) -> jnp.ndarray:
+        """Sparse-dense inner product over a flat index space
+        (SparseTensorBLAS.vdot): only the stored coordinates contribute."""
+        vec = _as_jax(dense_vec)
+        if not self.nnz():
+            return jnp.zeros((), self.values.dtype)
+        if self.nElement() > np.iinfo(np.int32).max:
+            # the linearized coordinate would overflow int32 (jax's
+            # default index dtype with x64 disabled) — refuse loudly
+            # rather than gather from silently-wrapped indices
+            raise ValueError(
+                f"dot: flat index space {self.shape} exceeds int32; "
+                f"slice the tensor or enable jax x64")
+        # linearize the COO coordinates into the dense vec's layout
+        lin = jnp.zeros_like(self.indices[0])
+        stride = 1
+        for d in range(self.dim() - 1, -1, -1):
+            lin = lin + self.indices[d] * stride
+            stride *= self.shape[d]
+        return jnp.sum(self.values * vec.reshape(-1)[lin])
+
+    def sum(self, dim=None):
+        """Total sum, or (Torch semantics) the sum ALONG 1-based `dim`:
+        the result is dense with `dim` collapsed — e.g. a [R, C] sparse
+        summed over dim 2 gives the length-R per-row sums
+        (SparseTensor.scala:550's overload scatter-adds by the KEPT
+        dim's coordinate)."""
+        if dim is None:
+            return jnp.sum(self.values)
+        d = dim - 1
+        rest = [i for i in range(self.dim()) if i != d]
+        if not rest:
+            return jnp.sum(self.values)
+        lin = jnp.zeros_like(self.indices[0])
+        stride = 1
+        for i in reversed(rest):
+            lin = lin + self.indices[i] * stride
+            stride *= self.shape[i]
+        out = jax.ops.segment_sum(self.values, lin, num_segments=stride)
+        return out.reshape(tuple(self.shape[i] for i in rest))
+
+    def num_non_zero_by_row(self) -> jnp.ndarray:
+        """Per-row stored-entry counts (SparseTensor.numNonZeroByRow —
+        feeds LookupTableSparse's bag sizes)."""
+        return jax.ops.segment_sum(jnp.ones_like(self.indices[0]),
+                                   self.indices[0],
+                                   num_segments=self.shape[0])
+
+    numNonZeroByRow = num_non_zero_by_row
+
+    def cast(self, dtype) -> "SparseTensor":
+        return SparseTensor(self.indices, self.values.astype(dtype),
+                            self.shape)
+
+    def apply_fun(self, func) -> "SparseTensor":
+        """Elementwise map over STORED values only (reference applyFun
+        semantics: the function is not applied to implicit zeros)."""
+        return SparseTensor(self.indices, func(self.values), self.shape)
+
+    applyFun = apply_fun
+    apply1 = apply_fun
+
+    def get(self, *indexes) -> float:
+        """1-based element access (reference `apply(indexes)`): the stored
+        value at the coordinate, or 0 for an implicit zero."""
+        if len(indexes) != self.dim():
+            raise ValueError(f"need {self.dim()} indexes")
+        hit = np.ones(self.nnz(), bool)
+        for d, ix in enumerate(indexes):
+            hit &= np.asarray(self.indices[d]) == (int(ix) - 1)
+        k = np.nonzero(hit)[0]
+        return float(np.asarray(self.values)[k].sum()) if k.size else 0.0
+
+    def resize(self, shape: Sequence[int], nnz: int = None) -> "SparseTensor":
+        """Re-shape the index space in place; with `nnz`, re-allocate the
+        storage to that many (zeroed) entries (reference resize +
+        resizeIndices). Shrinking drops entries whose coordinates fall
+        outside the new bounds (jax's clip-mode scatters would otherwise
+        silently fold them into edge cells)."""
+        self.shape = tuple(int(s) for s in shape)
+        if nnz is not None and nnz != self.nnz():
+            self.indices = tuple(jnp.zeros((nnz,), jnp.int32)
+                                 for _ in self.shape)
+            self.values = jnp.zeros((nnz,), self.values.dtype)
+        elif len(self.indices) != len(self.shape):
+            self.indices = tuple(jnp.zeros((self.nnz(),), jnp.int32)
+                                 for _ in self.shape)
+        elif self.nnz():
+            keep = np.ones(self.nnz(), bool)
+            for d, ix in enumerate(self.indices):
+                keep &= np.asarray(ix) < self.shape[d]
+            if not keep.all():
+                self.indices = tuple(jnp.asarray(np.asarray(ix)[keep])
+                                     for ix in self.indices)
+                self.values = jnp.asarray(np.asarray(self.values)[keep])
+        return self
+
+    def set_(self, other: "SparseTensor") -> "SparseTensor":
+        """Adopt `other`'s storage (reference `set`)."""
+        self.indices = other.indices
+        self.values = other.values
+        self.shape = other.shape
+        return self
+
+    def copy_(self, other: "SparseTensor") -> "SparseTensor":
+        """Copy `other`'s entries into this tensor (reference `copy`)."""
+        self.indices = tuple(jnp.asarray(ix, jnp.int32)
+                             for ix in other.indices)
+        self.values = jnp.asarray(other.values)
+        return self
+
+    def __eq__(self, other):
+        if not isinstance(other, SparseTensor):
+            return NotImplemented
+        return (self.shape == other.shape
+                and all(bool(jnp.array_equal(a, b))
+                        for a, b in zip(self.indices, other.indices))
+                and bool(jnp.array_equal(self.values, other.values)))
+
+    # mutable container (resize/set_ rebind storage) — unhashable, like list
+    __hash__ = None
 
     def __mul__(self, scalar):
         return SparseTensor(self.indices, self.values * scalar, self.shape)
 
+    __rmul__ = __mul__
+
+    def __truediv__(self, scalar):
+        return SparseTensor(self.indices, self.values / scalar, self.shape)
+
     def __repr__(self):
         return (f"SparseTensor(shape={list(self.shape)}, nnz={self.nnz()}, "
                 f"dtype={self.values.dtype})")
+
+
+def _as_jax(x) -> jnp.ndarray:
+    if isinstance(x, jnp.ndarray):
+        return x
+    to_jax = getattr(x, "to_jax", None)
+    return to_jax() if to_jax is not None else jnp.asarray(x)
+
+
+class SparseTensorMath:
+    """Module-level product entry points mirroring
+    DL/tensor/SparseTensorMath.scala (each dispatches on which operand is
+    sparse, like SparseTensorBLAS's paired scoomm overloads)."""
+
+    @staticmethod
+    def vdot(dense_vec, sparse: SparseTensor):
+        return sparse.dot(dense_vec)
+
+    @staticmethod
+    def addmv(beta: float, t, alpha: float, mat: SparseTensor, vec
+              ) -> jnp.ndarray:
+        """beta * t + alpha * (sparse mat @ dense vec)."""
+        return mat.addmv(vec, beta=beta, alpha=alpha, out=t)
+
+    @staticmethod
+    def addmm(beta: float, mat3, alpha: float, mat1, mat2) -> jnp.ndarray:
+        """beta * mat3 + alpha * (mat1 @ mat2) with EITHER operand sparse
+        (SparseTensorBLAS.scala:232 sparse x dense, :348 dense x sparse)."""
+        if isinstance(mat1, SparseTensor):
+            return mat1.addmm(mat2, beta=beta, alpha=alpha, out=mat3)
+        if not isinstance(mat2, SparseTensor):
+            raise TypeError("one of mat1/mat2 must be a SparseTensor")
+        if mat2.dim() != 2:
+            raise ValueError("addmm needs a 2-D sparse tensor")
+        dense = _as_jax(mat1)
+        if dense.ndim != 2 or dense.shape[1] != mat2.shape[0]:
+            raise ValueError(
+                f"dense {dense.shape} incompatible with sparse "
+                f"{mat2.shape}")
+        rows, cols = mat2.indices
+        # dense [M, K] x sparse [K, N]: entry (r, c, v) adds v * dense[:, r]
+        # into out column c -> segment_sum over column ids
+        contrib = mat2.values[:, None] * dense[:, rows].T  # [nnz, M]
+        prod = jax.ops.segment_sum(contrib, cols,
+                                   num_segments=mat2.shape[1]).T  # [M, N]
+        if mat3 is not None and beta != 0.0:
+            return beta * _as_jax(mat3) + alpha * prod
+        return alpha * prod
