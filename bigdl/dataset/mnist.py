@@ -81,3 +81,14 @@ def read_data_sets(train_dir, data_type="train"):
         images = extract_images(_open(train_dir, TEST_IMAGES))
         labels = extract_labels(_open(train_dir, TEST_LABELS))
     return images, labels
+
+
+def load_data(location="/tmp/mnist"):
+    """((X_train, Y_train), (X_test, Y_test)): normalized images,
+    1-based labels (reference load_data)."""
+    from bigdl.dataset.transformer import normalizer
+    (train_images, train_labels) = read_data_sets(location, "train")
+    (test_images, test_labels) = read_data_sets(location, "test")
+    X_train = normalizer(train_images, TRAIN_MEAN, TRAIN_STD)
+    X_test = normalizer(test_images, TRAIN_MEAN, TRAIN_STD)
+    return (X_train, train_labels + 1), (X_test, test_labels + 1)

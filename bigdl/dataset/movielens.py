@@ -1,0 +1,46 @@
+"""pyspark-BigDL API compatibility: `bigdl.dataset.movielens`.
+
+Parity: reference pyspark/bigdl/dataset/movielens.py — the MovieLens-1M
+ratings parser feeding the NCF/recommender examples. Zero-egress build:
+resolves an already-staged ml-1m.zip (or extracted ml-1m/ directory);
+the "::"-separated ratings.dat parse and the int ndarray contract are
+identical.
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from bigdl.dataset import base
+
+SOURCE_URL = 'http://files.grouplens.org/datasets/movielens/'
+
+
+def read_data_sets(data_dir):
+    """[N, 4] int array of (user, item, rating, timestamp) rows."""
+    extracted_to = os.path.join(data_dir, "ml-1m")
+    if not os.path.exists(extracted_to):
+        import zipfile
+        local_file = base.maybe_download('ml-1m.zip', data_dir,
+                                         SOURCE_URL + 'ml-1m.zip')
+        with zipfile.ZipFile(local_file, 'r') as zip_ref:
+            print("Extracting %s to %s" % (local_file, data_dir))
+            zip_ref.extractall(data_dir)
+    rating_files = os.path.join(extracted_to, "ratings.dat")
+    with open(rating_files, "r") as f:
+        rating_list = [i.strip().split("::") for i in f.readlines()]
+    return np.array(rating_list).astype(int)
+
+
+def get_id_pairs(data_dir):
+    return read_data_sets(data_dir)[:, 0:2]
+
+
+def get_id_ratings(data_dir):
+    return read_data_sets(data_dir)[:, 0:3]
+
+
+if __name__ == "__main__":
+    movielens_data = read_data_sets("/tmp/movielens/")

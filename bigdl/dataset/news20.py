@@ -1,0 +1,82 @@
+"""pyspark-BigDL API compatibility: `bigdl.dataset.news20`.
+
+Parity: reference pyspark/bigdl/dataset/news20.py — the 20 Newsgroups
+corpus + GloVe embeddings used by the textclassifier example. Zero-egress
+build: the download step resolves already-staged archives (or extracted
+directories) and raises with staging instructions otherwise; the parsing
+contract — (text, 1-based label) pairs from per-class directories, and a
+word -> vector dict from the GloVe txt — is identical.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import tarfile
+
+from bigdl.dataset import base
+
+NEWS20_URL = 'http://qwone.com/~jason/20Newsgroups/20news-18828.tar.gz'
+GLOVE_URL = 'http://nlp.stanford.edu/data/glove.6B.zip'
+
+CLASS_NUM = 20
+
+
+def download_news20(dest_dir):
+    extracted_to = os.path.join(dest_dir, "20news-18828")
+    if os.path.exists(extracted_to):
+        return extracted_to
+    file_abs_path = base.maybe_download("20news-18828.tar.gz", dest_dir,
+                                        NEWS20_URL)
+    with tarfile.open(file_abs_path, "r:gz") as tar:
+        print("Extracting %s to %s" % (file_abs_path, extracted_to))
+        tar.extractall(dest_dir)
+    return extracted_to
+
+
+def download_glove_w2v(dest_dir):
+    import zipfile
+    extracted_to = os.path.join(dest_dir, "glove.6B")
+    if os.path.exists(extracted_to):
+        return extracted_to
+    file_abs_path = base.maybe_download("glove.6B.zip", dest_dir, GLOVE_URL)
+    with zipfile.ZipFile(file_abs_path, 'r') as zip_ref:
+        print("Extracting %s to %s" % (file_abs_path, extracted_to))
+        zip_ref.extractall(extracted_to)
+    return extracted_to
+
+
+def get_news20(source_dir="./data/news20/"):
+    """A list of (text, 1-based label) from the per-class directories
+    (file names are message ids, i.e. digits)."""
+    news_dir = download_news20(source_dir)
+    texts = []
+    label_id = 0
+    for name in sorted(os.listdir(news_dir)):
+        path = os.path.join(news_dir, name)
+        label_id += 1
+        if os.path.isdir(path):
+            for fname in sorted(os.listdir(path)):
+                if fname.isdigit():
+                    fpath = os.path.join(path, fname)
+                    with open(fpath, encoding='latin-1') as f:
+                        texts.append((f.read(), label_id))
+    print('Found %s texts.' % len(texts))
+    return texts
+
+
+def get_glove_w2v(source_dir="./data/news20/", dim=100):
+    """word -> list[float] from the staged glove.6B.<dim>d.txt."""
+    w2v_dir = download_glove_w2v(source_dir)
+    w2v_path = os.path.join(w2v_dir, "glove.6B.%sd.txt" % dim)
+    pre_w2v = {}
+    with open(w2v_path, encoding='latin-1') as w2v_f:
+        for line in w2v_f:
+            items = line.split(" ")
+            pre_w2v[items[0]] = [float(i) for i in items[1:]]
+    return pre_w2v
+
+
+if __name__ == "__main__":
+    get_news20("./data/news20/")
+    get_glove_w2v("./data/news20/")

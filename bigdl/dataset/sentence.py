@@ -1,0 +1,49 @@
+"""pyspark-BigDL API compatibility: `bigdl.dataset.sentence`.
+
+Parity: reference pyspark/bigdl/dataset/sentence.py — sentence
+splitting/tokenizing for the RNN language-model example. Uses nltk like
+the reference when its Punkt data is available, with a regex fallback so
+the functions work without downloaded nltk corpora (zero-egress build).
+"""
+
+from __future__ import annotations
+
+import itertools  # noqa: F401  (reference module re-exported it)
+import os
+import re
+import sys  # noqa: F401
+
+
+def read_localfile(fileName):
+    lines = []
+    with open(fileName) as f:
+        for line in f:
+            lines.append(line)
+    return lines
+
+
+def sentences_split(line):
+    try:
+        import nltk
+        nltk.data.path.append(os.environ.get('PWD'))
+        sent_tokenizer = nltk.tokenize.PunktSentenceTokenizer()
+        return sent_tokenizer.tokenize(line)
+    except LookupError:
+        pass
+    except ImportError:
+        pass
+    # fallback: split on sentence-final punctuation (keeps the delimiter)
+    parts = re.split(r'(?<=[.!?])\s+', line.strip())
+    return [p for p in parts if p]
+
+
+def sentences_bipadding(sent):
+    return "SENTENCESTART " + sent + " SENTENCEEND"
+
+
+def sentence_tokenizer(sentences):
+    try:
+        import nltk
+        return nltk.word_tokenize(sentences)
+    except (ImportError, LookupError):
+        return re.findall(r"\w+|[^\w\s]", sentences)

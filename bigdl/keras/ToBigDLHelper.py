@@ -1,0 +1,63 @@
+"""pyspark-BigDL API compatibility: `bigdl.keras.ToBigDLHelper`.
+
+Parity: reference pyspark/bigdl/keras/ToBigDLHelper.py — small
+Keras->BigDL translation helpers: dim-ordering strings, border-mode ->
+padding, init-method and regularizer mapping.
+"""
+
+from __future__ import annotations
+
+import bigdl.nn.initialization_method as BInit
+from bigdl.optim.optimizer import L1L2Regularizer as BRegularizer
+
+
+def to_bigdl_2d_ordering(order):
+    if order == "tf":
+        return "NHWC"
+    if order == "th":
+        return "NCHW"
+    raise Exception("Unsupported dim_ordering: %s" % order)
+
+
+def to_bigdl_3d_ordering(order):
+    if order == "tf":
+        return "channel_last"
+    if order == "th":
+        return "channel_first"
+    raise Exception("Unsupported dim_ordering: %s" % order)
+
+
+def to_bigdl_3d_padding(border_mode):
+    if border_mode == "valid":
+        return 0, 0
+    if border_mode == "same":
+        return -1, -1  # sentinel: compute SAME padding in the layer
+    raise Exception("Unsupported border mode: %s" % border_mode)
+
+
+def to_bigdl_2d_padding(border_mode, *args):
+    if border_mode == "same":
+        return -1, -1  # BigDL's SAME sentinel
+    if border_mode == "valid":
+        return 0, 0
+    raise Exception("Unsupported border mode: %s" % border_mode)
+
+
+def to_bigdl_init(kinit_method):
+    if kinit_method == "glorot_uniform":
+        return BInit.Xavier()
+    if kinit_method == "one":
+        return BInit.Ones()
+    if kinit_method == "zero":
+        return BInit.Zeros()
+    if kinit_method == "uniform":
+        return BInit.RandomUniform(lower=-0.05, upper=0.05)
+    if kinit_method == "normal":
+        return BInit.RandomNormal(mean=0.0, stdv=0.05)
+    raise Exception("Unsupported init type: %s" % kinit_method)
+
+
+def to_bigdl_reg(reg):
+    if reg:
+        return BRegularizer(reg.get('l1', 0.0), reg.get('l2', 0.0))
+    return None

@@ -1,0 +1,10 @@
+"""pyspark-BigDL API compatibility: `bigdl.keras`.
+
+Parity: reference pyspark/bigdl/keras — the Keras-1.2.2 model converter
+namespace (DefinitionLoader/WeightLoader in converter.py, the
+keras-object training facade in backend.py, loss/optimizer mapping in
+optimization.py, and the small translation helpers in ToBigDLHelper.py).
+The conversion machinery itself lives in
+bigdl_tpu/interop/keras_converter.py; this package is the reference
+import surface over it.
+"""

@@ -1,0 +1,108 @@
+"""pyspark-BigDL API compatibility: `bigdl.keras.backend`.
+
+Parity: reference pyspark/bigdl/keras/backend.py — `KerasModelWrapper` /
+`with_bigdl_backend`: take a compiled Keras-1.2.2 model object and run
+its fit/predict/evaluate on the BigDL stack. Declared delta: the
+reference's distributed mode consumes RDD[Sample]; this runtime is
+Spark-free, so ndarray (local-mode) inputs are the supported path and
+`is_distributed=True` raises with that explanation.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from bigdl.keras.converter import DefinitionLoader, WeightLoader
+from bigdl.keras.optimization import OptimConverter
+from bigdl.util.common import (init_engine, redire_spark_logs,
+                               show_bigdl_info_logs)
+
+
+def _no_rdd(flag):
+    if flag:
+        raise Exception(
+            "is_distributed=True needs Spark RDDs; this build is "
+            "Spark-free — pass ndarrays (local mode)")
+
+
+class KerasModelWrapper:
+
+    def __init__(self, kmodel):
+        redire_spark_logs()
+        show_bigdl_info_logs()
+        init_engine()
+        self.bmodel = DefinitionLoader.from_kmodel(kmodel)
+        WeightLoader.load_weights_from_kmodel(self.bmodel, kmodel)
+        kloss = getattr(kmodel, "loss", None)
+        self.criterion = OptimConverter.to_bigdl_criterion(kloss) \
+            if kloss else None
+        kopt = getattr(kmodel, "optimizer", None)
+        self.optim_method = OptimConverter.to_bigdl_optim_method(kopt) \
+            if kopt else None
+        kmetrics = getattr(kmodel, "metrics", None)
+        self.metrics = OptimConverter.to_bigdl_metrics(kmetrics) \
+            if kmetrics else None
+
+    def predict(self, x, batch_size=None, verbose=None,
+                is_distributed=False):
+        _no_rdd(is_distributed)
+        if not isinstance(x, (np.ndarray, list)):
+            raise Exception("not supported type: %s" % type(x).__name__)
+        return self.bmodel.predict_local(x)
+
+    def evaluate(self, x, y, batch_size=32, sample_weight=None,
+                 is_distributed=False):
+        if sample_weight is not None:
+            raise Exception("unsupported: sample_weight")
+        _no_rdd(is_distributed)
+        if not self.metrics:
+            raise Exception("No Metrics found.")
+        from bigdl.optim.optimizer import _as_validation_set
+        results = self.bmodel.evaluate_local(x, y, batch_size, self.metrics) \
+            if hasattr(self.bmodel, "evaluate_local") else \
+            self._evaluate_local(x, y, batch_size)
+        return results
+
+    def _evaluate_local(self, x, y, batch_size):
+        from bigdl_tpu.dataset.dataset import DataSet
+        res = self.bmodel.value.evaluate_on(
+            DataSet.from_arrays(np.asarray(x), np.asarray(y)),
+            [m.value if hasattr(m, "value") else m for m in self.metrics],
+            batch_size=batch_size)
+        return [r.result()[0] for r in res]
+
+    def fit(self, x, y=None, batch_size=32, nb_epoch=10, verbose=1,
+            callbacks=None, validation_split=0., validation_data=None,
+            shuffle=True, class_weight=None, sample_weight=None,
+            initial_epoch=0, is_distributed=False):
+        if callbacks:
+            raise Exception("We don't support callbacks in fit for now")
+        if class_weight or sample_weight or initial_epoch or \
+                validation_split:
+            raise Exception(
+                "unsupported fit arguments: class_weight / sample_weight "
+                "/ initial_epoch / validation_split")
+        _no_rdd(is_distributed)
+        from bigdl.optim.optimizer import (EveryEpoch, MaxEpoch, Optimizer,
+                                           SGD)
+        optimizer = Optimizer.create(
+            model=self.bmodel,
+            training_set=(np.asarray(x), np.asarray(y)),
+            criterion=self.criterion,
+            optim_method=self.optim_method or SGD(),
+            end_trigger=MaxEpoch(nb_epoch),
+            batch_size=batch_size)
+        if validation_data is not None and self.metrics:
+            vx, vy = validation_data
+            optimizer.set_validation(
+                batch_size=batch_size, X_val=np.asarray(vx),
+                Y_val=np.asarray(vy), trigger=EveryEpoch(),
+                val_method=self.metrics)
+        optimizer.optimize()
+        return self
+
+
+def with_bigdl_backend(kmodel):
+    """Compile-and-swap: returns a wrapper whose fit/evaluate/predict run
+    on this framework (reference with_bigdl_backend)."""
+    return KerasModelWrapper(kmodel)

@@ -1,0 +1,117 @@
+"""pyspark-BigDL API compatibility: `bigdl.keras.optimization`.
+
+Parity: reference pyspark/bigdl/keras/optimization.py — OptimConverter
+maps Keras losses / optimizers / metrics onto BigDL counterparts. The
+loss table matches the reference's; optimizer objects are read via
+duck-typed attrs (lr/decay/momentum/...) so both Keras-1 objects and
+plain namespaces convert.
+"""
+
+from __future__ import annotations
+
+import warnings
+
+import bigdl.nn.criterion as bcriterion
+import bigdl.optim.optimizer as boptimizer
+from bigdl.util.common import to_list
+
+
+def _num(v):
+    """Read a keras hyperparameter: plain number or backend variable."""
+    try:
+        return float(v)
+    except TypeError:
+        pass
+    try:
+        from keras import backend as K
+        return float(K.eval(v))
+    except Exception:
+        return float(getattr(v, "value", lambda: v)())
+
+
+class OptimConverter:
+
+    @staticmethod
+    def to_bigdl_metrics(metrics):
+        bmetrics = []
+        for metric in to_list(metrics):
+            if metric == "accuracy":
+                bmetrics.append(boptimizer.Top1Accuracy())
+            else:
+                raise Exception("Unsupported metric: %s" % metric)
+        return bmetrics
+
+    _LOSSES = {
+        "categorical_crossentropy": lambda: bcriterion.CategoricalCrossEntropy(),
+        "mse": lambda: bcriterion.MSECriterion(),
+        "mean_squared_error": lambda: bcriterion.MSECriterion(),
+        "binary_crossentropy": lambda: bcriterion.BCECriterion(),
+        "mae": lambda: bcriterion.AbsCriterion(),
+        "mean_absolute_error": lambda: bcriterion.AbsCriterion(),
+        "hinge": lambda: bcriterion.MarginCriterion(),
+        "squared_hinge": lambda: bcriterion.MarginCriterion(squared=True),
+        "mean_absolute_percentage_error":
+            lambda: bcriterion.MeanAbsolutePercentageCriterion(),
+        "mape": lambda: bcriterion.MeanAbsolutePercentageCriterion(),
+        "mean_squared_logarithmic_error":
+            lambda: bcriterion.MeanSquaredLogarithmicCriterion(),
+        "msle": lambda: bcriterion.MeanSquaredLogarithmicCriterion(),
+        "sparse_categorical_crossentropy":
+            lambda: bcriterion.ClassNLLCriterion(logProbAsInput=False),
+        "kullback_leibler_divergence":
+            lambda: bcriterion.KullbackLeiblerDivergenceCriterion(),
+        "kld": lambda: bcriterion.KullbackLeiblerDivergenceCriterion(),
+        "poisson": lambda: bcriterion.PoissonCriterion(),
+        "cosine_proximity": lambda: bcriterion.CosineProximityCriterion(),
+        "cosine": lambda: bcriterion.CosineProximityCriterion(),
+    }
+
+    @staticmethod
+    def to_bigdl_criterion(kloss):
+        name = kloss if isinstance(kloss, str) else \
+            getattr(kloss, "__name__", str(kloss))
+        make = OptimConverter._LOSSES.get(name.lower())
+        if make is None:
+            raise Exception("Not supported loss: %s" % kloss)
+        return make()
+
+    @staticmethod
+    def to_bigdl_optim_method(koptim_method):
+        cls = type(koptim_method).__name__
+        lr = _num(getattr(koptim_method, "lr", 0.01))
+        decay = _num(getattr(koptim_method, "decay", 0.0))
+        if cls == "Adagrad":
+            warnings.warn("For Adagrad, we don't support epsilon for now")
+            return boptimizer.Adagrad(learningrate=lr,
+                                      learningrate_decay=decay)
+        if cls == "SGD":
+            return boptimizer.SGD(
+                learningrate=lr, learningrate_decay=decay,
+                momentum=_num(getattr(koptim_method, "momentum", 0.0)),
+                nesterov=bool(getattr(koptim_method, "nesterov", False)))
+        if cls == "Adam":
+            return boptimizer.Adam(
+                learningrate=lr, learningrate_decay=decay,
+                beta1=_num(getattr(koptim_method, "beta_1", 0.9)),
+                beta2=_num(getattr(koptim_method, "beta_2", 0.999)),
+                epsilon=_num(getattr(koptim_method, "epsilon", 1e-8)))
+        if cls == "RMSprop":
+            return boptimizer.RMSprop(
+                learningrate=lr,
+                decayrate=_num(getattr(koptim_method, "rho", 0.9)),
+                epsilon=_num(getattr(koptim_method, "epsilon", 1e-8)))
+        if cls == "Adadelta":
+            warnings.warn("For Adadelta, we don't support learning rate "
+                          "and learning rate decay for now")
+            return boptimizer.Adadelta(
+                decayrate=_num(getattr(koptim_method, "rho", 0.95)),
+                epsilon=_num(getattr(koptim_method, "epsilon", 1e-8)))
+        if cls == "Adamax":
+            warnings.warn("For Adamax, we don't support learning rate "
+                          "decay for now")
+            return boptimizer.Adamax(
+                learningrate=lr,
+                beta1=_num(getattr(koptim_method, "beta_1", 0.9)),
+                beta2=_num(getattr(koptim_method, "beta_2", 0.999)),
+                epsilon=_num(getattr(koptim_method, "epsilon", 1e-8)))
+        raise Exception("Not supported optimizer: %s" % cls)

@@ -1,0 +1,125 @@
+"""pyspark-BigDL API compatibility: `bigdl.models.inception`.
+
+Parity: reference pyspark/bigdl/models/inception/inception.py — the
+GoogLeNet-v1 builders (inception_layer_v1 block, the no-aux and full
+two-aux-head variants). Built here over the SAME compat layer API a
+ported user script would use, with the inception block expressed as a
+loop over branch configs instead of the reference's unrolled text. The
+`t(...)` Table-literal helper and config shapes match the reference so
+its call sites work unchanged.
+"""
+
+from __future__ import annotations
+
+from bigdl.nn.initialization_method import ConstInitMethod, Xavier, Zeros
+from bigdl.nn.layer import (Concat, Dropout, Linear, LogSoftMax, ReLU,
+                            Sequential, SpatialAveragePooling,
+                            SpatialConvolution, SpatialCrossMapLRN,
+                            SpatialMaxPooling, View)
+
+
+def t(input_t):
+    """List -> 1-based dict Table literal (reference helper)."""
+    if isinstance(input_t, list):
+        return dict(enumerate(input_t, 1))
+    return input_t
+
+
+def _conv(n_in, n_out, k, stride=1, pad=0, name=""):
+    return (SpatialConvolution(n_in, n_out, k, k, stride, stride, pad, pad)
+            .set_init_method(weight_init_method=Xavier(),
+                             bias_init_method=ConstInitMethod(0.1))
+            .set_name(name))
+
+
+def inception_layer_v1(input_size, config, name_prefix=""):
+    """One inception block: 1x1 / 3x3 / 5x5 / pool-proj branches
+    concatenated on the channel dim (reference inception_layer_v1)."""
+    concat = Concat(2)
+    # (branch-name, reduce-channels or None, conv kernel, out-channels)
+    p = name_prefix
+    b1 = Sequential().add(_conv(input_size, config[1][1], 1, name=p + "1x1"))
+    b1.add(ReLU(True).set_name(p + "relu_1x1"))
+    concat.add(b1)
+    b3 = Sequential().add(_conv(input_size, config[2][1], 1,
+                                name=p + "3x3_reduce"))
+    b3.add(ReLU(True).set_name(p + "relu_3x3_reduce"))
+    b3.add(_conv(config[2][1], config[2][2], 3, pad=1, name=p + "3x3"))
+    b3.add(ReLU(True).set_name(p + "relu_3x3"))
+    concat.add(b3)
+    b5 = Sequential().add(_conv(input_size, config[3][1], 1,
+                                name=p + "5x5_reduce"))
+    b5.add(ReLU(True).set_name(p + "relu_5x5_reduce"))
+    b5.add(_conv(config[3][1], config[3][2], 5, pad=2, name=p + "5x5"))
+    b5.add(ReLU(True).set_name(p + "relu_5x5"))
+    concat.add(b5)
+    bp = Sequential().add(SpatialMaxPooling(3, 3, 1, 1, 1, 1, to_ceil=True)
+                          .set_name(p + "pool"))
+    bp.add(_conv(input_size, config[4][1], 1, name=p + "pool_proj"))
+    bp.add(ReLU(True).set_name(p + "relu_pool_proj"))
+    concat.add(bp).set_name(p + "output")
+    return concat
+
+
+# per-stage block configs shared by both variants (reference's literals)
+_BLOCKS = [
+    ("inception_3a/", 192, [[64], [96, 128], [16, 32], [32]]),
+    ("inception_3b/", 256, [[128], [128, 192], [32, 96], [64]]),
+    ("pool", None, None),
+    ("inception_4a/", 480, [[192], [96, 208], [16, 48], [64]]),
+    ("inception_4b/", 512, [[160], [112, 224], [24, 64], [64]]),
+    ("inception_4c/", 512, [[128], [128, 256], [24, 64], [64]]),
+    ("inception_4d/", 512, [[112], [144, 288], [32, 64], [64]]),
+    ("inception_4e/", 528, [[256], [160, 320], [32, 128], [128]]),
+    ("pool", None, None),
+    ("inception_5a/", 832, [[256], [160, 320], [32, 128], [128]]),
+    ("inception_5b/", 832, [[384], [192, 384], [48, 128], [128]]),
+]
+
+
+def _stem(model):
+    model.add(SpatialConvolution(3, 64, 7, 7, 2, 2, 3, 3, 1, False)
+              .set_init_method(weight_init_method=Xavier(),
+                               bias_init_method=ConstInitMethod(0.1))
+              .set_name("conv1/7x7_s2"))
+    model.add(ReLU(True).set_name("conv1/relu_7x7"))
+    model.add(SpatialMaxPooling(3, 3, 2, 2, to_ceil=True)
+              .set_name("pool1/3x3_s2"))
+    model.add(SpatialCrossMapLRN(5, 0.0001, 0.75).set_name("pool1/norm1"))
+    model.add(_conv(64, 64, 1, name="conv2/3x3_reduce"))
+    model.add(ReLU(True).set_name("conv2/relu_3x3_reduce"))
+    model.add(_conv(64, 192, 3, pad=1, name="conv2/3x3"))
+    model.add(ReLU(True).set_name("conv2/relu_3x3"))
+    model.add(SpatialCrossMapLRN(5, 0.0001, 0.75).set_name("conv2/norm2"))
+    model.add(SpatialMaxPooling(3, 3, 2, 2, to_ceil=True)
+              .set_name("pool2/3x3_s2"))
+    return model
+
+
+def inception_v1_no_aux_classifier(class_num, has_dropout=True):
+    model = _stem(Sequential())
+    for name, n_in, cfg in _BLOCKS:
+        if name == "pool":
+            model.add(SpatialMaxPooling(3, 3, 2, 2, to_ceil=True))
+        else:
+            model.add(inception_layer_v1(n_in, t([t(c) for c in cfg]), name))
+    model.add(SpatialAveragePooling(7, 7, 1, 1).set_name("pool5/7x7_s1"))
+    if has_dropout:
+        model.add(Dropout(0.4).set_name("pool5/drop_7x7_s1"))
+    model.add(View([1024], num_input_dims=3))
+    model.add(Linear(1024, class_num)
+              .set_init_method(weight_init_method=Xavier(),
+                               bias_init_method=Zeros())
+              .set_name("loss3/classifier"))
+    model.add(LogSoftMax().set_name("loss3/loss3"))
+    model.reset()
+    return model
+
+
+def inception_v1(class_num, has_dropout=True):
+    """Full training variant with the two auxiliary classifier heads —
+    delegates to the native builder (bigdl_tpu/models/inception.py keeps
+    the aux-head topology) and wraps it in the compat Layer facade."""
+    from bigdl.nn.layer import Layer
+    from bigdl_tpu.models.inception import Inception_v1
+    return Layer.of(Inception_v1(class_num, has_dropout=has_dropout))

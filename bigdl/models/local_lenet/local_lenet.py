@@ -1,0 +1,43 @@
+"""pyspark-BigDL API compatibility: `bigdl.models.local_lenet`.
+
+Parity: reference pyspark/bigdl/models/local_lenet/local_lenet.py — the
+Spark-free LeNet training entry (the reference's own local-mode path;
+tests/test_pyspark_compat.py additionally executes the REFERENCE file
+verbatim against this package). `get_mnist` returns plain ndarrays with
+1-based labels, exactly the reference contract.
+"""
+
+from __future__ import annotations
+
+from bigdl.dataset import mnist
+
+
+def get_mnist(data_type="train", location="/tmp/mnist"):
+    """(features ndarray, 1-based label ndarray) for the split."""
+    X, Y = mnist.read_data_sets(location, data_type)
+    return X, Y + 1
+
+
+def train_local(data_path="/tmp/mnist", batch_size=128, max_epoch=2):
+    """The reference __main__ body as a callable: build LeNet-5, train
+    through the local Optimizer, validate Top1 each epoch."""
+    from bigdl.models.lenet.lenet5 import build_model
+    from bigdl.nn.criterion import ClassNLLCriterion
+    from bigdl.optim.optimizer import (EveryEpoch, MaxEpoch, Optimizer, SGD,
+                                       Top1Accuracy)
+    from bigdl.util.common import init_engine
+
+    init_engine()
+    (X_train, Y_train), (X_test, Y_test) = mnist.load_data(data_path)
+    optimizer = Optimizer.create(
+        model=build_model(10),
+        training_set=(X_train, Y_train),
+        criterion=ClassNLLCriterion(),
+        optim_method=SGD(learningrate=0.01, learningrate_decay=0.0002),
+        end_trigger=MaxEpoch(max_epoch),
+        batch_size=batch_size)
+    optimizer.set_validation(
+        batch_size=batch_size, X_val=X_test, Y_val=Y_test,
+        trigger=EveryEpoch(), val_method=[Top1Accuracy()])
+    trained_model = optimizer.optimize()
+    return trained_model, trained_model.predict_class(X_test)

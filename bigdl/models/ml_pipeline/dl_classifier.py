@@ -1,0 +1,12 @@
+"""pyspark-BigDL API compatibility: `bigdl.models.ml_pipeline`.
+
+Parity: reference pyspark/bigdl/models/ml_pipeline/dl_classifier.py —
+the Spark-ML pipeline stages. These are the same classes the reference
+later moved to bigdl.dlframes; this module re-exports our dlframes
+implementations under the old import path so either spelling works.
+"""
+
+from bigdl.dlframes.dl_classifier import (DLClassifier, DLClassifierModel,
+                                          DLEstimator, DLModel)
+
+__all__ = ["DLEstimator", "DLModel", "DLClassifier", "DLClassifierModel"]

@@ -1,0 +1,57 @@
+"""pyspark-BigDL API compatibility: `bigdl.models.rnn.rnnexample`.
+
+Parity: reference pyspark/bigdl/models/rnn/rnnexample.py — the simple
+RNN language model (Recurrent(RnnCell) -> TimeDistributed(Linear)) plus
+the Tiny-Shakespeare text preparation helpers, here list-based instead
+of RDD-based (declared delta: no Spark in this build) and zero-egress
+(download resolves staged files only).
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from bigdl.dataset import base, sentence
+from bigdl.nn.layer import (Linear, Recurrent, RnnCell, Sequential, Tanh,
+                            TimeDistributed)
+
+SOURCE_URL = ("https://raw.githubusercontent.com/udibr/"
+              "head_lines/master/data/")
+
+
+def download_data(dest_dir):
+    return base.maybe_download("input.txt", dest_dir, SOURCE_URL + "input.txt")
+
+
+def prepare_data(sc, folder, vocabsize, training_split=0.8):
+    """(train_tokens, val_tokens, vocab_size, word->idx dict): sentences
+    split, bipadded, tokenized, and capped to the `vocabsize` most
+    frequent words (rarer words map to an UNK bucket). `sc` is accepted
+    for signature parity and ignored (no Spark)."""
+    path = download_data(folder)
+    sents = []
+    for line in sentence.read_localfile(path):
+        for s in sentence.sentences_split(line):
+            sents.append(sentence.sentences_bipadding(s))
+    tokens = [sentence.sentence_tokenizer(s) for s in sents]
+    freq = {}
+    for toks in tokens:
+        for w in toks:
+            freq[w] = freq.get(w, 0) + 1
+    vocab = sorted(freq, key=lambda w: -freq[w])[:vocabsize - 1]
+    w2i = {w: i + 1 for i, w in enumerate(vocab)}  # 1-based; UNK = last id
+    unk = len(w2i) + 1
+    idxed = [[w2i.get(w, unk) for w in toks] for toks in tokens]
+    split = int(len(idxed) * training_split)
+    return idxed[:split], idxed[split:], unk, w2i
+
+
+def build_model(input_size, hidden_size, output_size):
+    model = Sequential()
+    model.add(Recurrent()
+              .add(RnnCell(input_size, hidden_size, Tanh()))) \
+        .add(TimeDistributed(Linear(hidden_size, output_size)))
+    model.reset()
+    return model

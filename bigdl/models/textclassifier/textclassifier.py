@@ -1,0 +1,83 @@
+"""pyspark-BigDL API compatibility: `bigdl.models.textclassifier`.
+
+Parity: reference pyspark/bigdl/models/textclassifier/textclassifier.py —
+the news20 text-CNN/LSTM/GRU classifier. The model builder and the text
+helpers keep the reference contract; `analyze_texts` operates on a list
+of (text, label) pairs instead of an RDD (declared delta: no Spark).
+"""
+
+from __future__ import annotations
+
+import itertools
+import re
+
+import numpy as np
+
+from bigdl.nn.layer import (GRU, LSTM, Dropout, Linear, LogSoftMax, ReLU,
+                            Recurrent, Select, Sequential, Squeeze,
+                            TemporalConvolution, TemporalMaxPooling)
+from bigdl.util.common import Sample
+
+# module-level knobs, assigned by the training entry in the reference
+model_type = "cnn"
+sequence_len = 500
+embedding_dim = 200
+p = 0.0
+
+
+def text_to_words(review_text):
+    letters_only = re.sub("[^a-zA-Z]", " ", review_text)
+    return letters_only.lower().split()
+
+
+def analyze_texts(data):
+    """[(word, (1-based index by desc frequency, count))] over a list of
+    (text, label) pairs (reference runs the same aggregation as an RDD
+    wordcount)."""
+    freq = {}
+    for text, _label in data:
+        for w in text_to_words(text):
+            freq[w] = freq.get(w, 0) + 1
+    ordered = sorted(freq.items(), key=lambda wc: -wc[1])
+    return [(w, (i + 1, c)) for i, (w, c) in enumerate(ordered)]
+
+
+def pad(l, fill_value, width):
+    if len(l) >= width:
+        return l[0:width]
+    l.extend([fill_value] * (width - len(l)))
+    return l
+
+
+def to_vec(token, b_w2v, embedding_dim):
+    if token in b_w2v:
+        return b_w2v[token]
+    return pad([], 0, embedding_dim)
+
+
+def to_sample(vectors, label, embedding_dim):
+    flatten_features = list(itertools.chain(*vectors))
+    features = np.array(flatten_features, dtype='float').reshape(
+        [sequence_len, embedding_dim])
+    return Sample.from_ndarray(features, np.array(label))
+
+
+def build_model(class_num):
+    model = Sequential()
+    if model_type.lower() == "cnn":
+        model.add(TemporalConvolution(embedding_dim, 256, 5)) \
+            .add(ReLU()) \
+            .add(TemporalMaxPooling(sequence_len - 5 + 1)) \
+            .add(Squeeze(2))
+    elif model_type.lower() == "lstm":
+        model.add(Recurrent().add(LSTM(embedding_dim, 256, p)))
+        model.add(Select(2, -1))
+    elif model_type.lower() == "gru":
+        model.add(Recurrent().add(GRU(embedding_dim, 256, p)))
+        model.add(Select(2, -1))
+    model.add(Linear(256, 128)) \
+        .add(Dropout(0.2)) \
+        .add(ReLU()) \
+        .add(Linear(128, class_num)) \
+        .add(LogSoftMax())
+    return model

@@ -1,0 +1,41 @@
+"""pyspark-BigDL API compatibility: `bigdl.models.utils.model_broadcast`.
+
+Parity: reference pyspark/bigdl/models/utils/model_broadcast.py — a
+Spark Broadcast subclass that ships a model to executors via BigDL's
+own serializer instead of pickle. In this single-process runtime there
+are no executors; `broadcast_model` round-trips the model through the
+protobuf serializer (same wire format role) and `.value` hands back the
+reconstructed layer — so ported scripts keep working and the
+serialization cost/behavior they relied on is preserved.
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+
+
+def broadcast_model(sc, layer):
+    """`sc` accepted for signature parity and ignored (no Spark)."""
+    return ModelBroadcast(layer)
+
+
+class ModelBroadcast:
+    def __init__(self, layer):
+        # serialize/deserialize through the real model format (the
+        # reference broadcasts the serialized bytes, not the object)
+        from bigdl.nn.layer import Layer
+        d = tempfile.mkdtemp(prefix="bigdl_broadcast_")
+        path = os.path.join(d, "model.bigdl")
+        layer.saveModel(path, over_write=True)
+        self._value = Layer.load(path)
+
+    @property
+    def value(self):
+        return self._value
+
+    def unpersist(self, blocking=False):
+        return self
+
+    def destroy(self, blocking=False):
+        self._value = None

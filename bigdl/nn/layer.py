@@ -117,35 +117,91 @@ class Layer(object):
     def _ensure_params(self):
         self.value.ensure_params()
 
+    def _rng(self):
+        """Per-call jax PRNG key drawn from the seeded global generator
+        (the reference's dropout masks come from the JVM RNG the same
+        way: seeded via set_seed, advancing per call)."""
+        import jax as _jax
+
+        from bigdl_tpu.utils.random_generator import RNG as _rng
+        return _jax.random.PRNGKey(int(_rng.randint(0, 2 ** 31 - 1)))
+
     def forward(self, input):
         """Debug-only single forward (reference modelForward)."""
         inputs = [_jnp(i) for i in to_list(input)]
-        out = self.value.forward(inputs[0] if len(inputs) == 1 else inputs)
+        out = self.value.forward(inputs[0] if len(inputs) == 1 else inputs,
+                                 rng=self._rng())
         return self._convert_output(out)
 
     def backward(self, input, grad_output):
-        """Debug-only backward: grad of <output, grad_output> w.r.t.
-        input, computed by autodiff (reference modelBackward)."""
+        """Debug-only backward: grad of <output, grad_output> w.r.t. the
+        input, computed by autodiff (reference modelBackward). Parameter
+        gradients are accumulated on the side (reference
+        accGradParameters) for `update_parameters`."""
         import jax
+
+        from bigdl_tpu.nn.module import functional_apply
         inputs = [_jnp(i) for i in to_list(input)]
         gouts = [_jnp(g) for g in to_list(grad_output)]
         x = inputs[0] if len(inputs) == 1 else inputs
         g = gouts[0] if len(gouts) == 1 else gouts
+        self._ensure_params()
+        params = self.value.parameters()
 
-        def fwd(xx):
-            return self.value.forward(xx)
+        rng = self._rng()
+        mstate = self.value._state  # live BN running stats, not init's
 
-        _, vjp = jax.vjp(fwd, x)
-        gin = vjp(g)[0]
+        def fwd(p, xx):
+            out, _ = functional_apply(
+                self.value, p, xx, rng=rng, state=mstate,
+                training=self.value.training_mode)
+            return out
+
+        _, vjp = jax.vjp(fwd, params, x)
+        gparams, gin = vjp(g)
+        acc = getattr(self, "_acc_grads", None)
+        self._acc_grads = gparams if acc is None else \
+            jax.tree_util.tree_map(lambda a, b: a + b, acc, gparams)
         return self._convert_output(gin)
 
     def zero_grad_parameters(self):
-        """Gradients are functional values, not stored buffers: no-op."""
+        """Reset the gradient accumulator `backward` fills (reference
+        zeroGradParameters)."""
+        self._acc_grads = None
+        return self
+
+    def reset(self):
+        """Drop materialized parameters so the next use re-initializes
+        them (reference `reset` re-draws weights in place; the functional
+        design re-draws lazily at the next ensure_params)."""
+        def clear(m):
+            m._params = None
+            m._state = {}
+            for c in getattr(m, "children", []):
+                clear(c)
+            for n in getattr(m, "exec_order", []):
+                clear(n.module)
+        clear(self.value)
         return self
 
     def update_parameters(self, learning_rate):
-        raise NotImplementedError(
-            "update_parameters: use an Optimizer / OptimMethod")
+        """Apply the accumulated parameter gradients: params -= lr * grad
+        (reference updateParameters — the manual torch-style loop:
+        forward / backward / update_parameters / zero_grad_parameters).
+        `backward` accumulates parameter gradients by autodiff; here they
+        are folded into the module's stateful params."""
+        import jax
+        acc = getattr(self, "_acc_grads", None)
+        if acc is None:
+            raise RuntimeError(
+                "update_parameters: no accumulated gradients — call "
+                "backward(input, grad_output) first")
+        self._ensure_params()
+        new = jax.tree_util.tree_map(
+            lambda p, g: p - learning_rate * g,
+            self.value.parameters(), acc)
+        self.value.set_params(new)
+        return self
 
     @staticmethod
     def _convert_output(output):
@@ -307,11 +363,15 @@ class Layer(object):
         return self
 
     def freeze(self, names=None):
-        raise NotImplementedError(
-            "freeze: pass per-submodule optim methods instead "
-            "(set_optim_methods with a zero-lr method)")
+        """Freeze this layer or the named sub-layers (reference freeze):
+        their params pass through stop_gradient in the traced graph, so
+        optimizers see zero gradients for them."""
+        self.value.freeze(names)
+        return self
 
-    unfreeze = freeze
+    def unfreeze(self, names=None):
+        self.value.unfreeze(names)
+        return self
 
     def __call__(self, x=None):
         """Graph DSL: layer(node) -> Node (reference createNode). Native
@@ -368,6 +428,68 @@ class Sequential(Container):
 
     def __init__(self, jvalue=None, bigdl_type="float"):
         super().__init__(jvalue or _nn.Sequential(), bigdl_type)
+
+
+class Concat(Container):
+    """Reference createConcat: children outputs joined along the 1-based
+    `dimension` (2 = channel under the reference's NCHW activations);
+    the native Concat takes a 0-based axis."""
+
+    def __init__(self, dimension=2, jvalue=None, bigdl_type="float"):
+        super().__init__(jvalue or _nn.Concat(axis=dimension - 1),
+                         bigdl_type)
+
+
+class Squeeze(Layer):
+    """Reference createSqueeze: drop the 1-based `dim`. With
+    `num_input_dims` set (batch mode, Squeeze.scala), `dim` is counted
+    WITHOUT the batch axis, so the squeezed axis shifts right by one;
+    native Squeeze is 0-based."""
+
+    def __init__(self, dim=None, num_input_dims=0, jvalue=None,
+                 bigdl_type="float"):
+        if dim is None:
+            axis = None
+        else:
+            axis = dim if num_input_dims > 0 else dim - 1
+        super().__init__(jvalue or _nn.Squeeze(axis), bigdl_type)
+
+
+class Select(Layer):
+    """Reference createSelect: pick `index` along `dim`, both 1-based
+    (negative dim/index count from the end); native Select is 0-based."""
+
+    def __init__(self, dim, index, jvalue=None, bigdl_type="float"):
+        axis = dim - 1 if dim > 0 else dim
+        idx = index - 1 if index > 0 else index
+        super().__init__(jvalue or _nn.Select(axis, idx), bigdl_type)
+
+
+class Recurrent(Container):
+    """Reference createRecurrent: built empty, the cell arrives via
+    `.add(cell)` (`Recurrent().add(LSTM(...))`). The native Recurrent
+    takes its cell at construction, so the wrapper defers building until
+    the add — or accepts a cell directly for the native spelling."""
+
+    def __init__(self, cell=None, jvalue=None, bigdl_type="float"):
+        if jvalue is None and cell is not None:
+            jvalue = _nn.Recurrent(_unwrap(cell))
+        if jvalue is None:
+            # placeholder until add(): keeps the Layer contract (value
+            # is never None, set_name before add() works like the
+            # reference's pre-built JVM container)
+            jvalue = _nn.Identity(name="Recurrent")
+            self._pending_cell = True
+        super().__init__(jvalue, bigdl_type)
+
+    def add(self, cell):
+        if not getattr(self, "_pending_cell", False):
+            raise ValueError("Recurrent holds exactly one cell")
+        rec = _nn.Recurrent(_unwrap(cell))
+        rec.name = self.value.name  # preserve any pre-add set_name
+        self.value = rec
+        self._pending_cell = False
+        return self
 
 
 class Model(Container):
@@ -441,9 +563,11 @@ class Model(Container):
             "tf_session.Session.train")
 
     def stop_gradient(self, stop_layers, bigdl_type="float"):
-        raise NotImplementedError(
-            "stop_gradient: wrap the subgraph with jax.lax.stop_gradient "
-            "via bigdl_tpu.nn.StopGradient")
+        """Cut backprop at the named layers (reference
+        Graph.stopGradient): neither they nor anything upstream of them
+        receives gradients."""
+        self.value.stop_gradient(stop_layers)
+        return self
 
     def node(self, name, bigdl_type="float"):
         for n in self.value.exec_order:
@@ -452,9 +576,11 @@ class Model(Container):
         raise KeyError(name)
 
     def save_graph_topology(self, log_path, bigdl_type="float"):
-        from bigdl_tpu.visualization import summary_writer
-        raise NotImplementedError(
-            "save_graph_topology: use bigdl_tpu.visualization")
+        """Write the model DAG as a TensorBoard graph event (reference
+        Graph.saveGraphTopology)."""
+        from bigdl_tpu.visualization import save_graph_topology
+        save_graph_topology(self.value, log_path)
+        return self
 
 
 # ---------------------------------------------------------------------------
@@ -704,6 +830,10 @@ def _unwrap(v):
 
 def _passthrough(cls_name):
     tpu_cls = getattr(_nn, cls_name)
+    # native containers (Concat, Recurrent, ParallelTable, ...) surface
+    # the reference's .add()/.layers through the compat Container base
+    from bigdl_tpu.nn.containers import Container as _TpuContainer
+    base = Container if issubclass(tpu_cls, _TpuContainer) else Layer
 
     def __init__(self, *args, bigdl_type="float", **kwargs):
         kwargs.pop("bigdl_type", None)
@@ -713,11 +843,12 @@ def _passthrough(cls_name):
 
     doc = (f"pyspark-compat passthrough for bigdl_tpu.nn.{cls_name} "
            f"(reference pyspark/bigdl/nn/layer.py create{cls_name}).")
-    return type(cls_name, (Layer,), {"__init__": __init__, "__doc__": doc})
+    return type(cls_name, (base,), {"__init__": __init__, "__doc__": doc})
 
 
 _EXPLICIT = {
-    "Layer", "Container", "Model", "Sequential", "Node", "Linear",
+    "Layer", "Container", "Model", "Sequential", "Concat", "Recurrent",
+    "Squeeze", "Select", "Node", "Linear",
     "SpatialConvolution", "SpatialMaxPooling", "SpatialAveragePooling",
     "SpatialBatchNormalization", "BatchNormalization", "LookupTable",
     "Dropout", "Reshape", "View", "Echo", "TemporalConvolution",

@@ -12,6 +12,8 @@ swap is RDD -> list/ndarray).
 from __future__ import annotations
 
 import logging
+import os  # noqa: F401  (star-exported: reference scripts rely on
+import sys  # noqa: F401  `from bigdl.util.common import *` providing these)
 from typing import Any, List, Optional
 
 import numpy as np

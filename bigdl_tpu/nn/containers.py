@@ -64,6 +64,8 @@ class Container(Module):
         key = self._child_keys[i]
         ctx.push(key)
         try:
+            # freeze/stop-gradient gating lives in the subclass-wrapped
+            # Module.apply itself (module.py __init_subclass__)
             return self.children[i].apply(params[key], x, ctx)
         finally:
             ctx.pop()

@@ -93,19 +93,101 @@ class Module:
         self.training_mode = True
         self._params: Optional[Dict] = None  # cached stateful params
         self._state: Dict = {}
+        self._frozen = False          # freeze(): params see stop_gradient
+        self._stop_gradient = False   # Graph.stop_gradient(): output cut
+
+    # -- freeze / gradient gating --------------------------------------- #
+    def freeze(self, names: Optional[Sequence[str]] = None) -> "Module":
+        """Freeze this module (or, on containers, the named sub-modules,
+        searched recursively): its params pass through
+        `jax.lax.stop_gradient` at every apply site, so autodiff sees
+        zero gradients and no optimizer touches them. TPU-first analogue
+        of the reference's setScaleW/B(0) freeze (Container.scala
+        freeze): the gating happens in the traced graph, costs nothing
+        at runtime, and composes with jit/pjit."""
+        for m in self._modules_by_name(names):
+            m._frozen = True
+        return self
+
+    def unfreeze(self, names: Optional[Sequence[str]] = None) -> "Module":
+        for m in self._modules_by_name(names):
+            m._frozen = False
+        return self
+
+    def _modules_by_name(self, names: Optional[Sequence[str]]):
+        if names is None:
+            return [self]
+        wanted = set(names)
+        found, seen = [], set()
+
+        def walk(m):
+            if id(m) in seen:
+                return
+            seen.add(id(m))
+            if m.name in wanted:
+                found.append(m)
+            for c in getattr(m, "children", []):
+                walk(c)
+            for n in getattr(m, "exec_order", []):
+                walk(n.module)
+            # composite modules (BiRecurrent, attention, ...) hold
+            # sub-modules in plain attributes
+            for v in m.__dict__.values():
+                if isinstance(v, Module):
+                    walk(v)
+                elif isinstance(v, (list, tuple)):
+                    for x in v:
+                        if isinstance(x, Module):
+                            walk(x)
+
+        walk(self)
+        missing = wanted - {m.name for m in found}
+        if missing:
+            raise KeyError(f"no sub-module named {sorted(missing)}")
+        return found
+
+    def stop_gradient(self, names: Sequence[str]) -> "Module":
+        """Cut backprop at the named sub-modules (reference
+        Graph.stopGradient): their outputs pass through
+        `jax.lax.stop_gradient`, so neither they nor anything upstream
+        of them receives gradients."""
+        for m in self._modules_by_name(list(names)):
+            m._stop_gradient = True
+        return self
 
     def __init_subclass__(cls, **kwargs):
         """Capture constructor args on every subclass instance — the
         reflection hook the protobuf serializer uses to rebuild modules
         (reference: reflection-driven default serialization,
         ModuleSerializer.scala:34 / DataConverter). The outermost __init__
-        in the MRO wins, so `self._ctor_spec` records the concrete class."""
+        in the MRO wins, so `self._ctor_spec` records the concrete class.
+
+        Also wraps each subclass's `apply` with the freeze/stop-gradient
+        gate, so the gating holds at EVERY apply site (containers, graph
+        nodes, composite modules calling sub.apply directly) — not just
+        the container dispatch helpers."""
         super().__init_subclass__(**kwargs)
+        import functools
+
+        orig_apply = cls.__dict__.get("apply")
+        if orig_apply is not None and \
+                not getattr(orig_apply, "_gate_wrap", False):
+
+            @functools.wraps(orig_apply)
+            def apply_gated(self, params, input, ctx, __orig=orig_apply):
+                if getattr(self, "_frozen", False):
+                    params = jax.lax.stop_gradient(params)
+                out = __orig(self, params, input, ctx)
+                if getattr(self, "_stop_gradient", False):
+                    out = jax.tree_util.tree_map(jax.lax.stop_gradient, out)
+                return out
+
+            apply_gated._gate_wrap = True
+            cls.apply = apply_gated
+
         orig = cls.__dict__.get("__init__")
         if orig is None or getattr(orig, "_ctor_capture", False):
             return
-
-        import functools
 
         @functools.wraps(orig)
         def wrapper(self, *args, **kw):

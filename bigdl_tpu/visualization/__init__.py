@@ -1,10 +1,12 @@
 from bigdl_tpu.visualization.record_writer import (RecordWriter,
                                                    TFRecordFileWriter)
 from bigdl_tpu.visualization.event_writer import EventWriter
+from bigdl_tpu.visualization.graph_writer import (model_graph_def,
+                                                  save_graph_topology)
 from bigdl_tpu.visualization.summary import (FileReader, Summary,
                                              TrainSummary, ValidationSummary,
                                              histogram_event, scalar_event)
 
 __all__ = ["RecordWriter", "TFRecordFileWriter", "EventWriter", "FileReader",
            "Summary", "TrainSummary", "ValidationSummary", "scalar_event",
-           "histogram_event"]
+           "histogram_event", "model_graph_def", "save_graph_topology"]

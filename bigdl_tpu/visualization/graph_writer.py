@@ -1,0 +1,78 @@
+"""TensorBoard graph-view export.
+
+Parity: `Graph.saveGraphTopology` (DL/nn/Graph.scala:221 writes the
+module DAG as a tensorflow GraphDef event so TensorBoard's graph tab can
+render it; surfaced in pyspark as Model.save_graph_topology). Same
+contract here: one events file whose Event carries a serialized
+GraphDef — node per layer, op = layer class, inputs = DAG edges
+(Sequential chains linearize)."""
+
+from __future__ import annotations
+
+import time
+
+
+def model_graph_def(module):
+    """Build a tensorflow.GraphDef describing `module`'s topology."""
+    from bigdl_tpu.proto import tf_graph_pb2 as tpb
+
+    gd = tpb.GraphDef()
+    seen = set()
+
+    def unique(name):
+        base = name.replace(" ", "_")
+        n, i = base, 1
+        while n in seen:
+            i += 1
+            n = f"{base}_{i}"
+        seen.add(n)
+        return n
+
+    def add_node(name, op, inputs):
+        nd = gd.node.add()
+        nd.name = name
+        nd.op = op
+        for i in inputs:
+            nd.input.append(i)
+        return name
+
+    def emit(m, inputs, prefix):
+        """Returns the output node name(s) of `m`."""
+        exec_order = getattr(m, "exec_order", None)
+        if exec_order is not None:  # Graph container
+            names = {}
+            for node in exec_order:
+                srcs = [names[p.id] for p in node.prev] if node.prev \
+                    else list(inputs)
+                names[node.id] = emit(node.module, srcs,
+                                      f"{prefix}{node.module.name}/")[0]
+            return [names[n.id] for n in getattr(m, "output_nodes",
+                                                 exec_order[-1:])]
+        children = getattr(m, "children", None)
+        if children:  # Sequential-style chain
+            outs = list(inputs)
+            for c in children:
+                outs = emit(c, outs, f"{prefix}{c.name}/")
+            return outs
+        return [add_node(unique(prefix.rstrip("/") or m.name),
+                         type(m).__name__, inputs)]
+
+    inp = add_node(unique("input"), "Placeholder", [])
+    emit(module, [inp], "")
+    return gd
+
+
+def save_graph_topology(module, log_path: str) -> str:
+    """Write `log_path/…tfevents…` with the model graph; returns the
+    directory (point TensorBoard at it)."""
+    from bigdl_tpu.proto import tb_event_pb2
+    from bigdl_tpu.visualization.event_writer import EventWriter
+
+    gd = model_graph_def(module)
+    ev = tb_event_pb2.Event()
+    ev.wall_time = time.time()
+    ev.graph_def = gd.SerializeToString()
+    w = EventWriter(log_path)
+    w.add_event(ev)
+    w.close()
+    return log_path

@@ -483,3 +483,50 @@ class TestRecurrentDecoderUnroll:
             outs.append(np.asarray(x))
         want = np.stack(outs, axis=1)
         np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+class TestFreezeGating:
+    """freeze()/stop_gradient() gate at Module.apply itself (the
+    __init_subclass__ wrapper), so they hold at EVERY apply site: the
+    root module, container children, graph nodes, and sub-modules held
+    in composite-module attributes."""
+
+    def _grads(self, m, x):
+        from bigdl_tpu.nn.module import functional_apply
+        p = m.ensure_params()
+
+        def loss(pp):
+            out, _ = functional_apply(m, pp, x, training=False)
+            return jnp.sum(out ** 2)
+
+        return jax.grad(loss)(p)
+
+    def test_root_freeze_no_names(self):
+        m = nn.Linear(4, 2)
+        m.freeze()
+        g = self._grads(m, jnp.ones((2, 4)))
+        assert all(float(jnp.abs(l).sum()) == 0.0
+                   for l in jax.tree_util.tree_leaves(g))
+        m.unfreeze()
+        g2 = self._grads(m, jnp.ones((2, 4)))
+        assert any(float(jnp.abs(l).sum()) > 0.0
+                   for l in jax.tree_util.tree_leaves(g2))
+
+    def test_freeze_inside_composite_attribute(self):
+        """BiRecurrent holds its Recurrent halves in attributes, not
+        children: named freeze must reach through and zero their grads."""
+        cell = nn.LSTMCell(4, 3)
+        bi = nn.BiRecurrent(cell, merge="concat")
+        bi.fwd.name = "fwd_half"
+        bi.freeze(["fwd_half"])
+        x = jnp.asarray(np.random.RandomState(0).randn(2, 5, 4)
+                        .astype(np.float32))
+        g = self._grads(bi, x)
+        flat = jax.tree_util.tree_flatten_with_path(g)[0]
+        fwd_total = sum(float(jnp.abs(l).sum()) for path, l in flat
+                        if "fwd" in "/".join(str(getattr(k, "key", k))
+                                             for k in path))
+        bwd_total = sum(float(jnp.abs(l).sum()) for path, l in flat
+                        if "bwd" in "/".join(str(getattr(k, "key", k))
+                                             for k in path))
+        assert fwd_total == 0.0 and bwd_total > 0.0
