@@ -57,11 +57,7 @@ class KerasModelWrapper:
         _no_rdd(is_distributed)
         if not self.metrics:
             raise Exception("No Metrics found.")
-        from bigdl.optim.optimizer import _as_validation_set
-        results = self.bmodel.evaluate_local(x, y, batch_size, self.metrics) \
-            if hasattr(self.bmodel, "evaluate_local") else \
-            self._evaluate_local(x, y, batch_size)
-        return results
+        return self._evaluate_local(x, y, batch_size)
 
     def _evaluate_local(self, x, y, batch_size):
         from bigdl_tpu.dataset.dataset import DataSet

@@ -24,11 +24,15 @@ class ModelBroadcast:
     def __init__(self, layer):
         # serialize/deserialize through the real model format (the
         # reference broadcasts the serialized bytes, not the object)
+        import shutil
         from bigdl.nn.layer import Layer
         d = tempfile.mkdtemp(prefix="bigdl_broadcast_")
-        path = os.path.join(d, "model.bigdl")
-        layer.saveModel(path, over_write=True)
-        self._value = Layer.load(path)
+        try:
+            path = os.path.join(d, "model.bigdl")
+            layer.saveModel(path, over_write=True)
+            self._value = Layer.load(path)
+        finally:
+            shutil.rmtree(d, ignore_errors=True)
 
     @property
     def value(self):

@@ -472,23 +472,21 @@ class Recurrent(Container):
     the add — or accepts a cell directly for the native spelling."""
 
     def __init__(self, cell=None, jvalue=None, bigdl_type="float"):
-        if jvalue is None and cell is not None:
-            jvalue = _nn.Recurrent(_unwrap(cell))
-        if jvalue is None:
-            # placeholder until add(): keeps the Layer contract (value
-            # is never None, set_name before add() works like the
-            # reference's pre-built JVM container)
-            jvalue = _nn.Identity(name="Recurrent")
-            self._pending_cell = True
-        super().__init__(jvalue, bigdl_type)
+        if jvalue is not None:
+            super().__init__(jvalue, bigdl_type)
+            return
+        # `value` is a STABLE wrapper container: outer containers that
+        # add() this layer before its cell arrives hold the same object
+        # the later add(cell) fills (the reference's JVM container is
+        # likewise built up front and mutated)
+        super().__init__(_nn.Sequential(name="Recurrent"), bigdl_type)
+        if cell is not None:
+            self.add(cell)
 
     def add(self, cell):
-        if not getattr(self, "_pending_cell", False):
+        if self.value.children:
             raise ValueError("Recurrent holds exactly one cell")
-        rec = _nn.Recurrent(_unwrap(cell))
-        rec.name = self.value.name  # preserve any pre-add set_name
-        self.value = rec
-        self._pending_cell = False
+        self.value.add(_nn.Recurrent(_unwrap(cell)))
         return self
 
 

@@ -3,11 +3,15 @@
 Parity: `DLEstimator`/`DLModel`/`DLClassifier`/`DLClassifierModel`
 (DL/dlframes/DLEstimator.scala:163,270,362, SURVEY.md C31) — the reference's
 Spark-ML pipeline integration: `estimator.fit(df)` trains and returns a
-model; `model.transform(df)` appends a prediction column. Here the
-"DataFrame" is a pandas DataFrame (or any dict-of-columns), the natural
-host-side tabular container in a python/TPU stack, and the fit runs the
-standard Optimizer on the extracted feature/label columns. The sklearn-style
-`fit/transform` surface doubles as a drop-in for sklearn pipelines.
+model; `model.transform(df)` appends a prediction column. The "DataFrame"
+is, by default, a pandas DataFrame (or any dict-of-columns), the natural
+host-side tabular container in a python/TPU stack; when pyspark is
+installed, a real Spark DataFrame works too — columns stream to this host
+partition-wise via `toLocalIterator` (the reference's internalFit collect,
+DLEstimator.scala:270, without pulling the whole frame into one list) and
+`transform` hands back a Spark DataFrame through the frame's own session.
+The sklearn-style `fit/transform` surface doubles as a drop-in for
+sklearn pipelines.
 """
 
 from __future__ import annotations
@@ -19,22 +23,43 @@ import numpy as np
 from bigdl_tpu.nn.module import Module
 
 
+def _is_spark_df(df) -> bool:
+    """Duck-typed Spark DataFrame detection (works for pyspark and for
+    anything honoring its interface): schema + partition-wise row
+    iteration + per-column select."""
+    return (hasattr(df, "toLocalIterator") and hasattr(df, "schema")
+            and hasattr(df, "select"))
+
+
+def _cell_to_arr(v) -> np.ndarray:
+    # pyspark.ml Vector types expose toArray(); image STRUCT columns
+    # (DLImageReader/DLImageTransformer) hold origin/.../data — consume
+    # the data field, like the reference's DLModel does
+    if hasattr(v, "toArray"):
+        v = v.toArray()
+    if isinstance(v, dict) and "data" in v:
+        v = v["data"]
+    elif hasattr(v, "asDict"):  # spark Row struct
+        d = v.asDict()
+        if "data" in d:
+            v = d["data"]
+    return np.asarray(v, np.float32)
+
+
 def _get_column(df, name: str) -> np.ndarray:
+    if _is_spark_df(df):
+        # stream rows partition-by-partition: only one partition's rows
+        # are materialized on this host at a time
+        vals = [_cell_to_arr(row[name])
+                for row in df.select(name).toLocalIterator()]
+        return np.asarray(vals)
     if hasattr(df, "loc") and hasattr(df, "columns"):  # pandas
         col = df[name].tolist()
     elif isinstance(df, dict):
         col = list(df[name])
     else:
         raise TypeError(f"unsupported frame type {type(df)}")
-    def to_arr(v):
-        # DLImageReader/DLImageTransformer columns hold image STRUCTS
-        # (origin/height/width/nChannels/data) — consume the data field,
-        # like the reference's DLModel does with the image schema
-        if isinstance(v, dict) and "data" in v:
-            v = v["data"]
-        return np.asarray(v, np.float32)
-
-    return np.asarray([to_arr(v) for v in col])
+    return np.asarray([_cell_to_arr(v) for v in col])
 
 
 def _with_column(df, name: str, values: List):
@@ -43,6 +68,46 @@ def _with_column(df, name: str, values: List):
     out = dict(df)
     out[name] = list(values)
     return out
+
+
+def _spark_transform(df, feature_col: str, feature_size, predict_rows,
+                     batch_size: int, out_col: str):
+    """Spark-DataFrame transform: ONE streaming pass over the frame
+    (toLocalIterator) computes predictions batch-wise and carries the
+    full rows along, so prediction/row alignment is guaranteed by
+    construction (no second Spark job whose ordering could differ). The
+    RESULT materializes on this host before going back through the
+    frame's session — inherent to driver-side TPU compute; the reference
+    computes inside executor UDFs instead (DLEstimator.scala:362), which
+    a Spark-free runtime cannot."""
+    import pandas as pd
+    schema = df.schema
+    names = list(getattr(schema, "names", None) or
+                 getattr(schema, "fieldNames", lambda: list(schema))())
+    rows: List[Dict] = []
+    feats: List[np.ndarray] = []
+    preds: List = []
+
+    def flush():
+        if not feats:
+            return
+        batch = np.asarray(feats).reshape((-1,) + tuple(feature_size))
+        preds.extend(predict_rows(batch))
+        feats.clear()
+
+    for row in df.toLocalIterator():
+        rows.append({n: row[n] for n in names})
+        feats.append(_cell_to_arr(row[feature_col]))
+        if len(feats) >= batch_size:
+            flush()
+    flush()
+    pdf = pd.DataFrame(rows)
+    pdf[out_col] = preds
+    session = getattr(df, "sparkSession", None) or \
+        getattr(df, "sql_ctx", None)
+    if session is not None and hasattr(session, "createDataFrame"):
+        return session.createDataFrame(pdf)
+    return pdf
 
 
 class DLEstimator:
@@ -130,7 +195,17 @@ class DLModel:
                 self.model.forward(batch, training=False)))
         return np.concatenate(outs)
 
+    def _predict_batch(self, batch: np.ndarray) -> List:
+        import jax.numpy as jnp
+        out = np.asarray(self.model.forward(jnp.asarray(batch),
+                                            training=False))
+        return [p for p in out]
+
     def transform(self, df):
+        if _is_spark_df(df):
+            return _spark_transform(df, self.features_col,
+                                    self.feature_size, self._predict_batch,
+                                    self.batch_size, self.prediction_col)
         preds = self._predict_raw(df)
         return _with_column(df, self.prediction_col,
                             [p for p in preds])
@@ -154,7 +229,15 @@ class DLClassifier(DLEstimator):
 class DLClassifierModel(DLModel):
     """Appends 1-based class predictions (argmax over the output row)."""
 
+    def _predict_batch(self, batch: np.ndarray) -> List:
+        raw = super()._predict_batch(batch)
+        return [float(np.argmax(p, axis=-1) + 1) for p in raw]
+
     def transform(self, df):
+        if _is_spark_df(df):
+            return _spark_transform(df, self.features_col,
+                                    self.feature_size, self._predict_batch,
+                                    self.batch_size, self.prediction_col)
         preds = self._predict_raw(df)
         classes = (np.argmax(preds, axis=-1) + 1).astype(np.float64)
         return _with_column(df, self.prediction_col, classes.tolist())

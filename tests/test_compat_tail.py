@@ -374,3 +374,18 @@ class TestModelsTail:
         X, Y = get_mnist("test", str(tmp_path))
         assert X.shape[1:] == (28, 28, 1)
         assert Y.min() >= 1  # 1-based
+
+
+class TestRecurrentAddOrder:
+    def test_add_to_container_before_cell(self):
+        """Reference-legal order: the Recurrent joins a Sequential BEFORE
+        its cell arrives; the later add(cell) must be visible through the
+        container (the wrapper object is stable, not swapped)."""
+        from bigdl.nn.layer import LSTM, Linear, Recurrent, Sequential
+        seq = Sequential()
+        rec = Recurrent()
+        seq.add(rec)                      # placeholder inside the chain
+        rec.add(LSTM(6, 5))               # cell arrives afterwards
+        seq.add(Linear(5, 2))
+        out = seq.forward(np.random.rand(3, 4, 6).astype(np.float32))
+        assert out.shape == (3, 4, 2)
