@@ -1,0 +1,35 @@
+"""pyspark-BigDL API compatibility: `bigdl.dataset.dataset`.
+
+Parity: reference pyspark/bigdl/dataset/dataset.py — the thin DataSet
+wrapper over an ImageFrame that feeds Optimizer with transformed image
+features (createDatasetFromImageFrame / featureTransformDataset). The
+in-process equivalent keeps the ImageFrame and applies
+FeatureTransformers eagerly through the vision pipeline.
+"""
+
+from __future__ import annotations
+
+
+class DataSet:
+
+    def __init__(self, jvalue=None, image_frame=None, bigdl_type="float"):
+        self.bigdl_type = bigdl_type
+        if jvalue is not None:
+            self.value = jvalue
+        if image_frame is not None:
+            self.image_frame = image_frame
+            self.value = getattr(image_frame, "value", image_frame)
+
+    @classmethod
+    def image_frame(cls, image_frame, bigdl_type="float"):
+        return DataSet(image_frame=image_frame, bigdl_type=bigdl_type)
+
+    def transform(self, transformer):
+        from bigdl.transform.vision.image import FeatureTransformer
+        if isinstance(transformer, FeatureTransformer):
+            return DataSet(image_frame=transformer(self.image_frame),
+                           bigdl_type=self.bigdl_type)
+        raise ValueError("Unsupported transformer: %s" % transformer)
+
+    def get_image_frame(self):
+        return self.image_frame

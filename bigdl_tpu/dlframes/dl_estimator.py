@@ -92,7 +92,10 @@ def _spark_transform(df, feature_col: str, feature_size, predict_rows,
         if not feats:
             return
         batch = np.asarray(feats).reshape((-1,) + tuple(feature_size))
-        preds.extend(predict_rows(batch))
+        # ndarray rows become lists: Spark's createDataFrame schema
+        # inference accepts lists (ArrayType) but not numpy arrays
+        preds.extend(p.tolist() if isinstance(p, np.ndarray) else p
+                     for p in predict_rows(batch))
         feats.clear()
 
     for row in df.toLocalIterator():
@@ -149,9 +152,20 @@ class DLEstimator:
 
     def fit(self, df) -> "DLModel":
         import bigdl_tpu.optim as optim
-        X = _get_column(df, self.features_col).reshape(
-            (-1,) + self.feature_size)
-        Y = _get_column(df, self.label_col).reshape((-1,) + self.label_size)
+        if _is_spark_df(df):
+            # ONE streaming pass filling both columns (two _get_column
+            # calls would launch two Spark jobs over every partition)
+            feats, labels = [], []
+            for row in df.select(self.features_col,
+                                 self.label_col).toLocalIterator():
+                feats.append(_cell_to_arr(row[self.features_col]))
+                labels.append(_cell_to_arr(row[self.label_col]))
+            X, Y = np.asarray(feats), np.asarray(labels)
+        else:
+            X = _get_column(df, self.features_col)
+            Y = _get_column(df, self.label_col)
+        X = X.reshape((-1,) + self.feature_size)
+        Y = Y.reshape((-1,) + self.label_size)
         if self._flatten_labels and self.label_size == (1,):
             Y = Y.reshape(-1)
         o = optim.Optimizer(self.model, (X, Y), self.criterion,
@@ -196,6 +210,9 @@ class DLModel:
         return np.concatenate(outs)
 
     def _predict_batch(self, batch: np.ndarray) -> List:
+        """Per-row predictions for one batch; subclasses post-process
+        (DLClassifierModel argmaxes). Both the pandas and Spark paths
+        route through this single hook."""
         import jax.numpy as jnp
         out = np.asarray(self.model.forward(jnp.asarray(batch),
                                             training=False))
@@ -206,9 +223,12 @@ class DLModel:
             return _spark_transform(df, self.features_col,
                                     self.feature_size, self._predict_batch,
                                     self.batch_size, self.prediction_col)
-        preds = self._predict_raw(df)
-        return _with_column(df, self.prediction_col,
-                            [p for p in preds])
+        X = _get_column(df, self.features_col).reshape(
+            (-1,) + self.feature_size)
+        preds: List = []
+        for i in range(0, len(X), self.batch_size):
+            preds.extend(self._predict_batch(X[i:i + self.batch_size]))
+        return _with_column(df, self.prediction_col, preds)
 
 
 class DLClassifier(DLEstimator):
@@ -227,17 +247,9 @@ class DLClassifier(DLEstimator):
 
 
 class DLClassifierModel(DLModel):
-    """Appends 1-based class predictions (argmax over the output row)."""
+    """Appends 1-based class predictions (argmax over the output row) —
+    only the per-batch hook differs; transform dispatch is inherited."""
 
     def _predict_batch(self, batch: np.ndarray) -> List:
         raw = super()._predict_batch(batch)
         return [float(np.argmax(p, axis=-1) + 1) for p in raw]
-
-    def transform(self, df):
-        if _is_spark_df(df):
-            return _spark_transform(df, self.features_col,
-                                    self.feature_size, self._predict_batch,
-                                    self.batch_size, self.prediction_col)
-        preds = self._predict_raw(df)
-        classes = (np.argmax(preds, axis=-1) + 1).astype(np.float64)
-        return _with_column(df, self.prediction_col, classes.tolist())

@@ -19,9 +19,24 @@ from typing import Optional
 _LIB: Optional[ctypes.CDLL] = None
 _TRIED = False
 
-_NATIVE_DIR = os.path.join(
-    os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
-    "native")
+def _find_native_dir() -> str:
+    """The C sources/Makefile directory: <repo-root>/native for a
+    checkout; for an installed wheel (which does not package the C
+    sources) BIGDL_TPU_NATIVE_DIR or ./native of the working directory
+    point at a sources checkout — absent those, the pure-python
+    fallback serves."""
+    env = os.environ.get("BIGDL_TPU_NATIVE_DIR")
+    if env:
+        return env
+    repo = os.path.join(
+        os.path.dirname(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__)))), "native")
+    if os.path.isdir(repo):
+        return repo
+    return os.path.join(os.getcwd(), "native")
+
+
+_NATIVE_DIR = _find_native_dir()
 _LIB_PATH = os.path.join(_NATIVE_DIR, "libbigdl_tpu_native.so")
 
 

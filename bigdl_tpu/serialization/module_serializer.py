@@ -321,14 +321,16 @@ class ModuleSerializer:
                 _encode_tensor(leaf, nt.tensor, ctx)
         for i, blob in enumerate(ctx.blobs):
             mp.storages.add(id=i, data=blob)
-        with open(path, "wb") as f:
+        from bigdl_tpu.utils import filesystem as fsys
+        with fsys.open_file(path, "wb") as f:
             f.write(mp.SerializeToString())
 
     @staticmethod
     def load(path: str):
         """Rebuild the module and attach its parameters/state."""
         global _CUR_STORAGES
-        with open(path, "rb") as f:
+        from bigdl_tpu.utils import filesystem as fsys
+        with fsys.open_file(path, "rb") as f:
             mp = pb.ModelProto.FromString(f.read())
         storages = {s.id: s.data for s in mp.storages}
         _CUR_STORAGES = storages

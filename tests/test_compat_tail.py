@@ -389,3 +389,87 @@ class TestRecurrentAddOrder:
         seq.add(Linear(5, 2))
         out = seq.forward(np.random.rand(3, 4, 6).astype(np.float32))
         assert out.shape == (3, 4, 2)
+
+
+class TestKerasBackendWrapper:
+    """with_bigdl_backend over a duck-typed compiled Keras-1 model:
+    fit / predict / evaluate run on this stack (local mode)."""
+
+    def _kmodel(self, tmp_path):
+        h5py = pytest.importorskip("h5py")
+        rs = np.random.RandomState(0)
+        W1, b1 = rs.randn(6, 8).astype("f"), np.zeros(8, "f")
+        W2, b2 = rs.randn(8, 3).astype("f"), np.zeros(3, "f")
+        cfg = {
+            "class_name": "Sequential",
+            "config": [
+                {"class_name": "Dense", "config": {
+                    "name": "d1", "output_dim": 8, "activation": "relu",
+                    "batch_input_shape": [None, 6], "bias": True}},
+                {"class_name": "Dense", "config": {
+                    "name": "d2", "output_dim": 3,
+                    "activation": "softmax", "bias": True}},
+            ],
+        }
+
+        class FakeSGD:
+            lr, decay, momentum, nesterov = 0.05, 0.0, 0.0, False
+        FakeSGD.__name__ = "SGD"
+
+        class FakeKModel:
+            loss = "sparse_categorical_crossentropy"
+            optimizer = FakeSGD()
+            metrics = ["accuracy"]
+
+            def to_json(self):
+                return json.dumps(cfg)
+
+            def save_weights(self, path, overwrite=True):
+                with h5py.File(path, "w") as f:
+                    g = f.create_group("model_weights")
+                    g.attrs["layer_names"] = [b"d1", b"d2"]
+                    for n, ws in [("d1", [("W", W1), ("b", b1)]),
+                                  ("d2", [("W", W2), ("b", b2)])]:
+                        lg = g.create_group(n)
+                        lg.attrs["weight_names"] = [
+                            f"{n}_{w[0]}".encode() for w in ws]
+                        for wn, arr in ws:
+                            lg.create_dataset(f"{n}_{wn}", data=arr)
+
+        return FakeKModel()
+
+    def test_fit_predict_evaluate(self, tmp_path):
+        from bigdl.keras.backend import with_bigdl_backend
+        rs = np.random.RandomState(1)
+        X = rs.rand(96, 6).astype(np.float32)
+        w = rs.rand(6) - 0.5
+        Y = (X @ w > 0).astype(np.int64) + 1  # 1-based classes
+        wrapper = with_bigdl_backend(self._kmodel(tmp_path))
+        assert wrapper.criterion is not None
+        assert type(wrapper.optim_method).__name__ == "SGD"
+        wrapper.fit(X, Y, batch_size=16, nb_epoch=20)
+        preds = wrapper.predict(X)
+        assert preds.shape == (96, 3)
+        acc = wrapper.evaluate(X, Y)[0]
+        assert acc > 0.8, acc
+        with pytest.raises(Exception, match="Spark-free"):
+            wrapper.fit(X, Y, is_distributed=True)
+
+
+class TestDatasetImageFrameWrapper:
+    def test_dataset_over_image_frame(self, tmp_path):
+        """bigdl.dataset.dataset.DataSet wraps an ImageFrame and applies
+        FeatureTransformers (reference createDatasetFromImageFrame /
+        featureTransformDataset roles)."""
+        from bigdl.dataset.dataset import DataSet
+        from bigdl.transform.vision.image import LocalImageFrame, Resize
+        imgs = [np.random.RandomState(i).rand(12, 10, 3)
+                .astype(np.float32) for i in range(3)]
+        frame = LocalImageFrame(imgs)
+        ds = DataSet.image_frame(frame)
+        assert ds.get_image_frame() is frame
+        out = ds.transform(Resize(6, 6))
+        got = out.get_image_frame().get_image()  # CHW, reference default
+        assert all(g.shape == (3, 6, 6) for g in got)
+        with pytest.raises(ValueError, match="Unsupported"):
+            ds.transform(object())

@@ -171,8 +171,9 @@ class _FakeSparkDF:
         self.schema = list(columns)
         self.sparkSession = session or _FakeSession()
 
-    def select(self, name):
-        return _FakeSparkDF({name: self._cols[name]}, self.sparkSession)
+    def select(self, *names):
+        return _FakeSparkDF({n: self._cols[n] for n in names},
+                            self.sparkSession)
 
     def toLocalIterator(self):
         n = len(next(iter(self._cols.values())))
