@@ -169,6 +169,7 @@ class DistriOptimizer(BaseOptimizer):
 
     # ------------------------------------------------------------------ #
     def optimize(self) -> Module:
+        self._maybe_optimize_graph()
         attempt = 0
         last_failure = time.time()
         while True:

@@ -66,6 +66,7 @@ class BaseOptimizer:
         self.matmul_precision: Optional[str] = None
         self.sync_interval: int = 1
         self.iteration_hook: Optional[Callable[[Dict], None]] = None
+        self.graph_optimizations = False
         self.grad_accum_steps: int = 1
 
     # fluent setters (Optimizer.scala:93-452)
@@ -293,6 +294,20 @@ class BaseOptimizer:
         self.iteration_hook = fn
         return self
 
+    def set_graph_optimizations(self, enable: bool = True):
+        """Run the IR restatement passes over the model before building
+        the train step (`ir.ConversionUtils.apply_tpu_restatements`):
+        math-preserving rewrites with identical parameter trees (e.g.
+        the space-to-depth stem), so checkpoints stay interchangeable.
+        Off by default; the restatements pay on TPU MXU tiling."""
+        self.graph_optimizations = enable
+        return self
+
+    def _maybe_optimize_graph(self):
+        if getattr(self, "graph_optimizations", False):
+            from bigdl_tpu.ir import ConversionUtils
+            self.model = ConversionUtils.apply_tpu_restatements(self.model)
+
     def _precision_scope(self):
         import contextlib
         if self.matmul_precision is None:
@@ -458,6 +473,7 @@ class LocalOptimizer(BaseOptimizer):
         return jax.jit(step)
 
     def optimize(self) -> Module:
+        self._maybe_optimize_graph()
         params = self.model.ensure_params()
         model_state = self.model._state
         resume_slots = getattr(self, "_resume_slots", None)

@@ -3,6 +3,7 @@ mkldnn Fusion specs, SURVEY.md C12): BN folding preserves outputs exactly,
 noise layers vanish at inference, predictor path converts automatically.
 """
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
@@ -137,3 +138,32 @@ class TestS2DStemRestatement:
                    for n in out.exec_order)
         got = np.asarray(out.forward(x, training=False))
         np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+
+class TestOptimizerGraphOptimizations:
+    def test_set_graph_optimizations_restates_and_trains(self):
+        """Opt-in optimizer knob: the stem restates before the step
+        builds, training runs, and the param tree stays checkpoint-
+        compatible (identical shapes)."""
+        import bigdl_tpu.optim as optim
+        rs = np.random.RandomState(0)
+        X = rs.rand(32, 16, 16, 3).astype(np.float32)
+        Y = (rs.randint(0, 4, size=32) + 1).astype(np.int32)
+        m = (nn.Sequential()
+             .add(nn.SpatialConvolution(3, 8, 7, 7, 2, 2, 3, 3,
+                                        with_bias=False))
+             .add(nn.ReLU()).add(nn.Pooler())
+             .add(nn.Linear(8, 4)).add(nn.LogSoftMax()))
+        shapes_before = [tuple(l.shape) for l in
+                         jax.tree_util.tree_leaves(m.ensure_params())]
+        o = optim.Optimizer(m, (X, Y), nn.ClassNLLCriterion(),
+                            batch_size=16, local=True)
+        o.set_graph_optimizations(True)
+        o.set_optim_method(optim.SGD(learning_rate=0.05))
+        o.set_end_when(optim.max_iteration(4))
+        trained = o.optimize()
+        assert type(trained.children[0]).__name__ == \
+            "SpaceToDepthStemConvolution"
+        shapes_after = [tuple(l.shape) for l in
+                        jax.tree_util.tree_leaves(trained.ensure_params())]
+        assert shapes_before == shapes_after
