@@ -1,0 +1,22 @@
+"""pyspark-BigDL API compatibility: `bigdl.dlframes.dl_image_reader`.
+
+Parity: reference pyspark/bigdl/dlframes/dl_image_reader.py —
+`DLImageReader.readImages(path)` loads a directory/glob of images into
+a DataFrame with one `image` struct column
+(origin/height/width/nChannels/data). Spark-free delta: the frame is
+pandas (the dlframes stages consume either), `sc`/partition args are
+accepted and ignored.
+"""
+
+from __future__ import annotations
+
+
+class DLImageReader:
+
+    @staticmethod
+    def readImages(path, sc=None, minParitions=1, bigdl_type="float"):
+        from bigdl_tpu.dlframes.dl_image import DLImageReader as _R
+        return _R.read(path)
+
+    # pep8 spelling used by newer reference code
+    read_images = readImages

@@ -1,0 +1,30 @@
+"""pyspark-BigDL API compatibility: `bigdl.dlframes.dl_image_transformer`.
+
+Parity: reference pyspark/bigdl/dlframes/dl_image_transformer.py —
+a Spark-ML-style Transformer applying a vision FeatureTransformer to
+the image column (input col defaults to `image`, output to `output`,
+always the float schema). Works in sklearn-style pipelines over pandas
+frames here.
+"""
+
+from __future__ import annotations
+
+
+class DLImageTransformer:
+
+    def __init__(self, transformer, jvalue=None, bigdl_type="float"):
+        from bigdl_tpu.dlframes.dl_image import DLImageTransformer as _T
+        native = getattr(transformer, "value", transformer)
+        self.value = _T(native)
+        self.bigdl_type = bigdl_type
+
+    def setInputCol(self, value):
+        self.value.input_col = value
+        return self
+
+    def setOutputCol(self, value):
+        self.value.output_col = value
+        return self
+
+    def transform(self, dataset):
+        return self.value.transform(dataset)

@@ -113,6 +113,147 @@ def _ring_pallas_bwd(axis_name, causal, sm_scale, block_k, axis_size, res,
 _ring_pallas.defvjp(_ring_pallas_fwd, _ring_pallas_bwd)
 
 
+def zigzag_ring_attention(q, k, v, axis_name: str, causal: bool = True,
+                          sm_scale: Optional[float] = None,
+                          block_k: int = 512,
+                          axis_size: Optional[int] = None):
+    """Load-balanced ("zigzag"/striped) causal ring attention.
+
+    Plain contiguous ring + causal mask is 2x wasteful: every (q-shard,
+    kv-shard) pair is computed even though half are fully masked, and
+    SPMD lockstep means conditional skipping would just idle the early
+    devices while the last one grinds. Zigzag sharding fixes the
+    balance: device d holds sequence chunks d AND 2n-1-d concatenated
+    ([B, H, 2c, D] local, c = T/2n), so when fully-masked chunk pairs
+    are skipped (lax.cond — real branches on TPU), every device computes
+    exactly n+1 masked-pair-eligible updates plus n always-unmasked
+    ones. Net: ~2x causal throughput over the plain ring at the same
+    exactness (same online softmax, global offsets).
+
+    Chunk-pair case analysis per hop (src = originating device of the
+    held KV; A = src's low chunk, B = its high chunk):
+      q_low  vs A: diagonal/unmasked iff src <= d  (cond)
+      q_low  vs B: ALWAYS fully masked             (statically skipped)
+      q_high vs A: always fully unmasked           (causal=False path)
+      q_high vs B: diagonal/unmasked iff src >= d  (cond)
+
+    Requires causal=True (zigzag exists only to balance the causal
+    triangle) and even local length. Layout helpers
+    `zigzag_order`/`zigzag_inverse` convert natural global order;
+    `make_sequence_parallel_attention(scheme="zigzag")` applies them
+    around the shard_map so callers keep natural-order tensors (feed
+    the zigzag layout straight from the data pipeline to skip the
+    reorder gather in production)."""
+    from bigdl_tpu.ops import attention_kernel as ak
+    if not causal:
+        raise ValueError("zigzag ring is a causal-balance scheme; use "
+                         "scheme='ring' for non-causal")
+    if jax.default_backend() == "tpu" or ak.INTERPRET:
+        return _zigzag_pallas(q, k, v, axis_name, sm_scale, block_k,
+                              axis_size)
+    return _zigzag_impl(q, k, v, False, axis_name, sm_scale, block_k,
+                        axis_size)
+
+
+def _zigzag_impl(q, k, v, use_pallas, axis_name, sm_scale, block_k,
+                 axis_size):
+    from bigdl_tpu.ops import attention_kernel as ak
+    n = axis_size if axis_size is not None else int(lax.psum(1, axis_name))
+    idx = lax.axis_index(axis_name)
+    if q.shape[2] % 2:
+        raise ValueError("zigzag needs an even local sequence length")
+    c = q.shape[2] // 2
+    sm_scale = sm_scale or q.shape[-1] ** -0.5
+
+    def update(state, qq, kk, vv, q_off, k_off, causal_pair):
+        if use_pallas:
+            return ak.flash_attention_carry(
+                qq, kk, vv, state, causal=causal_pair, sm_scale=sm_scale,
+                q_offset=q_off, k_offset=k_off, block_k=block_k)
+        return blockwise_attention(
+            qq, kk, vv, causal=causal_pair, sm_scale=sm_scale,
+            block_k=block_k, q_offset=q_off, k_offset=k_off,
+            carry=state, finish=False)
+
+    q1, q2 = q[:, :, :c], q[:, :, c:]
+    off_q1 = idx * c
+    off_q2 = (2 * n - 1 - idx) * c
+    s1 = ak.attention_state_init(q1.astype(jnp.float32))
+    s2 = ak.attention_state_init(q2.astype(jnp.float32))
+    perm = [(i, (i + 1) % n) for i in range(n)]
+    k_cur, v_cur = k, v
+    for i in range(n):
+        src = (idx - i) % n
+        a_off, b_off = src * c, (2 * n - 1 - src) * c
+        kA, vA = k_cur[:, :, :c], v_cur[:, :, :c]
+        kB, vB = k_cur[:, :, c:], v_cur[:, :, c:]
+        # q_high vs A: strictly below the diagonal for every (d, src)
+        s2 = update(s2, q2, kA, vA, off_q2, a_off, False)
+        # q_low vs A: on/below the diagonal only when src <= d
+        s1 = lax.cond(
+            src <= idx,
+            lambda s: update(s, q1, kA, vA, off_q1, a_off, True),
+            lambda s: s, s1)
+        # q_high vs B: on/below the diagonal only when src >= d
+        s2 = lax.cond(
+            src >= idx,
+            lambda s: update(s, q2, kB, vB, off_q2, b_off, True),
+            lambda s: s, s2)
+        if i + 1 < n:
+            k_cur = lax.ppermute(k_cur, axis_name, perm)
+            v_cur = lax.ppermute(v_cur, axis_name, perm)
+    out = jnp.concatenate([attention_state_finish(*s1),
+                           attention_state_finish(*s2)], axis=2)
+    return out.astype(q.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _zigzag_pallas(q, k, v, axis_name, sm_scale, block_k, axis_size):
+    return _zigzag_impl(q, k, v, True, axis_name, sm_scale, block_k,
+                        axis_size)
+
+
+def _zigzag_pallas_fwd(q, k, v, axis_name, sm_scale, block_k, axis_size):
+    out = _zigzag_impl(q, k, v, True, axis_name, sm_scale, block_k,
+                       axis_size)
+    return out, (q, k, v)
+
+
+def _zigzag_pallas_bwd(axis_name, sm_scale, block_k, axis_size, res, g):
+    q, k, v = res
+    _, vjp = jax.vjp(
+        lambda q_, k_, v_: _zigzag_impl(q_, k_, v_, False, axis_name,
+                                        sm_scale, block_k, axis_size),
+        q, k, v)
+    return vjp(g)
+
+
+_zigzag_pallas.defvjp(_zigzag_pallas_fwd, _zigzag_pallas_bwd)
+
+
+def zigzag_order(n: int, t: int):
+    """Global T-length permutation: natural order -> zigzag layout
+    (device d's shard = chunks d and 2n-1-d). Apply to q/k/v along the
+    sequence axis before contiguous sharding over the ring axis."""
+    import numpy as np
+    c = t // (2 * n)
+    if t % (2 * n):
+        raise ValueError(f"T={t} must divide by 2*axis_size={2 * n}")
+    order = []
+    for d in range(n):
+        order.extend(range(d * c, (d + 1) * c))
+        order.extend(range((2 * n - 1 - d) * c, (2 * n - d) * c))
+    return np.asarray(order)
+
+
+def zigzag_inverse(n: int, t: int):
+    import numpy as np
+    order = zigzag_order(n, t)
+    inv = np.empty_like(order)
+    inv[order] = np.arange(t)
+    return inv
+
+
 def ulysses_attention(q, k, v, axis_name: str, causal: bool = False,
                       sm_scale: Optional[float] = None):
     """All-to-all sequence parallelism (DeepSpeed-Ulysses style).
@@ -165,12 +306,15 @@ def make_sequence_parallel_attention(mesh: Mesh, scheme: str = "ring",
         from jax import shard_map
     except ImportError:  # older jax
         from jax.experimental.shard_map import shard_map
-    if scheme not in ("ring", "ulysses"):
-        raise ValueError(f"scheme must be ring|ulysses, got {scheme}")
+    if scheme not in ("ring", "ulysses", "zigzag"):
+        raise ValueError(f"scheme must be ring|ulysses|zigzag, got {scheme}")
+    n = int(mesh.shape[axis_name])
     if scheme == "ring":
         fn = functools.partial(ring_attention, axis_name=axis_name,
-                               causal=causal,
-                               axis_size=int(mesh.shape[axis_name]))
+                               causal=causal, axis_size=n)
+    elif scheme == "zigzag":
+        fn = functools.partial(zigzag_ring_attention, axis_name=axis_name,
+                               causal=causal, axis_size=n)
     else:
         fn = functools.partial(ulysses_attention, axis_name=axis_name,
                                causal=causal)
@@ -178,7 +322,7 @@ def make_sequence_parallel_attention(mesh: Mesh, scheme: str = "ring",
 
     kw = {}
     from bigdl_tpu.ops import attention_kernel as ak
-    if scheme == "ring" and ak.INTERPRET:
+    if scheme in ("ring", "zigzag") and ak.INTERPRET:
         # interpret-mode Pallas drops varying-axes types inside the carry
         # kernel's loop (CPU test hook only; the real-TPU path keeps full
         # vma checking). Older shard_map predates the kwarg.
@@ -187,6 +331,19 @@ def make_sequence_parallel_attention(mesh: Mesh, scheme: str = "ring",
             kw["check_vma"] = False
     mapped = shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
                        out_specs=spec, **kw)
+    if scheme == "zigzag":
+        # callers keep natural order: reorder in, inverse-reorder out.
+        # (Feed zigzag-ordered data directly and call the shard_mapped fn
+        # to skip these gathers in a production loop.)
+        def natural_order_fn(q, k, v, _mapped=mapped):
+            t = q.shape[2]
+            order = jnp.asarray(zigzag_order(n, t))
+            inv = jnp.asarray(zigzag_inverse(n, t))
+            o = _mapped(jnp.take(q, order, axis=2),
+                        jnp.take(k, order, axis=2),
+                        jnp.take(v, order, axis=2))
+            return jnp.take(o, inv, axis=2)
+        return natural_order_fn
     return mapped
 
 

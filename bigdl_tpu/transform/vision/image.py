@@ -137,6 +137,12 @@ class ImageFrame:
                 os.path.join(root, f)
                 for root, _, names in os.walk(path)
                 for f in names if f.lower().endswith(exts))
+        elif not os.path.exists(path) and any(c in path for c in "*?["):
+            # wildcard path (reference readImages supports globs the way
+            # sc.binaryFiles does); a real file whose NAME contains glob
+            # metacharacters keeps the direct-read branch above
+            import glob as _glob
+            files = sorted(f for f in _glob.glob(path) if os.path.isfile(f))
         else:
             files = [path]
         features = [ImageFeature.read(f) for f in files]

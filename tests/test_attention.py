@@ -189,6 +189,29 @@ class TestPallasFlashBackward:
         np.testing.assert_allclose(np.asarray(g), np.asarray(gr),
                                    rtol=3e-4, atol=3e-4)
 
+    def test_zigzag_pallas_path(self, monkeypatch):
+        """Zigzag ring with the Pallas hop kernel under lax.cond (forced
+        via INTERPRET): forward parity + custom_vjp gradients."""
+        from bigdl_tpu.ops import attention_kernel as ak
+        monkeypatch.setattr(ak, "INTERPRET", True)
+        from jax.sharding import Mesh
+        from bigdl_tpu.parallel.sequence import (
+            make_sequence_parallel_attention)
+        mesh = Mesh(np.array(jax.devices()[:4]), ("data",))
+        rs = np.random.RandomState(5)
+        q, k, v = (jnp.asarray(rs.randn(1, 2, 256, 32), jnp.float32) * 0.3
+                   for _ in range(3))
+        attn = make_sequence_parallel_attention(mesh, "zigzag", causal=True)
+        out = attn(q, k, v)
+        ref = naive_attention(q, k, v, causal=True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=3e-5, atol=3e-5)
+        g = jax.grad(lambda q_: jnp.sum(attn(q_, k, v) ** 2))(q)
+        gr = jax.grad(lambda q_: jnp.sum(
+            naive_attention(q_, k, v, causal=True) ** 2))(q)
+        np.testing.assert_allclose(np.asarray(g), np.asarray(gr),
+                                   rtol=3e-4, atol=3e-4)
+
     def test_torch_sdpa_golden_fwd_bwd(self):
         """Cross-library oracle: torch scaled_dot_product_attention
         forward AND input gradients."""
@@ -325,6 +348,52 @@ class TestSequenceParallel:
                                               axis_name="data")
         with pytest.raises(ValueError):
             jax.jit(fn)(q, k, v)
+
+    def test_zigzag_matches_unsharded(self):
+        """Load-balanced causal ring: natural-order in/out, exact vs
+        naive (the zigzag reorder + skip logic changes scheduling, not
+        math)."""
+        mesh = build_mesh(data=8, model=1)
+        q, k, v = _qkv(b=2, h=4, t=64, d=16)
+        ref = naive_attention(q, k, v, causal=True)
+        fn = make_sequence_parallel_attention(mesh, scheme="zigzag",
+                                              axis_name="data", causal=True)
+        out = jax.jit(fn)(q, k, v)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=1e-4)
+
+    def test_zigzag_grads_match(self):
+        mesh = build_mesh(data=4, model=2)
+        q, k, v = _qkv(b=1, h=4, t=32, d=8)
+        fn = make_sequence_parallel_attention(mesh, scheme="zigzag",
+                                              axis_name="data", causal=True)
+        for argnum in range(3):
+            g = jax.grad(lambda *a: jax.jit(fn)(*a).sum(),
+                         argnums=argnum)(q, k, v)
+            gr = jax.grad(
+                lambda *a: naive_attention(*a, causal=True).sum(),
+                argnums=argnum)(q, k, v)
+            np.testing.assert_allclose(np.asarray(g), np.asarray(gr),
+                                       atol=1e-4)
+
+    def test_zigzag_refuses_non_causal(self):
+        mesh = build_mesh(data=8, model=1)
+        q, k, v = _qkv(b=1, h=2, t=64, d=8)
+        fn = make_sequence_parallel_attention(mesh, scheme="zigzag",
+                                              axis_name="data", causal=False)
+        with pytest.raises(Exception, match="causal"):
+            jax.jit(fn)(q, k, v)
+
+    def test_zigzag_order_round_trip(self):
+        from bigdl_tpu.parallel.sequence import zigzag_inverse, zigzag_order
+        n, t = 4, 64
+        order, inv = zigzag_order(n, t), zigzag_inverse(n, t)
+        np.testing.assert_array_equal(np.arange(t), order[inv])
+        # device 0's shard = chunks 0 and 2n-1
+        c = t // (2 * n)
+        np.testing.assert_array_equal(order[:c], np.arange(c))
+        np.testing.assert_array_equal(order[c:2 * c],
+                                      np.arange(t - c, t))
 
 
 class TestLongContext:

@@ -473,3 +473,25 @@ class TestDatasetImageFrameWrapper:
         assert all(g.shape == (3, 6, 6) for g in got)
         with pytest.raises(ValueError, match="Unsupported"):
             ds.transform(object())
+
+
+class TestDLImageCompatShims:
+    def test_read_and_transform(self, tmp_path):
+        """bigdl.dlframes.{dl_image_reader,dl_image_transformer}: read a
+        directory of images into the image-struct frame, transform
+        through a vision FeatureTransformer pipeline stage."""
+        pytest.importorskip("PIL")
+        from PIL import Image
+        for i in range(2):
+            arr = (np.random.RandomState(i).rand(10, 8, 3) * 255)
+            Image.fromarray(arr.astype(np.uint8)).save(
+                str(tmp_path / f"img{i}.jpg"))
+        from bigdl.dlframes.dl_image_reader import DLImageReader
+        from bigdl.dlframes.dl_image_transformer import DLImageTransformer
+        from bigdl.transform.vision.image import Resize
+        df = DLImageReader.readImages(str(tmp_path) + "/*.jpg")
+        assert len(df) == 2
+        assert df["image"][0]["height"] == 10
+        out = DLImageTransformer(Resize(6, 6)) \
+            .setOutputCol("resized").transform(df)
+        assert np.asarray(out["resized"][0]["data"]).shape[:2] == (6, 6)
