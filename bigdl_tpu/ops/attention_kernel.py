@@ -217,9 +217,12 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k: int,
     den = jnp.where(l == 0.0, 1.0, l)
     o_ref[0] = (acc / den[:, None]).astype(o_ref.dtype)
     # logsumexp per row; fully-masked rows get shift=0, den=1 -> lse=0,
-    # and the backward's exp(NEG_INF - 0) correctly vanishes
+    # and the backward's exp(NEG_INF - 0) correctly vanishes.
+    # lse rides as [bh, 1, T]: Mosaic requires the 2nd-minor block dim to
+    # divide 8 or equal the array dim, which a (1, block_q) block over
+    # [bh, T] violates whenever block_q < T (live-TPU finding, round 5)
     shift = jnp.where(m <= NEG_INF / 2, 0.0, m)
-    lse_ref[0] = shift + jnp.log(den)
+    lse_ref[0, 0] = shift + jnp.log(den)
 
 
 def flash_attention_forward(q, k, v, causal: bool = False,
@@ -257,11 +260,11 @@ def flash_attention_forward(q, k, v, causal: bool = False,
         ],
         out_specs=[
             pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
-            pl.BlockSpec((1, block_q), lambda i, j: (i, j)),
+            pl.BlockSpec((1, 1, block_q), lambda i, j: (i, 0, j)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((bh, tq, d), q.dtype),
-            jax.ShapeDtypeStruct((bh, tq), jnp.float32),
+            jax.ShapeDtypeStruct((bh, 1, tq), jnp.float32),
         ],
         interpret=interpret,
     )(qr, kr, vr)
@@ -283,8 +286,8 @@ def _flash_carry_kernel(q_ref, k_ref, v_ref, acc_ref, m_ref, l_ref,
     q = q_ref[0].astype(jnp.float32)            # [bq, d]
     block_q, d = q.shape
     acc = acc_ref[0].astype(jnp.float32)
-    m = m_ref[0].astype(jnp.float32)
-    l = l_ref[0].astype(jnp.float32)
+    m = m_ref[0, 0].astype(jnp.float32)         # [bh, 1, T] ride (see
+    l = l_ref[0, 0].astype(jnp.float32)         # _flash_fwd_kernel lse)
     q_off = off_ref[0] + pl.program_id(1) * block_q
     k_off = off_ref[1]
     n_kb = seq_k // block_k
@@ -305,8 +308,8 @@ def _flash_carry_kernel(q_ref, k_ref, v_ref, acc_ref, m_ref, l_ref,
     else:
         acc, m, l = jax.lax.fori_loop(0, n_kb, body, (acc, m, l))
     oacc_ref[0] = acc
-    om_ref[0] = m
-    ol_ref[0] = l
+    om_ref[0, 0] = m
+    ol_ref[0, 0] = l
 
 
 def _match_vma(val, like):
@@ -383,8 +386,8 @@ def flash_attention_carry(q, k, v, carry, causal: bool = False,
                 pl.BlockSpec((1, tk, d), lambda i, j: (i, 0, 0)),
                 pl.BlockSpec((1, tk, d), lambda i, j: (i, 0, 0)),
                 pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
-                pl.BlockSpec((1, block_q), lambda i, j: (i, j)),
-                pl.BlockSpec((1, block_q), lambda i, j: (i, j)),
+                pl.BlockSpec((1, 1, block_q), lambda i, j: (i, 0, j)),
+                pl.BlockSpec((1, 1, block_q), lambda i, j: (i, 0, j)),
                 # offsets feed control flow (the causal loop bound):
                 # Mosaic requires such scalars in SMEM; interpret mode
                 # ignores the memory space
@@ -392,17 +395,17 @@ def flash_attention_carry(q, k, v, carry, causal: bool = False,
             ],
             out_specs=[
                 pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
-                pl.BlockSpec((1, block_q), lambda i, j: (i, j)),
-                pl.BlockSpec((1, block_q), lambda i, j: (i, j)),
+                pl.BlockSpec((1, 1, block_q), lambda i, j: (i, 0, j)),
+                pl.BlockSpec((1, 1, block_q), lambda i, j: (i, 0, j)),
             ],
             out_shape=[
                 _struct_like((bh, tq, d), jnp.float32, q),
-                _struct_like((bh, tq), jnp.float32, q),
-                _struct_like((bh, tq), jnp.float32, q),
+                _struct_like((bh, 1, tq), jnp.float32, q),
+                _struct_like((bh, 1, tq), jnp.float32, q),
             ],
             interpret=interpret,
         )(q.reshape(bh, tq, d), k.reshape(bh, tk, d), v.reshape(bh, tk, d),
-          acc.reshape(bh, tq, d), m.reshape(bh, tq), l.reshape(bh, tq),
+          acc.reshape(bh, tq, d), m.reshape(bh, 1, tq), l.reshape(bh, 1, tq),
           offs)
     except TypeError:
         # varying-axes typing rejected the kernel on this backend/version:
@@ -429,8 +432,8 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     q = q_ref[0].astype(jnp.float32)            # [bq, d]
     do = do_ref[0].astype(jnp.float32)          # [bq, d]
-    lse = lse_ref[0].astype(jnp.float32)        # [bq]
-    delta = delta_ref[0].astype(jnp.float32)    # [bq]
+    lse = lse_ref[0, 0].astype(jnp.float32)     # [bq] ([bh, 1, T] ride)
+    delta = delta_ref[0, 0].astype(jnp.float32)  # [bq]
     block_q, d = q.shape
     q_off = pl.program_id(1) * block_q
     n_kb = seq_k // block_k
@@ -480,8 +483,9 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dk, dv = carry
         q = q_ref[0, pl.ds(ib * block_q, block_q), :].astype(jnp.float32)
         do = do_ref[0, pl.ds(ib * block_q, block_q), :].astype(jnp.float32)
-        lse = lse_ref[0, pl.ds(ib * block_q, block_q)].astype(jnp.float32)
-        delta = delta_ref[0, pl.ds(ib * block_q, block_q)].astype(
+        lse = lse_ref[0, 0, pl.ds(ib * block_q, block_q)].astype(
+            jnp.float32)
+        delta = delta_ref[0, 0, pl.ds(ib * block_q, block_q)].astype(
             jnp.float32)
         s = jax.lax.dot_general(q, k_blk, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)
@@ -534,10 +538,10 @@ def flash_attention_backward(q, k, v, out, lse, g, causal: bool = False,
     bh = b * h
     qr, kr, vr = (x.reshape(bh, -1, d) for x in (q, k, v))
     dor = g.reshape(bh, tq, d)
-    lser = lse.reshape(bh, tq)
+    lser = lse.reshape(bh, 1, tq)  # [bh, 1, T] ride (see _flash_fwd_kernel)
     # delta_i = rowsum(dO * O): tiny elementwise reduce, XLA fuses it
     delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32),
-                    axis=-1).reshape(bh, tq)
+                    axis=-1).reshape(bh, 1, tq)
 
     dq_kernel = functools.partial(_flash_bwd_dq_kernel, block_k=block_k,
                                   sm_scale=sm_scale, causal=causal,
@@ -550,8 +554,8 @@ def flash_attention_backward(q, k, v, out, lse, g, causal: bool = False,
             pl.BlockSpec((1, tk, d), lambda i, j: (i, 0, 0)),
             pl.BlockSpec((1, tk, d), lambda i, j: (i, 0, 0)),
             pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
-            pl.BlockSpec((1, block_q), lambda i, j: (i, j)),
-            pl.BlockSpec((1, block_q), lambda i, j: (i, j)),
+            pl.BlockSpec((1, 1, block_q), lambda i, j: (i, 0, j)),
+            pl.BlockSpec((1, 1, block_q), lambda i, j: (i, 0, j)),
         ],
         out_specs=pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
         out_shape=jax.ShapeDtypeStruct((bh, tq, d), q.dtype),
@@ -569,8 +573,8 @@ def flash_attention_backward(q, k, v, out, lse, g, causal: bool = False,
             pl.BlockSpec((1, block_k, d), lambda i, j: (i, j, 0)),
             pl.BlockSpec((1, block_k, d), lambda i, j: (i, j, 0)),
             pl.BlockSpec((1, tq, d), lambda i, j: (i, 0, 0)),
-            pl.BlockSpec((1, tq), lambda i, j: (i, 0)),
-            pl.BlockSpec((1, tq), lambda i, j: (i, 0)),
+            pl.BlockSpec((1, 1, tq), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((1, 1, tq), lambda i, j: (i, 0, 0)),
         ],
         out_specs=[
             pl.BlockSpec((1, block_k, d), lambda i, j: (i, j, 0)),

@@ -38,30 +38,46 @@ INTERPRET = False
 
 
 def _stem_kernel(x_ref, w_ref, b_ref, o_ref, *, kt: int, c2: int,
-                 tile_h: int, out_w: int, n_out: int):
+                 tile_h: int, tile_w: int, n_out: int):
     """One program = one (batch, row-tile): assemble the patch tile and
-    run the fused GEMM + bias."""
+    run the fused GEMM + bias.
+
+    The caller hands the padded image PRE-SHIFTED along W, one copy per
+    dx tap, stacked on a leading axis. Slicing a tap at a nonzero dx
+    offset gives it a nonzero sublane offset, and Mosaic's concatenate
+    refuses operands whose offsets differ on a non-concat dimension
+    (live-TPU finding, round 5: "result/input offset mismatch on
+    non-concat dimension"). With the shifts hoisted to XLA, every tap
+    here is sliced at W offset 0, so all concat operands share sublane
+    offset 0 and only differ on the lane (concat) dim — which Mosaic
+    handles. dy stays an in-kernel slice: H is an untiled leading dim of
+    the 3D vector, so dy offsets carry no layout."""
     from jax.experimental import pallas as pl
 
     j = pl.program_id(1)
-    # padded rows this tile reads: [tile_h + kt - 1, Wpad, c2]
-    rows = x_ref[0, pl.ds(j * tile_h, tile_h + kt - 1), :, :]
-    rows = rows.astype(jnp.float32)
     taps = []
-    for dy in range(kt):            # static tap loop -> fused VMEM copies
-        for dx in range(kt):
-            taps.append(rows[dy:dy + tile_h, dx:dx + out_w, :])
-    patches = jnp.concatenate(taps, axis=-1)        # [tile_h, W, kt*kt*c2]
-    patches = patches.reshape(tile_h * out_w, kt * kt * c2)
+    for dx in range(kt):            # static tap loop -> fused VMEM copies
+        # rows this tile reads from the dx-shifted copy:
+        # [tile_h + kt - 1, tile_w, c2], W offset 0 by construction (the
+        # W tile itself is selected by the block index map)
+        rows = x_ref[dx, 0, pl.ds(j * tile_h, tile_h + kt - 1), :, :]
+        rows = rows.astype(jnp.float32)
+        for dy in range(kt):
+            taps.append(rows[dy:dy + tile_h])
+    # kernel layout is (dy, dx, c) tap-major — reorder the dx-major list
+    patches = jnp.concatenate(
+        [taps[dx * kt + dy] for dy in range(kt) for dx in range(kt)],
+        axis=-1)                              # [tile_h, tile_w, kt*kt*c2]
+    patches = patches.reshape(tile_h * tile_w, kt * kt * c2)
     acc = jax.lax.dot_general(
         patches, w_ref[...].astype(jnp.float32),
         (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
     acc = acc + b_ref[...].astype(jnp.float32)
-    o_ref[0] = acc.reshape(tile_h, out_w, n_out).astype(o_ref.dtype)
+    o_ref[0] = acc.reshape(tile_h, tile_w, n_out).astype(o_ref.dtype)
 
 
 def stem_conv_forward(x2, wk, bias, pad_front: int, pad_rear: int,
-                      tile_h: int = 8,
+                      tile_h: int = 8, tile_w: int = 56,
                       interpret: Optional[bool] = None):
     """Pallas forward for the s2d stem.
 
@@ -79,30 +95,42 @@ def stem_conv_forward(x2, wk, bias, pad_front: int, pad_rear: int,
     assert pad_front + pad_rear == kt - 1, (pad_front, pad_rear, kt)
     xp = jnp.pad(x2, ((0, 0), (pad_front, pad_rear),
                       (pad_front, pad_rear), (0, 0)))
-    hp, wp = xp.shape[1], xp.shape[2]
+    hp = xp.shape[1]
     while h % tile_h:
         tile_h //= 2               # h is even for every real stem input
+    # w tiling bounds live VMEM registers (the full-width tile OOMed
+    # scoped vmem at 224x224/b128); Mosaic needs the sublane block dim
+    # divisible by 8 or equal to the full array dim
+    cands = [d for d in range(min(tile_w, w), 0, -1)
+             if w % d == 0 and d % 8 == 0]
+    tile_w = cands[0] if cands else w
+    # one W-shifted copy of the padded image per dx tap, trimmed back to
+    # the output width (see _stem_kernel: in-kernel dx slices are
+    # Mosaic-illegal under concatenate; the roll is a cheap XLA op paid
+    # once per step, the wraparound columns land past w and are trimmed)
+    xs = jnp.stack([jnp.roll(xp, -dx, axis=2)[:, :, :w] for dx in range(kt)])
     w2 = wk.reshape(-1, n_out)     # [kt*kt*c2, O] — tap-major like taps
-    # nn/conv.py kernel layout is (dy, dx, c) tap order; taps list above
-    # concatenates channels per (dy, dx) in the same order, so a plain
-    # reshape lines up.
+    # nn/conv.py kernel layout is (dy, dx, c) tap order; the kernel's
+    # concat reorders its dx-major tap list to the same (dy, dx) order,
+    # so a plain reshape lines up.
     bvec = bias if bias is not None else jnp.zeros((n_out,), x2.dtype)
 
     kernel = functools.partial(_stem_kernel, kt=kt, c2=c2, tile_h=tile_h,
-                               out_w=w, n_out=n_out)
+                               tile_w=tile_w, n_out=n_out)
     out = pl.pallas_call(
         kernel,
-        grid=(b, h // tile_h),
+        grid=(b, h // tile_h, w // tile_w),
         in_specs=[
-            pl.BlockSpec((1, hp, wp, c2), lambda i, j: (i, 0, 0, 0)),
-            pl.BlockSpec((kt * kt * c2, n_out), lambda i, j: (0, 0)),
-            pl.BlockSpec((n_out,), lambda i, j: (0,)),
+            pl.BlockSpec((kt, 1, hp, tile_w, c2),
+                         lambda i, j, kw: (0, i, 0, kw, 0)),
+            pl.BlockSpec((kt * kt * c2, n_out), lambda i, j, kw: (0, 0)),
+            pl.BlockSpec((n_out,), lambda i, j, kw: (0,)),
         ],
-        out_specs=pl.BlockSpec((1, tile_h, w, n_out),
-                               lambda i, j: (i, j, 0, 0)),
+        out_specs=pl.BlockSpec((1, tile_h, tile_w, n_out),
+                               lambda i, j, kw: (i, j, kw, 0)),
         out_shape=jax.ShapeDtypeStruct((b, h, w, n_out), x2.dtype),
         interpret=interpret,
-    )(xp, w2, bvec)
+    )(xs, w2, bvec)
     return out
 
 
