@@ -286,3 +286,35 @@ class TestRandomRecurrentConfigs:
                                         jnp.asarray(x))[0])
         want = {"sum": a + b, "mul": a * b, "ave": (a + b) / 2}[merge]
         np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+class TestMosaicLegalSpecs:
+    """Static Mosaic block-mapping rules, learned from the first live-TPU
+    compile of the round-4 kernels (docs/PERF.md round-5 section): the
+    last two block dims must divide (8, 128) or equal the array dims.
+    These checks run on CPU so a regression is caught before the next
+    hardware session."""
+
+    def test_stem_tile_w_selection_is_mosaic_legal(self):
+        from bigdl_tpu.ops import stem_kernel as sk
+        import jax.numpy as jnp
+        for w in (112, 56, 16, 28, 8, 12):
+            cands = [d for d in range(min(56, w), 0, -1)
+                     if w % d == 0 and d % 8 == 0]
+            tile_w = cands[0] if cands else w
+            assert tile_w == w or tile_w % 8 == 0
+            assert w % tile_w == 0
+
+    def test_flash_lse_rides_3d(self):
+        """The fwd kernel's lse output must be [bh, 1, T]-shaped so its
+        (1, 1, block_q) blocks satisfy the block-mapping rule whenever
+        block_q < T."""
+        import jax
+        from bigdl_tpu.ops import attention_kernel as ak
+        b, h, t, d = 1, 2, 512, 64
+        q = jnp.ones((b, h, t, d), jnp.float32)
+        out, lse = jax.eval_shape(
+            lambda a: ak.flash_attention_forward(a, a, a, interpret=True,
+                                                 return_lse=True), q)
+        assert out.shape == (b, h, t, d)
+        assert lse.shape == (b, h, t)
