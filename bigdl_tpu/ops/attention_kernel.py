@@ -604,7 +604,11 @@ def _flash_plan(q_shape, k_shape, causal, use_pallas):
     # measured 2026-07-31 block sweep (docs/bench_records). Prefer it only
     # when it divides tk — padding would push non-causal odd-multiple-of-512
     # key lengths (1536, 2560, ...) off the Pallas path entirely.
-    bq = min(256, _ceil_to(t, 8))
+    # block_q 512 when it divides t: +6-8% on the fwd+bwd training path
+    # vs 256 (22.0/40.1 TF/s at 8k/16k, v5e live sweep 2026-08-01,
+    # docs/bench_records/r05_flash_sweep.txt); otherwise keep 256, whose
+    # padding behavior for ragged t is long-tested
+    bq = 512 if t % 512 == 0 else min(256, _ceil_to(t, 8))
     for bk in (1024, 512):
         if tk % bk == 0:
             break
