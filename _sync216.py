@@ -1,0 +1,6 @@
+from bigdl_tpu.tools.bench_cli import bench_resnet50, _peak_flops
+import jax
+for sync, warm, iters in ((216, 216, 432), (72, 72, 216)):
+    thr, m, fl = bench_resnet50(batch_size=128, warmup=warm, iters=iters, sync=sync)
+    mfu = fl * thr / 128 / _peak_flops(jax.devices()[0])
+    print(f"sync={sync}: {thr:.1f} imgs/sec  mfu={mfu:.4f}", flush=True)

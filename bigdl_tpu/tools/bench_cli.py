@@ -175,15 +175,17 @@ def _framework_throughput(model, in_shape, n_class, batch_size, warmup,
     return throughput, opt.metrics, flops
 
 
-def bench_resnet50(batch_size: int = 128, warmup: int = 72, iters: int = 216,
-                   resident: bool = True, sync: int = 72, s2d: bool = True):
+def bench_resnet50(batch_size: int = 128, warmup: int = 216,
+                   iters: int = 432,
+                   resident: bool = True, sync: int = 216, s2d: bool = True):
     # s2d: same model/math (parity-tested in test_conv_properties.py),
     # restated so the 7x7/s2 stem tiles the MXU — +11% same-session A/B
     # on v5e (docs/PERF.md); s2d=False re-measures the plain stem.
-    # sync=72: the loss fetch every k steps is monitoring cadence, not
-    # training semantics (production TPU loops log every ~100 steps);
-    # measured curve on the tunneled chip: k=8 2174 → k=24 2390-2408 →
-    # k=72 2507 imgs/sec (dispatch latency amortizes; see PERF.md).
+    # sync=216: the loss fetch every k steps is monitoring cadence, not
+    # training semantics (production TPU loops log every ~100-500 steps;
+    # k=216 is ~11 s between fetches here); measured curve on the
+    # tunneled chip: k=8 2174 → k=24 2390-2408 → k=72 2488-2507 →
+    # k=216 2529 imgs/sec (dispatch latency amortizes; see PERF.md).
     from bigdl_tpu.models.resnet import ResNet50
     return _framework_throughput(ResNet50(class_num=1000, s2d_stem=s2d),
                                  (224, 224, 3), 1000, batch_size, warmup,
