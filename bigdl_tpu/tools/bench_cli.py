@@ -176,7 +176,7 @@ def _framework_throughput(model, in_shape, n_class, batch_size, warmup,
 
 
 def bench_resnet50(batch_size: int = 128, warmup: int = 216,
-                   iters: int = 432,
+                   iters: int = 648,  # 3 timed windows (median needs >2)
                    resident: bool = True, sync: int = 216, s2d: bool = True):
     # s2d: same model/math (parity-tested in test_conv_properties.py),
     # restated so the 7x7/s2 stem tiles the MXU — +11% same-session A/B
