@@ -4,7 +4,7 @@ The reference's sequence models stop at recurrent nets (SURVEY.md §5.7);
 this example trains the decoder-only `TransformerLM` (RoPE, pre-norm,
 flash attention on TPU) on a synthetic Markov corpus, and demonstrates
 the long-context inference path: scoring a sequence longer than the
-training length, optionally with ring/Ulysses sequence parallelism over
+training length, optionally with ring/Ulysses/zigzag sequence parallelism over
 the mesh's data axis (`--sequence-parallel`, needs a multi-device mesh —
 e.g. the 8-virtual-device CPU mesh the tests use).
 """
@@ -33,7 +33,8 @@ def main(argv=None):
     p.add_argument("--max-iteration", type=int, default=150)
     p.add_argument("--long-len", type=int, default=256,
                    help="inference length for the long-context score")
-    p.add_argument("--sequence-parallel", choices=["ring", "ulysses"],
+    p.add_argument("--sequence-parallel",
+                   choices=["ring", "ulysses", "zigzag"],
                    default=None)
     args = p.parse_args(argv)
 
@@ -88,8 +89,8 @@ def main(argv=None):
         from bigdl_tpu.ops.attention_kernel import naive_attention
         mesh = build_mesh(model=1)
         n_dev = int(mesh.devices.size)
-        h = args.heads if args.sequence_parallel == "ring" else \
-            max(args.heads, n_dev)
+        h = args.heads if args.sequence_parallel in ("ring", "zigzag") \
+            else max(args.heads, n_dev)  # ulysses shards heads
         T = args.long_len
         rs = np.random.RandomState(0)
         qkv = [jnp.asarray(rs.randn(1, h, T, 16), jnp.float32)
