@@ -20,6 +20,17 @@ Forward-only by design: the stem backward is a small share of the step
 (PERF.md), so `stem_conv` wraps the kernel in `jax.custom_vjp` with the
 mathematically-identical XLA conv supplying the gradients.
 
+MEASURED OUTCOME (v5e, 2026-08-01, docs/PERF.md round-5 section): after
+two Mosaic-legality fixes (pre-rolled dx shifts, W grid tiling) the
+kernel compiles and is bit-close to the XLA restatement on hardware —
+and is 9.4x SLOWER (40.9 ms vs 4.37 ms at b128; -44.5% through the full
+framework loop). The 12-channel taps occupy 12/128 lanes of every
+vector register, wasting ~10x vector bandwidth that no tile shape
+recovers, while XLA's conv keeps full layouts throughout. The kernel is
+therefore env-gated (`BIGDL_TPU_PALLAS_STEM=1`), kept as a
+parity-tested negative result; the XLA space-to-depth restatement
+(nn/conv.py) is the production stem.
+
 No reference counterpart (the reference's CPU im2col is
 layout-insensitive; this exists because of the MXU's tiling rules).
 """
