@@ -417,3 +417,19 @@ class TestLongContext:
         got = attn(q, k, v)
         np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                    rtol=2e-4, atol=2e-5)
+
+
+def test_flash_plan_block_q_tuned_default():
+    """bq=512 on 512-divisible lengths (+6-8% fwd+bwd on v5e, r05 sweep);
+    ragged lengths keep the 256 fallback and its padding behavior."""
+    from bigdl_tpu.ops.attention_kernel import _flash_plan
+    use, bq, bk, pq, pk = _flash_plan((1, 8, 2048, 64), (1, 8, 2048, 64),
+                                      True, True)
+    assert use and bq == 512 and bk == 1024
+    use, bq, bk, pq, pk = _flash_plan((1, 8, 8192, 64), (1, 8, 8192, 64),
+                                      True, True)
+    assert use and bq == 512 and bk == 1024
+    # ragged: not divisible by 512 -> legacy 256 path with padding
+    use, bq, bk, pq, pk = _flash_plan((1, 8, 300, 64), (1, 8, 300, 64),
+                                      True, True)
+    assert use and bq == 256
