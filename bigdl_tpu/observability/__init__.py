@@ -1,0 +1,40 @@
+"""bigdl_tpu.observability — spans, run telemetry, and train-loop health.
+
+The reference framework's observability is the `Metrics` phase table
+(DL/optim/Metrics.scala:36-103) plus TensorBoard scalars; on a compiled
+runtime that is not enough — XLA hides per-op boundaries, so a training run
+needs first-class host-side instrumentation to leave a machine-readable
+record. Three layers, each usable alone:
+
+- `spans` — nested host-side trace spans with `jax.profiler.TraceAnnotation`
+  integration, exportable as Chrome/Perfetto trace JSON so host phases line
+  up with the XLA device trace.
+- `telemetry` — structured per-step run metrics (loss, lr, throughput,
+  step time, optional grad/param norms, host RSS, device memory) fanned out
+  to pluggable sinks (JSONL file, in-memory, TrainSummary bridge).
+- `health` — train-loop guards: NaN/Inf loss+gradient guard (warn /
+  skip-step / raise), slow-step straggler detection, and throughput-
+  regression warnings.
+
+Both `LocalOptimizer` and `DistriOptimizer` accept these via
+`set_tracer` / `set_telemetry` / `set_health_monitors`.
+"""
+
+from bigdl_tpu.observability.spans import SpanTracer
+from bigdl_tpu.observability.telemetry import (CompositeSink, InMemorySink,
+                                               JsonlSink, SummarySink,
+                                               Telemetry, TelemetrySink,
+                                               device_memory_stats,
+                                               host_rss_mb)
+from bigdl_tpu.observability.health import (HealthMonitor, NanGuard,
+                                            StragglerDetector,
+                                            ThroughputMonitor,
+                                            TrainingHealthError)
+
+__all__ = [
+    "SpanTracer",
+    "Telemetry", "TelemetrySink", "JsonlSink", "InMemorySink",
+    "SummarySink", "CompositeSink", "host_rss_mb", "device_memory_stats",
+    "HealthMonitor", "NanGuard", "StragglerDetector", "ThroughputMonitor",
+    "TrainingHealthError",
+]

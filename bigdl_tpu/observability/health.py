@@ -1,0 +1,152 @@
+"""Train-loop health monitors: NaN guards, straggler and throughput watch.
+
+Silent failure modes a compiled training loop does not surface on its own:
+a NaN loss keeps "training" forever, one straggling step hides inside an
+averaged throughput figure, and a slow throughput bleed only shows up when
+someone rereads old logs. Monitors attach to an optimizer via
+`set_health_monitors(...)` and observe every sync-point step record (the
+same dict the telemetry stream carries); findings go to the training
+logger and, when telemetry is attached, to the stream as `event` records.
+
+The NaN guard's `skip` action is enforced INSIDE the jitted step (a
+`jnp.where` on the update, so it works under buffer donation and costs one
+select per leaf); the host side only reports. `raise` aborts the run with
+`TrainingHealthError` — under `DistriOptimizer` with a checkpoint
+configured, the standard retry-from-snapshot path catches it, which makes
+"raise + checkpoint" a rollback-on-NaN recovery policy.
+"""
+
+from __future__ import annotations
+
+import logging
+import math
+import statistics
+from collections import deque
+from typing import Dict, Optional
+
+logger = logging.getLogger("bigdl_tpu.optim")
+
+
+class TrainingHealthError(RuntimeError):
+    """Raised by a monitor whose action is "raise" (NaN/Inf loss or
+    gradients with `NanGuard(action="raise")`)."""
+
+
+class HealthMonitor:
+    """Base monitor: `observe(record, telemetry)` is called at every sync
+    point with the step record; implementations log/emit/raise."""
+
+    def observe(self, record: Dict, telemetry=None):
+        raise NotImplementedError
+
+    def _emit(self, telemetry, kind: str, **fields):
+        if telemetry is not None:
+            telemetry.event(kind, **fields)
+
+
+class NanGuard(HealthMonitor):
+    """NaN/Inf loss and gradient guard.
+
+    action:
+      - "warn"  — log + telemetry event, training continues.
+      - "skip"  — additionally the jitted step REVERTS the weight/slot/
+        state update for any non-finite step (old values kept via
+        jnp.where), so one poisoned batch cannot destroy the run.
+      - "raise" — abort with TrainingHealthError.
+
+    `check_grads=True` also guards the global gradient norm (computed
+    in-step), catching inf/NaN gradients before they reach a finite loss.
+    """
+
+    ACTIONS = ("warn", "skip", "raise")
+
+    def __init__(self, action: str = "warn", check_grads: bool = True):
+        if action not in self.ACTIONS:
+            raise ValueError(f"action must be one of {self.ACTIONS}, "
+                             f"got {action!r}")
+        self.action = action
+        self.check_grads = check_grads
+        self.nonfinite_steps = 0  # running total over the run
+
+    def observe(self, record: Dict, telemetry=None):
+        bad = int(record.get("nonfinite_steps", 0))
+        if not bad:
+            loss = record.get("loss")
+            bad = int(loss is not None and not math.isfinite(loss))
+        if not bad:
+            return
+        self.nonfinite_steps += bad
+        msg = (f"non-finite loss/gradients at iteration "
+               f"{record.get('step')} (loss={record.get('loss')}, "
+               f"{bad} step(s) this window, action={self.action})")
+        self._emit(telemetry, "nan_guard", step=record.get("step"),
+                   loss=record.get("loss"), nonfinite_steps=bad,
+                   action=self.action)
+        if self.action == "raise":
+            raise TrainingHealthError(msg)
+        verb = "update skipped: " if self.action == "skip" else ""
+        logger.warning(f"[NanGuard] {verb}{msg}")
+
+
+class StragglerDetector(HealthMonitor):
+    """Slow-step detector: warns when a sync window's per-step wall time
+    exceeds `factor` x the rolling p50 of the last `window` observations.
+    On SPMD hardware a host-visible straggler step means input-pipeline
+    stalls, host contention, or an unhealthy interconnect — the reference's
+    dropped-task percentile (DistriOptimizer "dropPercentage") reported
+    instead of silently absorbed."""
+
+    def __init__(self, factor: float = 3.0, window: int = 64,
+                 min_history: int = 8):
+        self.factor = factor
+        self.min_history = min_history
+        self.history: deque = deque(maxlen=window)
+        self.stragglers = 0
+
+    def observe(self, record: Dict, telemetry=None):
+        dt = record.get("step_time_s")
+        if dt is None or not math.isfinite(dt):
+            return
+        if len(self.history) >= self.min_history:
+            p50 = statistics.median(self.history)
+            if p50 > 0 and dt > self.factor * p50:
+                self.stragglers += 1
+                logger.warning(
+                    f"[StragglerDetector] iteration {record.get('step')} "
+                    f"took {dt * 1e3:.1f} ms/step vs rolling p50 "
+                    f"{p50 * 1e3:.1f} ms ({dt / p50:.1f}x)")
+                self._emit(telemetry, "straggler",
+                           step=record.get("step"), step_time_s=dt,
+                           p50_step_time_s=p50)
+        self.history.append(dt)
+
+
+class ThroughputMonitor(HealthMonitor):
+    """Throughput-regression warning: compares each window's records/sec
+    against the rolling median of the last `window` windows and warns when
+    it drops below `(1 - tolerance)` of that median — the "shrinking
+    throughput" failure mode made loud."""
+
+    def __init__(self, tolerance: float = 0.3, window: int = 20,
+                 min_history: int = 5):
+        self.tolerance = tolerance
+        self.min_history = min_history
+        self.history: deque = deque(maxlen=window)
+        self.regressions = 0
+
+    def observe(self, record: Dict, telemetry=None):
+        tp = record.get("throughput")
+        if tp is None or not math.isfinite(tp):
+            return
+        if len(self.history) >= self.min_history:
+            med = statistics.median(self.history)
+            if med > 0 and tp < (1.0 - self.tolerance) * med:
+                self.regressions += 1
+                logger.warning(
+                    f"[ThroughputMonitor] iteration {record.get('step')}: "
+                    f"{tp:.1f} records/sec is {1 - tp / med:.0%} below the "
+                    f"rolling median {med:.1f}")
+                self._emit(telemetry, "throughput_regression",
+                           step=record.get("step"), throughput=tp,
+                           median_throughput=med)
+        self.history.append(tp)

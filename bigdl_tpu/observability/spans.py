@@ -1,0 +1,124 @@
+"""Nested host-side trace spans, exportable as Chrome/Perfetto trace JSON.
+
+Why host spans at all on a compiled runtime: the XLA device trace (xprof /
+`jax.profiler.start_trace`) shows fused ops, not framework phases — "data
+fetch", "step dispatch", "loss sync", "checkpoint" are host concepts the
+compiler never sees. A `SpanTracer` records those phases with wall-clock
+timestamps and exports the standard Chrome trace-event format, which
+Perfetto (and TensorBoard's trace viewer) loads directly; opening the host
+trace next to a device trace captured in the same run lines the two up on
+absolute time.
+
+Each span also enters a `jax.profiler.TraceAnnotation`, so when the XLA
+profiler IS active the same phase names appear inside the device trace's
+host rows — one naming scheme across both views.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import threading
+import time
+from collections import deque
+from typing import Dict, List
+
+
+class SpanTracer:
+    """Records nested `with tracer.span(name): ...` phases.
+
+    Spans are complete events ("ph": "X") in the Chrome trace-event format:
+    microsecond wall-clock timestamps (absolute epoch, so the trace can be
+    overlaid on an xprof device trace from the same run), per-thread track
+    ids, and arbitrary JSON-safe `args`. Thread-safe; each thread carries
+    its own span stack.
+
+    `annotate=True` (default) additionally wraps every span in
+    `jax.profiler.TraceAnnotation`, a no-op unless the XLA profiler is
+    tracing.
+
+    `max_events` bounds host memory for long runs (the loops record a
+    handful of spans per iteration): once full, the OLDEST events are
+    dropped — the export keeps the most recent window and reports the
+    drop count in the process metadata (`dropped_events`)."""
+
+    def __init__(self, process_name: str = "bigdl_tpu",
+                 annotate: bool = True, max_events: int = 1_000_000):
+        self.process_name = process_name
+        self.annotate = annotate
+        self._events: deque = deque(maxlen=max_events)
+        self.dropped_events = 0
+        self._lock = threading.Lock()
+        # perf_counter supplies monotonic durations; the wall base anchors
+        # them to absolute epoch time for cross-trace alignment
+        self._wall0_us = time.time() * 1e6
+        self._perf0 = time.perf_counter()
+
+    def _now_us(self) -> float:
+        return self._wall0_us + (time.perf_counter() - self._perf0) * 1e6
+
+    @contextlib.contextmanager
+    def span(self, name: str, cat: str = "host", **args):
+        """Time a nested phase. `args` must be JSON-serializable; they land
+        in the trace event's `args` field (visible in Perfetto's detail
+        pane)."""
+        ann = None
+        if self.annotate:
+            try:
+                import jax
+                ann = jax.profiler.TraceAnnotation(name)
+                ann.__enter__()
+            except Exception:
+                ann = None
+        t0 = self._now_us()
+        try:
+            yield self
+        finally:
+            dur = self._now_us() - t0
+            if ann is not None:
+                ann.__exit__(None, None, None)
+            ev = {"name": name, "cat": cat, "ph": "X",
+                  "ts": t0, "dur": dur,
+                  "pid": 1, "tid": threading.get_ident() % 2 ** 31}
+            if args:
+                ev["args"] = args
+            with self._lock:
+                if len(self._events) == self._events.maxlen:
+                    self.dropped_events += 1
+                self._events.append(ev)
+
+    @property
+    def events(self) -> List[Dict]:
+        """Snapshot of the recorded complete events (for tests/tools)."""
+        with self._lock:
+            return list(self._events)
+
+    def reset(self):
+        with self._lock:
+            self._events.clear()
+            self.dropped_events = 0
+
+    def to_chrome_trace(self) -> Dict:
+        """The trace as a Chrome trace-event JSON object (Perfetto-loadable:
+        `{"traceEvents": [...], "displayTimeUnit": "ms"}` plus process/
+        thread metadata events)."""
+        with self._lock:
+            events = list(self._events)
+            dropped = self.dropped_events
+        proc_args = {"name": self.process_name}
+        if dropped:
+            proc_args["dropped_events"] = dropped
+        meta = [{"name": "process_name", "ph": "M", "pid": 1, "tid": 0,
+                 "args": proc_args}]
+        for tid in sorted({e["tid"] for e in events}):
+            meta.append({"name": "thread_name", "ph": "M", "pid": 1,
+                         "tid": tid, "args": {"name": f"host-{tid}"}})
+        return {"traceEvents": meta + events, "displayTimeUnit": "ms"}
+
+    def export(self, path: str) -> str:
+        """Write the Chrome trace JSON to `path` (chrome://tracing or
+        https://ui.perfetto.dev open it directly). Returns `path`."""
+        from bigdl_tpu.utils import filesystem as fsys
+        with fsys.open_file(path, "w") as f:
+            json.dump(self.to_chrome_trace(), f)
+        return path

@@ -1,0 +1,187 @@
+"""Structured run metrics: per-step records fanned out to pluggable sinks.
+
+A training run should leave a machine-readable record, not just log lines.
+`Telemetry` turns the optimizer's per-sync figures (step, loss, lr,
+throughput, step wall time, optional grad/param norms) plus host/device
+resource stats into flat JSON-safe dicts and hands them to every attached
+sink. Record types:
+
+- `run_start`  — one per `optimize()` call: run config (devices, model).
+- `step`       — one per sync point (= per iteration at sync_interval 1).
+- `event`      — health-monitor findings (nan_guard, straggler, ...).
+- `run_end`    — final step count plus the `Metrics.as_dict()` phase table.
+
+Every record carries `time` (epoch seconds). The step schema is documented
+field-by-field in docs/observability.md.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Dict, List, Optional
+
+
+def host_rss_mb() -> Optional[float]:
+    """Current resident set size of this process in MB (from
+    /proc/self/statm; None where procfs is unavailable)."""
+    try:
+        with open("/proc/self/statm") as f:
+            pages = int(f.read().split()[1])
+        return pages * os.sysconf("SC_PAGE_SIZE") / 1e6
+    except (OSError, ValueError, IndexError):
+        return None
+
+
+def device_memory_stats() -> List[Dict]:
+    """Per-device memory stats from `jax.local_devices()` — bytes in use
+    and peak, where the backend reports them (TPU does; CPU returns [])."""
+    import jax
+    out = []
+    for d in jax.local_devices():
+        try:
+            stats = d.memory_stats()
+        except Exception:
+            stats = None
+        if not stats:
+            continue
+        out.append({"device": str(d),
+                    "bytes_in_use": stats.get("bytes_in_use"),
+                    "peak_bytes_in_use": stats.get("peak_bytes_in_use")})
+    return out
+
+
+class TelemetrySink:
+    """A destination for telemetry records. Subclasses implement `emit`
+    (one flat JSON-safe dict per call); `close` is optional."""
+
+    def emit(self, record: Dict):
+        raise NotImplementedError
+
+    def close(self):
+        pass
+
+
+class JsonlSink(TelemetrySink):
+    """Append records to a JSONL file, one JSON object per line, flushed
+    per record so a crashed run still leaves its stream on disk."""
+
+    def __init__(self, path: str, append: bool = True):
+        self.path = path
+        parent = os.path.dirname(path)
+        if parent:
+            os.makedirs(parent, exist_ok=True)
+        self._f = open(path, "a" if append else "w")
+
+    def emit(self, record: Dict):
+        self._f.write(json.dumps(record) + "\n")
+        self._f.flush()
+
+    def close(self):
+        self._f.close()
+
+
+class InMemorySink(TelemetrySink):
+    """Collects records in a list — the test/notebook sink."""
+
+    def __init__(self):
+        self.records: List[Dict] = []
+
+    def emit(self, record: Dict):
+        self.records.append(record)
+
+    def steps(self) -> List[Dict]:
+        """Just the per-step records, in order."""
+        return [r for r in self.records if r.get("type") == "step"]
+
+
+class SummarySink(TelemetrySink):
+    """Bridge into the existing TensorBoard event writer: numeric fields of
+    `step` records become `TrainSummary.add_scalar` calls under
+    `telemetry/<field>` tags, so the telemetry stream shows up next to the
+    classic Loss/Throughput curves."""
+
+    _SKIP = ("step", "epoch", "time", "type")
+
+    def __init__(self, summary):
+        self.summary = summary
+
+    def emit(self, record: Dict):
+        if record.get("type") != "step" or "step" not in record:
+            return
+        it = int(record["step"])
+        for key, val in record.items():
+            if key in self._SKIP or not isinstance(val, (int, float)):
+                continue
+            self.summary.add_scalar(f"telemetry/{key}", float(val), it)
+
+    def close(self):
+        self.summary.close()
+
+
+class CompositeSink(TelemetrySink):
+    """Fan one stream out to several sinks."""
+
+    def __init__(self, *sinks: TelemetrySink):
+        self.sinks = list(sinks)
+
+    def emit(self, record: Dict):
+        for s in self.sinks:
+            s.emit(record)
+
+    def close(self):
+        for s in self.sinks:
+            s.close()
+
+
+class Telemetry:
+    """The optimizer-facing collector.
+
+    `Telemetry(sink, ...)` attaches to an optimizer via `set_telemetry`;
+    the train loop calls `step(...)` at every sync point and
+    `run_start`/`run_end` around the run. Knobs:
+
+    - `grad_norms=True` — have the optimizer compute the global gradient
+      and parameter L2 norms INSIDE the jitted step (two tree reductions,
+      fused by XLA) and report them per step.
+    - `resources=True` — sample host RSS and device memory stats with
+      every step record (procfs read + PJRT query, host-side only).
+    """
+
+    def __init__(self, *sinks: TelemetrySink, grad_norms: bool = False,
+                 resources: bool = True):
+        self.sink = CompositeSink(*sinks)
+        self.grad_norms = grad_norms
+        self.resources = resources
+
+    def add_sink(self, sink: TelemetrySink) -> "Telemetry":
+        self.sink.sinks.append(sink)
+        return self
+
+    def emit(self, record: Dict):
+        record.setdefault("time", time.time())
+        self.sink.emit(record)
+
+    def run_start(self, **fields):
+        self.emit({"type": "run_start", **fields})
+
+    def step(self, **fields):
+        rec = {"type": "step", **fields}
+        if self.resources:
+            rss = host_rss_mb()
+            if rss is not None:
+                rec["host_rss_mb"] = round(rss, 2)
+            mem = device_memory_stats()
+            if mem:
+                rec["device_mem"] = mem
+        self.emit(rec)
+
+    def event(self, kind: str, **fields):
+        self.emit({"type": "event", "event": kind, **fields})
+
+    def run_end(self, **fields):
+        self.emit({"type": "run_end", **fields})
+
+    def close(self):
+        self.sink.close()

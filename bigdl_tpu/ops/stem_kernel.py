@@ -48,6 +48,16 @@ from jax import lax
 INTERPRET = False
 
 
+def _pick_tile_w(w: int, tile_w: int) -> int:
+    """Largest Mosaic-legal W tile <= tile_w: must divide w AND be a
+    multiple of 8 (the sublane block dim must divide 8 or equal the full
+    array dim — live-TPU finding, round 5). Falls back to the full width
+    when no candidate exists."""
+    cands = [d for d in range(min(tile_w, w), 0, -1)
+             if w % d == 0 and d % 8 == 0]
+    return cands[0] if cands else w
+
+
 def _stem_kernel(x_ref, w_ref, b_ref, o_ref, *, kt: int, c2: int,
                  tile_h: int, tile_w: int, n_out: int):
     """One program = one (batch, row-tile): assemble the patch tile and
@@ -110,11 +120,8 @@ def stem_conv_forward(x2, wk, bias, pad_front: int, pad_rear: int,
     while h % tile_h:
         tile_h //= 2               # h is even for every real stem input
     # w tiling bounds live VMEM registers (the full-width tile OOMed
-    # scoped vmem at 224x224/b128); Mosaic needs the sublane block dim
-    # divisible by 8 or equal to the full array dim
-    cands = [d for d in range(min(tile_w, w), 0, -1)
-             if w % d == 0 and d % 8 == 0]
-    tile_w = cands[0] if cands else w
+    # scoped vmem at 224x224/b128)
+    tile_w = _pick_tile_w(w, tile_w)
     # one W-shifted copy of the padded image per dx tap, trimmed back to
     # the output width (see _stem_kernel: in-kernel dx slices are
     # Mosaic-illegal under concatenate; the roll is a cheap XLA op paid

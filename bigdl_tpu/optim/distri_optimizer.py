@@ -108,6 +108,8 @@ class DistriOptimizer(BaseOptimizer):
 
         mixed = self._mixed_bf16
         cast = self._cast_floats
+        guard, need_norms = self._aux_flags()
+        guards = self._apply_step_guards
 
         def loss_and_grads(params, model_state, x, y, rng):
             def loss_fn(p):
@@ -160,7 +162,11 @@ class DistriOptimizer(BaseOptimizer):
                                                        x, y, step_rng)
             grads = clip(grads)
             new_params, new_opt = optim.update(grads, opt_state, params, lr)
-            return new_params, new_opt, new_ms, loss, rng
+            (new_params, new_opt, new_ms), aux = guards(
+                guard, need_norms, loss, grads,
+                (params, opt_state, model_state),
+                (new_params, new_opt, new_ms))
+            return new_params, new_opt, new_ms, loss, rng, aux
 
         # jit with sharding propagated from the placed inputs; XLA SPMD
         # partitions the computation and inserts the ICI collectives;
@@ -184,10 +190,16 @@ class DistriOptimizer(BaseOptimizer):
                     attempt = 1
                 last_failure = time.time()
                 if attempt > self.retry_times or self.checkpoint_path is None:
+                    self._telemetry_run_abort(e)
                     raise
                 logger.warning(
                     f"Optimization failed ({e!r}); retry {attempt}/"
                     f"{self.retry_times} from latest checkpoint")
+                if self.telemetry is not None:
+                    # close the aborted attempt in the stream: consumers
+                    # pair each run_start with a run_end OR a run_retry
+                    self.telemetry.event("run_retry", attempt=attempt,
+                                         error=repr(e))
                 # same loader as cold-start resume — handles both the
                 # pickle and the orbax-sharded checkpoint formats
                 if self.resume_from_latest_checkpoint():
@@ -209,7 +221,8 @@ class DistriOptimizer(BaseOptimizer):
         # donates the placed arrays, so a failed attempt kills them)
         self._pristine_params = jax.device_get(params)
         self._pristine_state = jax.device_get(model_state)
-        params, model_state = self._place(params, model_state, None)
+        with self._span("place params"):
+            params, model_state = self._place(params, model_state, None)
         resume_slots = getattr(self, "_resume_slots", None)
         if resume_slots is not None:
             # restore checkpointed optimizer moments, placed like the params
@@ -240,7 +253,8 @@ class DistriOptimizer(BaseOptimizer):
             executing on-device, so their wall time OVERLAPS "computing
             time average" (which spans dispatch -> loss sync); the phase
             table is intentionally not additive."""
-            with Timer(self.metrics, "data fetch time"):
+            with Timer(self.metrics, "data fetch time"), \
+                    self._span("data fetch"):
                 batch: MiniBatch = next(data_iter, None)
                 if batch is None:  # finite stream exhausted
                     logger.warning(
@@ -248,7 +262,8 @@ class DistriOptimizer(BaseOptimizer):
                         "trigger fired; stopping early (train=True datasets "
                         "normally loop forever)")
                     return None
-            with Timer(self.metrics, "put batch on mesh"):
+            with Timer(self.metrics, "put batch on mesh"), \
+                    self._span("put batch on mesh"):
                 x = batch.get_input()
                 y = batch.get_target()
                 def place_any(v):
@@ -263,9 +278,12 @@ class DistriOptimizer(BaseOptimizer):
             return batch, x, y
 
         sync_every = max(1, int(getattr(self, "sync_interval", 1)))
+        self._telemetry_run_start("distri")
         win = self._SyncWindow()
         loss_val = float("nan")  # last synced loss
         loss = None  # device array of the most recent step's loss
+        lr = None
+        aux_pending = []  # per-dispatch instrumentation scalars (tiny)
         # device-resident rng chain, advanced inside the donated step; a
         # COPY so self.rng survives donation and the retry path can seed a
         # fresh chain after a failed attempt killed the in-flight buffers
@@ -274,8 +292,11 @@ class DistriOptimizer(BaseOptimizer):
         while pending is not None and not self.end_trigger(driver_state):
             batch, x, y = pending
             lr = self.optim_method.current_lr()
-            params, opt_state, new_ms, loss, rng_dev = step(
-                params, opt_state, model_state, x, y, lr, rng_dev)
+            with self._span("step dispatch", step=driver_state["neval"] + 1):
+                params, opt_state, new_ms, loss, rng_dev, aux = step(
+                    params, opt_state, model_state, x, y, lr, rng_dev)
+            if aux:
+                aux_pending.append(aux)
             # prefetch while the dispatched step runs on-device (deliberate
             # one-batch lookahead: the final prefetch of an optimize() call
             # is discarded — one batch of host work per run buys the
@@ -285,7 +306,8 @@ class DistriOptimizer(BaseOptimizer):
             if do_sync:
                 # waits for the step; donation chains steps, so this means
                 # every dispatched step up to here has completed
-                loss_val = float(loss)
+                with self._span("loss sync"):
+                    loss_val = float(loss)
             model_state = merge_state(model_state, new_ms)
 
             n = batch.size() * num_hosts  # global records this step
@@ -304,6 +326,8 @@ class DistriOptimizer(BaseOptimizer):
                 # time average" a true per-step figure (per-dispatch
                 # timing is meaningless under async).
                 throughput = win.throughput(self.metrics)
+                self._observe_sync(driver_state, loss_val, lr, throughput,
+                                   win.step_time_s, n, aux_pending)
                 logger.info(
                     f"[Epoch {driver_state['epoch'] + 1} "
                     f"{driver_state['recordsProcessedThisEpoch']}/"
@@ -314,10 +338,8 @@ class DistriOptimizer(BaseOptimizer):
             if do_sync and self.train_summary is not None:
                 it = driver_state["neval"]
                 self.train_summary.add_scalar("Loss", loss_val, it)
-                self.train_summary.add_scalar(
-                    "LearningRate",
-                    float(np.mean([v for v in lr if v]) if any(lr) else 0.0)
-                    if isinstance(lr, tuple) else lr, it)
+                self.train_summary.add_scalar("LearningRate",
+                                              self._lr_scalar(lr), it)
                 self.train_summary.add_scalar("Throughput", throughput, it)
                 # Parameters histograms only behind an explicit trigger —
                 # they pull every sharded weight to host
@@ -338,9 +360,11 @@ class DistriOptimizer(BaseOptimizer):
                 driver_state["recordsProcessedThisEpoch"] = 0
                 self.dataset.shuffle()
 
-            self._validate(params, model_state, driver_state)
+            with self._span("validation"):
+                self._validate(params, model_state, driver_state)
             if self.checkpoint_trigger and self.checkpoint_trigger(driver_state):
-                with Timer(self.metrics, "checkpoint time"):
+                with Timer(self.metrics, "checkpoint time"), \
+                        self._span("checkpoint"):
                     self._save_checkpoint(params, model_state,
                                           tag=f"iter{driver_state['neval']}",
                                           opt_slots=opt_state)
@@ -353,6 +377,11 @@ class DistriOptimizer(BaseOptimizer):
                 driver_state["neval"] % sync_every != 0:
             # the loop ended between syncs: surface the true final loss
             driver_state["loss"] = loss_val = float(loss)
+        if aux_pending:
+            # partial tail window: guards/monitors still see those steps
+            self._observe_sync(driver_state, loss_val, lr, float("nan"),
+                               float("nan"), 0, aux_pending)
+        self._telemetry_run_end(driver_state)
         # persist the advanced rng chain so a subsequent optimize() call
         # (resume / train-more) continues the dropout/noise stream instead
         # of replaying it (LocalOptimizer advances self.rng the same way)

@@ -11,6 +11,7 @@ line up with DistriOptimizer.scala:405-410.
 
 from __future__ import annotations
 
+import contextlib
 import logging
 import time
 from typing import Callable, Dict, List, Optional, Sequence
@@ -62,6 +63,9 @@ class BaseOptimizer:
         self.grad_clip_norm: Optional[float] = None
         self.grad_clip_const: Optional[tuple] = None
         self.metrics = Metrics()
+        self.telemetry = None
+        self.tracer = None
+        self.health_monitors: List = []
         self.rng = jax.random.PRNGKey(0)
         self.matmul_precision: Optional[str] = None
         self.sync_interval: int = 1
@@ -294,6 +298,38 @@ class BaseOptimizer:
         self.iteration_hook = fn
         return self
 
+    def set_telemetry(self, telemetry):
+        """Attach a structured run-metrics collector
+        (observability.Telemetry): one `step` record per sync point plus
+        run_start/run_end, fanned out to its sinks. With
+        `Telemetry(grad_norms=True)` the jitted step also computes the
+        global gradient/parameter L2 norms per step."""
+        self.telemetry = telemetry
+        return self
+
+    setTelemetry = set_telemetry
+
+    def set_tracer(self, tracer):
+        """Attach a SpanTracer: the loop's host phases (data fetch, step
+        dispatch, loss sync, validation, checkpoint) record as nested
+        spans, exportable as Chrome/Perfetto trace JSON
+        (observability.spans)."""
+        self.tracer = tracer
+        return self
+
+    setTracer = set_tracer
+
+    def set_health_monitors(self, *monitors):
+        """Attach health monitors (observability.health): each observes
+        every sync-point step record. A NanGuard with action="skip"
+        additionally arms the in-step update revert for non-finite
+        steps — set it BEFORE optimize() so the step compiles with the
+        guard."""
+        self.health_monitors = list(monitors)
+        return self
+
+    setHealthMonitors = set_health_monitors
+
     def set_graph_optimizations(self, enable: bool = True):
         """Run the IR restatement passes over the model before building
         the train step (`ir.ConversionUtils.apply_tpu_restatements`):
@@ -309,7 +345,6 @@ class BaseOptimizer:
             self.model = ConversionUtils.apply_tpu_restatements(self.model)
 
     def _precision_scope(self):
-        import contextlib
         if self.matmul_precision is None:
             return contextlib.nullcontext()
         prec = {"bfloat16-matmul": "bfloat16"}.get(self.matmul_precision,
@@ -331,6 +366,144 @@ class BaseOptimizer:
             return leaf
         return jax.tree_util.tree_map(cast, tree)
 
+    # -- observability helpers --
+    def _span(self, name: str, **args):
+        """Tracer span when a tracer is attached, else a free nullcontext
+        (the loops call this on every iteration — no tracer, no cost)."""
+        if self.tracer is None:
+            return contextlib.nullcontext()
+        return self.tracer.span(name, **args)
+
+    def _nan_guard(self):
+        from bigdl_tpu.observability.health import NanGuard
+        for m in self.health_monitors:
+            if isinstance(m, NanGuard):
+                return m
+        return None
+
+    @staticmethod
+    def _lr_scalar(lr) -> float:
+        """Scalar view of the current lr (composite methods carry a tuple
+        of per-group rates — report their mean, reference log parity)."""
+        if isinstance(lr, tuple):
+            return float(np.mean([v for v in lr if v]) if any(lr) else 0.0)
+        return float(lr)
+
+    @staticmethod
+    def _global_norm(tree):
+        """Global L2 norm over the float leaves of a pytree (traced)."""
+        leaves = [l for l in jax.tree_util.tree_leaves(tree)
+                  if hasattr(l, "dtype") and jnp.issubdtype(l.dtype,
+                                                            jnp.floating)]
+        if not leaves:
+            return jnp.float32(0.0)
+        return jnp.sqrt(sum(jnp.sum(l.astype(jnp.float32) ** 2)
+                            for l in leaves))
+
+    def _aux_flags(self):
+        """Build-time instrumentation config for the jitted step:
+        (nan_guard, need_norms)."""
+        guard = self._nan_guard()
+        need_norms = bool(
+            (self.telemetry is not None and self.telemetry.grad_norms)
+            or (guard is not None and guard.check_grads))
+        return guard, need_norms
+
+    @staticmethod
+    def _revert_partial_state(bad, new_ms, old_ms):
+        """Skip-mode revert for the model state, honoring the module
+        contract that new_state may be a PARTIAL update with a different
+        dict structure than the full state (module.py functional_apply:
+        "merge with the old state dict outside") — a plain tree_map of
+        new vs old would crash on the mismatch. Each new leaf reverts to
+        its old value where one exists; a key with no old counterpart
+        (first update of a freshly-loaded/set_params model) keeps the new
+        value — there is nothing to revert to."""
+        if isinstance(new_ms, dict):
+            old = old_ms if isinstance(old_ms, dict) else {}
+            return {k: BaseOptimizer._revert_partial_state(bad, v,
+                                                           old.get(k))
+                    for k, v in new_ms.items()}
+        if old_ms is None:
+            return new_ms
+        return jnp.where(bad, old_ms, new_ms)
+
+    def _apply_step_guards(self, guard, need_norms, loss, grads, old, new):
+        """Traced tail of the step: non-finite detection (and, for a
+        skip-mode NanGuard, the update revert via jnp.where — donation-safe
+        because it selects between traced values, not buffers) plus the
+        optional grad/param norms. `old`/`new` are (params, opt_state,
+        model_state) triples; returns (new, aux). aux is {} when no
+        instrumentation is armed, so the uninstrumented step is unchanged."""
+        aux = {}
+        gnorm = self._global_norm(grads) if need_norms else None
+        if guard is not None:
+            bad = ~jnp.isfinite(loss)
+            if guard.check_grads:
+                bad = bad | ~jnp.isfinite(gnorm)
+            aux["nonfinite"] = bad.astype(jnp.int32)
+            if guard.action == "skip":
+                keep = lambda n, o: jnp.where(bad, o, n)
+                # params and opt slots always share their old structure;
+                # model state may be a partial update — revert per key
+                new = (jax.tree_util.tree_map(keep, new[0], old[0]),
+                       jax.tree_util.tree_map(keep, new[1], old[1]),
+                       self._revert_partial_state(bad, new[2], old[2]))
+        if need_norms:
+            aux["grad_norm"] = gnorm
+            aux["param_norm"] = self._global_norm(new[0])
+        return new, aux
+
+    def _observe_sync(self, driver_state, loss_val, lr, throughput,
+                      step_time_s, records, aux_pending):
+        """Host side of a sync point: resolve the pending in-step aux
+        scalars (ONE batched device_get), assemble the step record, run the
+        health monitors, emit telemetry. No-op when neither is attached."""
+        if self.telemetry is None and not self.health_monitors:
+            return
+        rec = {"step": driver_state["neval"],
+               "epoch": driver_state["epoch"] + 1,
+               "loss": loss_val, "lr": self._lr_scalar(lr),
+               "throughput": throughput, "step_time_s": step_time_s,
+               "records": records}
+        if aux_pending:
+            vals = jax.device_get(list(aux_pending))
+            aux_pending.clear()
+            if "nonfinite" in vals[-1]:
+                rec["nonfinite_steps"] = int(sum(int(v["nonfinite"])
+                                                 for v in vals))
+            if "grad_norm" in vals[-1]:
+                rec["grad_norm"] = float(vals[-1]["grad_norm"])
+                rec["param_norm"] = float(vals[-1]["param_norm"])
+        for m in self.health_monitors:
+            m.observe(rec, self.telemetry)
+        if self.telemetry is not None:
+            self.telemetry.step(**rec)
+
+    def _telemetry_run_start(self, loop: str):
+        if self.telemetry is None:
+            return
+        self.telemetry.run_start(
+            loop=loop, model=type(self.model).__name__,
+            optim_method=type(self.optim_method).__name__,
+            backend=jax.default_backend(), n_devices=jax.device_count(),
+            sync_interval=max(1, int(getattr(self, "sync_interval", 1))))
+
+    def _telemetry_run_end(self, driver_state):
+        if self.telemetry is None:
+            return
+        self.telemetry.run_end(step=driver_state["neval"],
+                               epoch=driver_state["epoch"],
+                               loss=driver_state.get("loss"),
+                               metrics=self.metrics.as_dict())
+
+    def _telemetry_run_abort(self, error):
+        """Terminal marker for a run that dies mid-loop, so every
+        run_start in the stream pairs with run_end, run_retry, or
+        run_abort (a hard process kill can still truncate the stream)."""
+        if self.telemetry is not None:
+            self.telemetry.event("run_abort", error=repr(error))
+
     # -- helpers --
     class _SyncWindow:
         """Throughput/compute-time bookkeeping over sync windows, shared
@@ -345,6 +518,7 @@ class BaseOptimizer:
             self.records = 0
             self.iters = 0
             self.t0 = time.perf_counter()
+            self.step_time_s = float("nan")
 
         def add(self, n: int):
             self.records += n
@@ -352,10 +526,11 @@ class BaseOptimizer:
 
         def throughput(self, metrics) -> float:
             """At a sync point: window throughput; records the
-            per-iteration compute-time metric."""
+            per-iteration compute-time metric (also kept on
+            `step_time_s` for the telemetry step record)."""
             dt = max(time.perf_counter() - self.t0, 1e-9)
-            metrics.add("computing time average",
-                        dt / max(self.iters, 1) * 1e9)
+            self.step_time_s = dt / max(self.iters, 1)
+            metrics.add("computing time average", self.step_time_s * 1e9)
             return self.records / dt
 
         def restart(self):
@@ -444,6 +619,15 @@ class LocalOptimizer(BaseOptimizer):
         super().__init__(model, dataset, criterion)
         self.batch_size = batch_size
 
+    def optimize(self) -> Module:
+        try:
+            return self._optimize_impl()
+        except (KeyboardInterrupt, SystemExit):
+            raise
+        except Exception as e:
+            self._telemetry_run_abort(e)
+            raise
+
     def _build_step(self):
         model, criterion = self.model, self.criterion
         optim = self.optim_method
@@ -451,6 +635,8 @@ class LocalOptimizer(BaseOptimizer):
         precision_scope = self._precision_scope
         mixed = self._mixed_bf16
         cast = self._cast_floats
+        guard, need_norms = self._aux_flags()
+        guards = self._apply_step_guards
 
         def step(params, opt_state, model_state, x, y, lr, rng):
             def loss_fn(p):
@@ -468,11 +654,15 @@ class LocalOptimizer(BaseOptimizer):
             (loss, new_ms), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
             grads = clip(grads)
             new_params, new_opt = optim.update(grads, opt_state, params, lr)
-            return new_params, new_opt, new_ms, loss
+            (new_params, new_opt, new_ms), aux = guards(
+                guard, need_norms, loss, grads,
+                (params, opt_state, model_state),
+                (new_params, new_opt, new_ms))
+            return new_params, new_opt, new_ms, loss, aux
 
         return jax.jit(step)
 
-    def optimize(self) -> Module:
+    def _optimize_impl(self) -> Module:
         self._maybe_optimize_graph()
         params = self.model.ensure_params()
         model_state = self.model._state
@@ -494,7 +684,8 @@ class LocalOptimizer(BaseOptimizer):
         def fetch_and_place():
             """Next host batch + async device transfer; overlaps the
             dispatched step like DistriOptimizer's prefetch."""
-            with Timer(self.metrics, "data fetch time"):
+            with Timer(self.metrics, "data fetch time"), \
+                    self._span("data fetch"):
                 batch = next(data_iter, None)
                 if batch is None:
                     logger.warning(
@@ -506,20 +697,27 @@ class LocalOptimizer(BaseOptimizer):
             return batch, x, y
 
         sync_every = max(1, int(getattr(self, "sync_interval", 1)))
+        self._telemetry_run_start("local")
         win = self._SyncWindow()
         loss_val = float("nan")
         loss = None
+        lr = None
+        aux_pending: List = []
         pending = fetch_and_place()
         while pending is not None and not self.end_trigger(driver_state):
             batch, x, y = pending
             lr = self.optim_method.current_lr()
             self.rng, step_rng = jax.random.split(self.rng)
-            params, opt_state, new_ms, loss = step(
-                params, opt_state, model_state, x, y, lr, step_rng)
+            with self._span("step dispatch", step=driver_state["neval"] + 1):
+                params, opt_state, new_ms, loss, aux = step(
+                    params, opt_state, model_state, x, y, lr, step_rng)
+            if aux:
+                aux_pending.append(aux)
             pending = fetch_and_place()  # overlaps the running step
             do_sync = (driver_state["neval"] + 1) % sync_every == 0
             if do_sync:
-                loss_val = float(loss)  # waits for the step to finish
+                with self._span("loss sync"):
+                    loss_val = float(loss)  # waits for the step to finish
             model_state = merge_state(model_state, new_ms)
 
             n = batch.size()
@@ -531,6 +729,8 @@ class LocalOptimizer(BaseOptimizer):
                 # per-window figures: dispatch+device only (the window
                 # restarts AFTER the validation/checkpoint/hook tail)
                 throughput = win.throughput(self.metrics)
+                self._observe_sync(driver_state, loss_val, lr, throughput,
+                                   win.step_time_s, n, aux_pending)
                 logger.info(
                     f"[Epoch {driver_state['epoch'] + 1} "
                     f"{driver_state['recordsProcessedThisEpoch']}/"
@@ -541,10 +741,8 @@ class LocalOptimizer(BaseOptimizer):
             if do_sync and self.train_summary is not None:
                 it = driver_state["neval"]
                 self.train_summary.add_scalar("Loss", loss_val, it)
-                self.train_summary.add_scalar(
-                    "LearningRate",
-                    float(np.mean([v for v in lr if v]) if any(lr) else 0.0)
-                    if isinstance(lr, tuple) else lr, it)
+                self.train_summary.add_scalar("LearningRate",
+                                              self._lr_scalar(lr), it)
                 self.train_summary.add_scalar("Throughput", throughput, it)
                 # Parameters histograms only behind an explicit trigger —
                 # they pull every weight to host (AbstractOptimizer.scala:47-92)
@@ -564,11 +762,13 @@ class LocalOptimizer(BaseOptimizer):
                 driver_state["recordsProcessedThisEpoch"] = 0
                 self.dataset.shuffle()
 
-            self._validate(params, model_state, driver_state)
+            with self._span("validation"):
+                self._validate(params, model_state, driver_state)
             if self.checkpoint_trigger and self.checkpoint_trigger(driver_state):
-                self._save_checkpoint(params, model_state,
-                                      tag=f"iter{driver_state['neval']}",
-                                      opt_slots=opt_state)
+                with self._span("checkpoint"):
+                    self._save_checkpoint(params, model_state,
+                                          tag=f"iter{driver_state['neval']}",
+                                          opt_slots=opt_state)
             if self.iteration_hook is not None:
                 self.iteration_hook(driver_state)
             if do_sync:
@@ -576,7 +776,13 @@ class LocalOptimizer(BaseOptimizer):
 
         if sync_every > 1 and loss is not None and \
                 driver_state["neval"] % sync_every != 0:
-            driver_state["loss"] = float(loss)  # true final loss
+            driver_state["loss"] = loss_val = float(loss)  # true final loss
+        if aux_pending:
+            # partial tail window (end trigger fired between syncs): the
+            # guards/monitors must still see those steps' aux
+            self._observe_sync(driver_state, loss_val, lr, float("nan"),
+                               float("nan"), 0, aux_pending)
+        self._telemetry_run_end(driver_state)
         self.model.set_params(params)
         self.model._state = model_state
         return self.model

@@ -32,6 +32,15 @@ class Metrics:
         c = self._count.get(name, 0)
         return self._sum[name] / c if c else 0.0
 
+    def as_dict(self, unit_scale: float = 1e9) -> Dict[str, Dict[str, float]]:
+        """Machine-readable export of the phase table, scaled like
+        summary() (default ns -> seconds): {name: {mean, count, total}}.
+        Feeds the observability telemetry stream's run_end record."""
+        return {name: {"mean": self.get(name) / unit_scale,
+                       "count": self._count.get(name, 0),
+                       "total": self._sum[name] / unit_scale}
+                for name in sorted(self._sum)}
+
     def summary(self, unit_scale: float = 1e9) -> str:
         lines = ["========== Metrics Summary =========="]
         for name in sorted(self._sum):

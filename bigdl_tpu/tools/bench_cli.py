@@ -33,6 +33,7 @@ with fp16 wire compression, FP16CompressedTensor.scala:143).
 
 from __future__ import annotations
 
+import contextlib
 import json
 import logging
 import os
@@ -92,6 +93,38 @@ def _step_flops(model, crit, method, params, state, batch_size, in_shape):
         return float(cost.get("flops", 0.0)) or None
     except Exception:
         return None
+
+
+_TELEMETRY_RUNS = 0  # distinguishes multiple runs inside one process
+
+
+@contextlib.contextmanager
+def _bench_telemetry(opt):
+    """When BIGDL_TPU_TELEMETRY names a directory (set by the parent's
+    --telemetry flag; inherited by every child suite), wire a structured
+    telemetry stream (JSONL) and a span tracer (Chrome trace JSON) onto
+    the optimizer for the enclosed run — one file pair per run, keyed by
+    pid + in-process run counter, closed/exported even when the run
+    fails. No-op when the env var is unset."""
+    global _TELEMETRY_RUNS
+    tel_dir = os.environ.get("BIGDL_TPU_TELEMETRY")
+    if not tel_dir:
+        yield
+        return
+    from bigdl_tpu.observability import JsonlSink, SpanTracer, Telemetry
+    os.makedirs(tel_dir, exist_ok=True)
+    _TELEMETRY_RUNS += 1
+    stem = os.path.join(tel_dir,
+                        f"bench_{os.getpid()}_r{_TELEMETRY_RUNS}")
+    telemetry = Telemetry(JsonlSink(stem + ".jsonl"))
+    tracer = SpanTracer(process_name=f"bench[{os.getpid()}]")
+    opt.set_telemetry(telemetry)
+    opt.set_tracer(tracer)
+    try:
+        yield
+    finally:
+        telemetry.close()
+        tracer.export(stem + ".trace.json")
 
 
 def _framework_throughput(model, in_shape, n_class, batch_size, warmup,
@@ -163,7 +196,8 @@ def _framework_throughput(model, in_shape, n_class, batch_size, warmup,
             opt.metrics.reset()  # keep compile time out of the phase table
 
     opt.set_iteration_hook(hook)
-    opt.optimize()
+    with _bench_telemetry(opt):
+        opt.optimize()
 
     timed = times[warmup // sync - 1:]  # drop warmup/compile windows
     intervals = np.diff(timed)
@@ -425,7 +459,8 @@ def bench_baseline_configs():
         opt.set_iteration_hook(
             lambda s: times.append(time.perf_counter())
             if s["neval"] % sync == 0 else None)
-        opt.optimize()
+        with _bench_telemetry(opt):
+            opt.optimize()
         dt = float(np.median(np.diff(times)[1:])) / sync  # drop compile win
         print(f"{name}: {n / dt:.1f} records/sec", file=sys.stderr)
 
@@ -731,8 +766,22 @@ def _headline_child(name: str, timeout_s: float):
 
 
 def main():
-    if len(sys.argv) >= 3 and sys.argv[1] == "--secondary":
-        _secondary_main(sys.argv[2])
+    # --telemetry[=DIR]: record the structured observability stream for
+    # every suite this bench runs — per-process JSONL step records plus a
+    # Chrome/Perfetto host trace under DIR (default: telemetry/ inside the
+    # bench-records dir). Implemented as an env var so the watchdogged
+    # child processes inherit it.
+    argv = []
+    for a in sys.argv[1:]:
+        if a == "--telemetry":
+            os.environ["BIGDL_TPU_TELEMETRY"] = os.path.join(
+                _records_dir(), "telemetry")
+        elif a.startswith("--telemetry="):
+            os.environ["BIGDL_TPU_TELEMETRY"] = a.split("=", 1)[1]
+        else:
+            argv.append(a)
+    if len(argv) >= 2 and argv[0] == "--secondary":
+        _secondary_main(argv[1])
         return
     logging.getLogger("bigdl_tpu.optim").setLevel(logging.WARNING)
     accel_ok = _accel_responsive()

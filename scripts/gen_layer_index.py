@@ -65,8 +65,13 @@ def _public(pkg):
     names = getattr(pkg, "__all__", None)
     if names:
         return list(names)
+    # exclude typing re-exports (e.g. `Activity = Any`): inspect.isclass
+    # flips for typing.Any between Python 3.10 and 3.11+, which would make
+    # the generated index — and the doc-sync test — Python-version
+    # dependent
     return [n for n in dir(pkg)
             if not n.startswith("_") and
+            getattr(getattr(pkg, n), "__module__", None) != "typing" and
             (inspect.isclass(getattr(pkg, n)) or
              inspect.isfunction(getattr(pkg, n)))]
 

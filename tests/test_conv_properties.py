@@ -296,25 +296,61 @@ class TestMosaicLegalSpecs:
     hardware session."""
 
     def test_stem_tile_w_selection_is_mosaic_legal(self):
-        from bigdl_tpu.ops import stem_kernel as sk
-        import jax.numpy as jnp
+        """Asserts on the REAL selection helper (`_pick_tile_w`, the one
+        stem_conv_forward calls), not a re-implementation: the chosen tile
+        must divide w and be a multiple of 8 (or the full width when no
+        such divisor exists)."""
+        from bigdl_tpu.ops.stem_kernel import _pick_tile_w
         for w in (112, 56, 16, 28, 8, 12):
-            cands = [d for d in range(min(56, w), 0, -1)
-                     if w % d == 0 and d % 8 == 0]
-            tile_w = cands[0] if cands else w
+            tile_w = _pick_tile_w(w, 56)
             assert tile_w == w or tile_w % 8 == 0
             assert w % tile_w == 0
+        # the cap is honored and the largest legal divisor wins
+        assert _pick_tile_w(112, 56) == 56
+        assert _pick_tile_w(64, 56) == 32   # two W tiles (kw > 1 grid)
+        assert _pick_tile_w(12, 56) == 12   # no multiple-of-8 divisor:
+        assert _pick_tile_w(6, 56) == 6     # full width fallback
 
     def test_flash_lse_rides_3d(self):
         """The fwd kernel's lse output must be [bh, 1, T]-shaped so its
         (1, 1, block_q) blocks satisfy the block-mapping rule whenever
-        block_q < T."""
+        block_q < T. Asserting the INTERNAL pallas_call layout (from the
+        jaxpr), not just the public (b, h, t) shape — which also held
+        before the Mosaic fix."""
         import jax
         from bigdl_tpu.ops import attention_kernel as ak
         b, h, t, d = 1, 2, 512, 64
         q = jnp.ones((b, h, t, d), jnp.float32)
-        out, lse = jax.eval_shape(
-            lambda a: ak.flash_attention_forward(a, a, a, interpret=True,
-                                                 return_lse=True), q)
+        fn = lambda a: ak.flash_attention_forward(a, a, a, interpret=True,
+                                                  return_lse=True)
+        out, lse = jax.eval_shape(fn, q)
         assert out.shape == (b, h, t, d)
         assert lse.shape == (b, h, t)
+        # block_q is min(256, t) = 256 < t here, so the rule is in force
+        jaxpr = jax.make_jaxpr(fn)(q)
+        pallas_out_shapes = [tuple(v.aval.shape) for e in jaxpr.eqns
+                             if e.primitive.name == "pallas_call"
+                             for v in e.outvars]
+        assert pallas_out_shapes, "no pallas_call found in the jaxpr"
+        assert (b * h, 1, t) in pallas_out_shapes, pallas_out_shapes
+        assert (b * h, t) not in pallas_out_shapes, \
+            "lse reverted to the Mosaic-illegal 2D [bh, T] ride"
+
+    def test_pallas_stem_multi_w_tile_parity(self, monkeypatch):
+        """Interpret-mode parity for the multi-W-tile grid path: a 128x128
+        input space-to-depths to width 64, tile_w 32 — TWO W tiles, so the
+        pre-rolled-dx per-tile slicing (the subtlest round-5 Mosaic fix)
+        is exercised off-hardware."""
+        from bigdl_tpu.ops import stem_kernel as sk
+        assert sk._pick_tile_w(64, 56) == 32  # the premise: kw == 2
+        monkeypatch.setattr(sk, "INTERPRET", True)
+        xla = nn.SpaceToDepthStemConvolution(3, 8, 7, pallas_stem=False)
+        pallas = nn.SpaceToDepthStemConvolution(3, 8, 7, pallas_stem=True)
+        params = xla.init(jax.random.PRNGKey(21))
+        xla.set_params(params)
+        pallas.set_params(params)
+        x = jnp.asarray(np.random.RandomState(22).rand(1, 128, 128, 3),
+                        jnp.float32)
+        np.testing.assert_allclose(np.asarray(pallas.forward(x)),
+                                   np.asarray(xla.forward(x)),
+                                   rtol=1e-4, atol=1e-4)
