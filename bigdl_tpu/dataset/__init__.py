@@ -3,6 +3,9 @@ from bigdl_tpu.dataset.dataset import DataSet, DistributedDataSet, LocalDataSet
 from bigdl_tpu.dataset.datasource import (DataSource, RecordFileSource,
                                           SparkDataFrameSource,
                                           SparkRDDSource, from_data_source)
+from bigdl_tpu.dataset.prefetch import (InputPipeline, ThreadedPrefetcher,
+                                        build_input_pipeline,
+                                        split_elementwise_prefix)
 from bigdl_tpu.dataset.transformer import (SampleToMiniBatch, Transformer,
                                            chain)
 from bigdl_tpu.dataset import image, text

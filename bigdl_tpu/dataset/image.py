@@ -54,6 +54,8 @@ class BytesToGreyImg(Transformer):
     """(BytesToGreyImg.scala) (bytes [H*W], label) -> LabeledGreyImage,
     scaled to [0, 1] like the reference's /255."""
 
+    elementwise = True
+
     def __init__(self, row: int, col: int):
         self.row, self.col = row, col
 
@@ -67,6 +69,8 @@ class BytesToGreyImg(Transformer):
 class GreyImgNormalizer(Transformer):
     """(GreyImgNormalizer.scala) (x - mean) / std; constructor computes
     the stats from a dataset when given one."""
+
+    elementwise = True
 
     def __init__(self, mean, std=None):
         if std is None and not np.isscalar(mean):
@@ -85,6 +89,8 @@ class GreyImgNormalizer(Transformer):
 class GreyImgCropper(Transformer):
     """(GreyImgCropper.scala) random-offset crop to (crop_h, crop_w)."""
 
+    elementwise = True
+
     def __init__(self, crop_width: int, crop_height: int,
                  seed: Optional[int] = None):
         self.cw, self.ch = crop_width, crop_height
@@ -101,6 +107,8 @@ class GreyImgCropper(Transformer):
 
 class GreyImgToSample(Transformer):
     """(GreyImgToSample.scala)."""
+
+    elementwise = True
 
     def apply(self, it):
         for img in it:
@@ -132,6 +140,8 @@ class GreyImgToBatch(Transformer):
 class BytesToBGRImg(Transformer):
     """(BytesToBGRImg.scala) raw HWC uint8 bytes (BGR) -> LabeledBGRImage."""
 
+    elementwise = True
+
     def __init__(self, norm: float = 255.0, resize_w: Optional[int] = None,
                  resize_h: Optional[int] = None):
         self.norm = norm
@@ -151,6 +161,8 @@ class BytesToBGRImg(Transformer):
 class BGRImgNormalizer(Transformer):
     """(BGRImgNormalizer.scala) per-channel (x - mean) / std; stats computed
     from a dataset when given one."""
+
+    elementwise = True
 
     def __init__(self, mean, std=None):
         if std is None and not np.isscalar(mean):
@@ -176,6 +188,8 @@ class BGRImgNormalizer(Transformer):
 class BGRImgPixelNormalizer(Transformer):
     """(BGRImgPixelNormalizer.scala) subtract a whole mean image."""
 
+    elementwise = True
+
     def __init__(self, means: np.ndarray):
         self.means = np.asarray(means, np.float32)
 
@@ -187,6 +201,8 @@ class BGRImgPixelNormalizer(Transformer):
 
 class BGRImgCropper(Transformer):
     """(BGRImgCropper.scala) center or random crop."""
+
+    elementwise = True
 
     def __init__(self, crop_width: int, crop_height: int,
                  crop_method: str = "random", seed: Optional[int] = None):
@@ -214,6 +230,8 @@ def BGRImgRdmCropper(crop_width: int, crop_height: int, seed=None):
 class HFlip(Transformer):
     """(HFlip.scala) mirror with probability threshold."""
 
+    elementwise = True
+
     def __init__(self, threshold: float = 0.5, seed: Optional[int] = None):
         self.threshold = threshold
         self.rng = np.random.RandomState(seed)
@@ -227,6 +245,8 @@ class HFlip(Transformer):
 
 class BGRImgToSample(Transformer):
     """(BGRImgToSample.scala) HWC image -> Sample (NHWC model input)."""
+
+    elementwise = True
 
     def apply(self, it):
         for img in it:
@@ -274,6 +294,8 @@ class ColorJitter(Transformer):
     image with a companion (zeros / grayscale-mean fill / grayscale) at
     alpha = 1 + U(-v, v), v = 0.4."""
 
+    elementwise = True
+
     def __init__(self, brightness: float = 0.4, contrast: float = 0.4,
                  saturation: float = 0.4, seed: Optional[int] = None):
         self.v = {"b": brightness, "c": contrast, "s": saturation}
@@ -311,6 +333,8 @@ class Lighting(Transformer):
     """(Lighting.scala) AlexNet fancy-PCA lighting noise: per image draw
     alpha ~ U(0, 0.1) per eigen-channel and add
     rgb[c] = sum_j eigvec[c, j] * alpha[j] * eigval[j] to channel c."""
+
+    elementwise = True
 
     ALPHASTD = 0.1
     EIGVAL = np.asarray([0.2175, 0.0188, 0.0045], np.float32)

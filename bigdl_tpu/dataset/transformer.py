@@ -19,11 +19,22 @@ from bigdl_tpu.dataset.sample import MiniBatch, PaddingParam, Sample
 class Transformer:
     """Iterator -> iterator mapper; compose with a >> b."""
 
+    # 1-in/1-out stages (decode/normalize/crop/augment) mark this True so
+    # the prefetcher (dataset/prefetch.py) may apply them per-item across
+    # worker threads — the MTImageFeatureToBatch thread-pool contract.
+    # Stateful stages (batching) keep the False default.
+    elementwise: bool = False
+
     def apply(self, it: Iterator) -> Iterator:
         raise NotImplementedError
 
     def __call__(self, it: Iterable) -> Iterator:
         return self.apply(iter(it))
+
+    def apply_one(self, item):
+        """Apply to a single element. Only meaningful for element-wise
+        transformers (the multi-worker prefetch path)."""
+        return next(iter(self([item])))
 
     def __rshift__(self, other: "Transformer") -> "Transformer":
         return _Chained(self, other)
@@ -32,6 +43,10 @@ class Transformer:
 class _Chained(Transformer):
     def __init__(self, first: Transformer, second: Transformer):
         self.first, self.second = first, second
+
+    @property
+    def elementwise(self) -> bool:
+        return self.first.elementwise and self.second.elementwise
 
     def apply(self, it):
         return self.second(self.first(it))
@@ -46,6 +61,8 @@ def chain(*transformers: Transformer) -> Transformer:
 
 class FuncTransformer(Transformer):
     """Wrap an element-wise function."""
+
+    elementwise = True
 
     def __init__(self, fn: Callable):
         self.fn = fn

@@ -180,7 +180,13 @@ class DistriOptimizer(BaseOptimizer):
         last_failure = time.time()
         while True:
             try:
-                return self._optimize_impl()
+                try:
+                    return self._optimize_impl()
+                finally:
+                    # per-attempt join: neither a finished run nor a
+                    # failed attempt (about to respawn a pipeline) may
+                    # leak prefetch workers
+                    self._close_data_pipeline(self._active_pipeline)
             except (KeyboardInterrupt, SystemExit):
                 raise
             except Exception as e:  # retry from newest checkpoint
@@ -237,8 +243,8 @@ class DistriOptimizer(BaseOptimizer):
         num_hosts = getattr(self.dataset, "num_hosts", 1)
         epoch_size = getattr(self.dataset, "global_size", None) or \
             self.dataset.size() * num_hosts
-        data_iter = self._fast_forward_data(
-            self.dataset.data(train=True), driver_state)
+        _, src = self._open_data_pipeline()
+        data_iter = self._fast_forward_data(src, driver_state)
         n_dev = int(np.prod(mesh.devices.shape))
 
         def fetch_and_place():
@@ -247,7 +253,11 @@ class DistriOptimizer(BaseOptimizer):
             Called right after the train step is dispatched, so the numpy
             work and the device_put DMA overlap the running step — the
             reference's analogue is the data-fetch Spark task overlapping
-            the parameter-sync jobs (DistriOptimizer.scala:330-339).
+            the parameter-sync jobs (DistriOptimizer.scala:330-339). With
+            `set_prefetch` armed, `next(data_iter)` pops the background
+            input pipeline (dataset/prefetch.py) instead of running the
+            transformer chain inline, so chains slower than one device
+            step stop serializing the loop.
 
             The two phase timers here run while the previous step is still
             executing on-device, so their wall time OVERLAPS "computing
@@ -358,7 +368,7 @@ class DistriOptimizer(BaseOptimizer):
             if driver_state["recordsProcessedThisEpoch"] >= epoch_size:
                 driver_state["epoch"] += 1
                 driver_state["recordsProcessedThisEpoch"] = 0
-                self.dataset.shuffle()
+                self._shuffle_dataset()
 
             with self._span("validation"):
                 self._validate(params, model_state, driver_state)

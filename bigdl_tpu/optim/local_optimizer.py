@@ -72,6 +72,8 @@ class BaseOptimizer:
         self.iteration_hook: Optional[Callable[[Dict], None]] = None
         self.graph_optimizations = False
         self.grad_accum_steps: int = 1
+        self._prefetch: Optional[Dict] = None
+        self._active_pipeline = None
 
     # fluent setters (Optimizer.scala:93-452)
     def set_gradient_accumulation(self, steps: int):
@@ -203,7 +205,7 @@ class BaseOptimizer:
                     return data_iter
                 seen += b.size()
             pending = next(data_iter, None)  # live prefetch pre-shuffle
-            self.dataset.shuffle()
+            self._shuffle_dataset()
         already = driver_state.get("recordsProcessedThisEpoch", 0) \
             // max(num_hosts, 1)
         skipped = pending.size() if pending is not None else 0
@@ -291,6 +293,82 @@ class BaseOptimizer:
         donation, so syncing step k implies steps 1..k completed)."""
         self.sync_interval = max(1, int(k))
         return self
+
+    def set_prefetch(self, depth: Optional[int] = None,
+                     workers: Optional[int] = None,
+                     deterministic: bool = True):
+        """Enable the pipelined host data plane (dataset/prefetch.py):
+        background worker threads run the transformer chain into a bounded
+        queue so the driver only pays a queue pop before starting the next
+        async H2D transfer — the reference's concurrent data-fetch task
+        (DistriOptimizer.scala:330-339) plus MTImageFeatureToBatch's
+        thread-pool batching, in one subsystem.
+
+        `workers` defaults to `Engine.io_threads`; `depth` (total
+        lookahead: ready + in-flight batches) defaults to 4x workers —
+        deep enough that the driver thread never drains it while worker
+        refill bursts wait out the driver's GIL slices.
+        `deterministic=True` keeps batch order byte-identical to serial
+        iteration (reordering buffer); `False` yields in completion order.
+        Caveat: across EPOCH BOUNDARIES the `shuffle()` interleaving is
+        timing-dependent under prefetch, so multi-epoch streams (and
+        their checkpoint-resume replay) are approximate — disable
+        prefetch for workflows that need exact multi-epoch replay (see
+        `_shuffle_dataset`). `set_prefetch(depth=0)` disables. Threads
+        are started per `optimize()` call and joined before it returns —
+        also on failure."""
+        if depth == 0:
+            self._prefetch = None
+            return self
+        if workers is None:
+            from bigdl_tpu.utils.engine import Engine
+            workers = int(Engine.config["io_threads"])
+        if workers < 1:
+            raise ValueError(f"workers must be >= 1, got {workers}")
+        if depth is None:
+            depth = 4 * workers
+        if depth < 1:
+            raise ValueError(f"depth must be >= 1, got {depth}")
+        self._prefetch = {"depth": int(depth), "workers": int(workers),
+                          "deterministic": bool(deterministic)}
+        return self
+
+    setPrefetch = set_prefetch
+
+    def _open_data_pipeline(self):
+        """Training-stream source for _optimize_impl: a prefetching
+        InputPipeline when set_prefetch is armed (stash it for telemetry
+        gauges + the finally-close), else the plain dataset iterator."""
+        if self._prefetch is None:
+            self._active_pipeline = None
+            return None, self.dataset.data(train=True)
+        from bigdl_tpu.dataset.prefetch import build_input_pipeline
+        pipeline = build_input_pipeline(self.dataset, train=True,
+                                        **self._prefetch)
+        self._active_pipeline = pipeline
+        return pipeline, pipeline
+
+    def _close_data_pipeline(self, pipeline):
+        self._active_pipeline = None
+        if pipeline is not None:
+            pipeline.close()
+
+    def _shuffle_dataset(self):
+        """Epoch-boundary reshuffle. With prefetch armed the shuffle is
+        made atomic against worker pulls (pipeline source_guard), but
+        WHERE it lands between pulls depends on thread timing — so
+        cross-epoch-boundary streams are NOT exactly reproducible under
+        prefetch, and a cold checkpoint resume of a multi-epoch
+        prefetched run replays an approximate stream (the
+        _fast_forward_data exact-replay contract assumes the serial
+        loop's one-batch lookahead). Runs needing exact multi-epoch
+        replay should train with prefetch disabled; within one epoch
+        deterministic mode is exact (suite-asserted)."""
+        if self._active_pipeline is not None:
+            with self._active_pipeline.source_guard():
+                self.dataset.shuffle()
+        else:
+            self.dataset.shuffle()
 
     def set_iteration_hook(self, fn: Optional[Callable[[Dict], None]]):
         """Call `fn(driver_state)` after every completed iteration (used by
@@ -466,6 +544,11 @@ class BaseOptimizer:
                "loss": loss_val, "lr": self._lr_scalar(lr),
                "throughput": throughput, "step_time_s": step_time_s,
                "records": records}
+        if self._active_pipeline is not None:
+            # input-pipeline health gauges (docs/observability.md):
+            # instantaneous ready-batch depth, cumulative driver
+            # fetch-wait, worker-pool busy fraction
+            rec.update(self._active_pipeline.health())
         if aux_pending:
             vals = jax.device_get(list(aux_pending))
             aux_pending.clear()
@@ -627,6 +710,10 @@ class LocalOptimizer(BaseOptimizer):
         except Exception as e:
             self._telemetry_run_abort(e)
             raise
+        finally:
+            # join prefetch workers whether the run finished or died —
+            # repeated optimize() calls must never accumulate threads
+            self._close_data_pipeline(self._active_pipeline)
 
     def _build_step(self):
         model, criterion = self.model, self.criterion
@@ -678,12 +765,14 @@ class LocalOptimizer(BaseOptimizer):
         state = self.optim_method.state  # epoch/neval bookkeeping
         driver_state = state
         epoch_size = self.dataset.size()
-        data_iter = self._fast_forward_data(
-            self.dataset.data(train=True), driver_state)
+        _, src = self._open_data_pipeline()
+        data_iter = self._fast_forward_data(src, driver_state)
 
         def fetch_and_place():
             """Next host batch + async device transfer; overlaps the
-            dispatched step like DistriOptimizer's prefetch."""
+            dispatched step like DistriOptimizer's prefetch. With
+            set_prefetch armed, `next(data_iter)` is a queue pop off the
+            background pipeline instead of inline transformer work."""
             with Timer(self.metrics, "data fetch time"), \
                     self._span("data fetch"):
                 batch = next(data_iter, None)
@@ -760,7 +849,7 @@ class LocalOptimizer(BaseOptimizer):
             if driver_state["recordsProcessedThisEpoch"] >= epoch_size:
                 driver_state["epoch"] += 1
                 driver_state["recordsProcessedThisEpoch"] = 0
-                self.dataset.shuffle()
+                self._shuffle_dataset()
 
             with self._span("validation"):
                 self._validate(params, model_state, driver_state)

@@ -10,6 +10,7 @@ from __future__ import annotations
 
 import queue
 import threading
+from collections import deque
 from typing import Iterable, List, Optional, Sequence
 
 import jax
@@ -87,19 +88,32 @@ class LocalPredictor:
             self._jitted = jax.jit(fwd)
         return self._jitted(params, state, x)
 
+    # dispatched-but-unfetched forwards kept in flight: batch k+1 (and a
+    # few more) dispatches while batch k's result is still computing; the
+    # np.asarray fetch trails behind, so the device never idles between
+    # batches and host memory stays bounded
+    inflight = 4
+
     def predict(self, dataset) -> List[np.ndarray]:
         """dataset: AbstractDataSet of Samples, iterable of Samples, or
-        iterable of MiniBatches. Returns per-sample outputs."""
+        iterable of MiniBatches. Returns per-sample outputs. Forwards are
+        dispatched ahead through a bounded in-flight window; the blocking
+        device->host fetch happens `inflight` batches behind dispatch."""
         params = self.model.ensure_params()
         state = self.model._state
         outs: List[np.ndarray] = []
+        pending = deque()
         for batch in self._batches(dataset):
             x = batch.get_input()
             x = Table(*[jnp.asarray(v) for v in x]) if isinstance(x, list) else jnp.asarray(x)
             y = self._forward(params, state, x)
             if isinstance(y, Table):
                 y = y[1]
-            outs.extend(np.asarray(y))
+            pending.append(y)
+            if len(pending) > self.inflight:
+                outs.extend(np.asarray(pending.popleft()))
+        while pending:
+            outs.extend(np.asarray(pending.popleft()))
         return outs
 
     def predict_class(self, dataset) -> List[int]:

@@ -69,6 +69,22 @@ class ValidationMethod:
     def __call__(self, output, target):
         return self.apply(output, target)
 
+    # -- device-accumulation protocol (Evaluator.test) ------------------
+    # `stats` returns a small device array of mergeable statistics
+    # ([numerator, count] for every built-in method) WITHOUT forcing a
+    # host sync, so an evaluation loop can accumulate on device with
+    # `jnp.add` and materialize ONE ValidationResult after the last
+    # batch; `from_stats` builds the result from the fetched array.
+    # Returning None (the base default) tells the caller to fall back to
+    # per-batch host `apply` — custom user methods keep working.
+
+    def stats(self, output, target):
+        return None
+
+    def from_stats(self, stats) -> ValidationResult:
+        raise NotImplementedError(
+            f"{type(self).__name__} has no device-stats path")
+
 
 class Top1Accuracy(ValidationMethod):
     """1-based integer targets like the reference."""
@@ -76,7 +92,7 @@ class Top1Accuracy(ValidationMethod):
     def __init__(self, zero_based: bool = False):
         self.zero_based = zero_based
 
-    def apply(self, output, target):
+    def stats(self, output, target):
         out = jnp.asarray(output)
         t = jnp.asarray(target)
         if out.ndim >= 1 and out.shape[-1] == 1:
@@ -86,7 +102,7 @@ class Top1Accuracy(ValidationMethod):
             pred = (out.reshape((-1,)) >= 0.5).astype(jnp.int32)
             t = t.astype(jnp.int32).reshape((-1,))
             correct = jnp.sum((pred == t).astype(jnp.float32))
-            return AccuracyResult(float(correct), t.shape[0])
+            return jnp.stack([correct, jnp.float32(t.shape[0])])
         pred = jnp.argmax(out, axis=-1)
         if t.ndim == jnp.ndim(out) and t.shape[-1] > 1:
             # one-hot / probability targets (Keras categorical labels)
@@ -96,7 +112,13 @@ class Top1Accuracy(ValidationMethod):
             if not self.zero_based:
                 t = t - 1
         correct = jnp.sum((pred.reshape((-1,)) == t).astype(jnp.float32))
-        return AccuracyResult(float(correct), t.shape[0])
+        return jnp.stack([correct, jnp.float32(t.shape[0])])
+
+    def from_stats(self, stats):
+        return AccuracyResult(float(stats[0]), float(stats[1]))
+
+    def apply(self, output, target):
+        return self.from_stats(self.stats(output, target))
 
     def __repr__(self):
         return "Top1Accuracy"
@@ -107,14 +129,20 @@ class Top5Accuracy(ValidationMethod):
     def __init__(self, zero_based: bool = False):
         self.zero_based = zero_based
 
-    def apply(self, output, target):
+    def stats(self, output, target):
         t = jnp.asarray(target).astype(jnp.int32).reshape((-1,))
         if not self.zero_based:
             t = t - 1
         o = output.reshape((t.shape[0], -1))
         top5 = jnp.argsort(o, axis=-1)[:, -5:]
         correct = jnp.sum(jnp.any(top5 == t[:, None], axis=-1).astype(jnp.float32))
-        return AccuracyResult(float(correct), t.shape[0])
+        return jnp.stack([correct, jnp.float32(t.shape[0])])
+
+    def from_stats(self, stats):
+        return AccuracyResult(float(stats[0]), float(stats[1]))
+
+    def apply(self, output, target):
+        return self.from_stats(self.stats(output, target))
 
     def __repr__(self):
         return "Top5Accuracy"
@@ -128,10 +156,16 @@ class Loss(ValidationMethod):
             criterion = ClassNLLCriterion()
         self.criterion = criterion
 
-    def apply(self, output, target):
+    def stats(self, output, target):
         l = self.criterion.loss(output, target)
         n = output.shape[0] if hasattr(output, "shape") else 1
-        return LossResult(float(l) * n, n)
+        return jnp.stack([jnp.asarray(l, jnp.float32) * n, jnp.float32(n)])
+
+    def from_stats(self, stats):
+        return LossResult(float(stats[0]), float(stats[1]))
+
+    def apply(self, output, target):
+        return self.from_stats(self.stats(output, target))
 
     def __repr__(self):
         return "Loss"
@@ -139,12 +173,19 @@ class Loss(ValidationMethod):
 
 class MAE(ValidationMethod):
     """Mean absolute error validation method (DL/optim/ValidationMethod.scala MAE)."""
-    def apply(self, output, target):
+    def stats(self, output, target):
         # reference compares the 1-based max index to the target
         # (ValidationMethod.scala MAE)
         pred = jnp.argmax(output, -1).astype(jnp.float32) + 1.0
         err = jnp.mean(jnp.abs(pred - jnp.asarray(target).reshape((-1,))))
-        return LossResult(float(err) * output.shape[0], output.shape[0])
+        n = output.shape[0]
+        return jnp.stack([err * n, jnp.float32(n)])
+
+    def from_stats(self, stats):
+        return LossResult(float(stats[0]), float(stats[1]))
+
+    def apply(self, output, target):
+        return self.from_stats(self.stats(output, target))
 
     def __repr__(self):
         return "MAE"
@@ -171,10 +212,16 @@ class HitRatio(ValidationMethod):
     def __init__(self, k: int = 10, neg_num: int = 100):
         self.k, self.neg_num = k, neg_num
 
-    def apply(self, output, target):
+    def stats(self, output, target):
         o, rank = _positive_rank(output, target, self.neg_num)
         hits = jnp.sum((rank <= self.k).astype(jnp.float32))
-        return AccuracyResult(float(hits), o.shape[0])
+        return jnp.stack([hits, jnp.float32(o.shape[0])])
+
+    def from_stats(self, stats):
+        return AccuracyResult(float(stats[0]), float(stats[1]))
+
+    def apply(self, output, target):
+        return self.from_stats(self.stats(output, target))
 
     def __repr__(self):
         return f"HitRate@{self.k}"
@@ -185,10 +232,16 @@ class NDCG(ValidationMethod):
     def __init__(self, k: int = 10, neg_num: int = 100):
         self.k, self.neg_num = k, neg_num
 
-    def apply(self, output, target):
+    def stats(self, output, target):
         o, rank = _positive_rank(output, target, self.neg_num)
         gain = jnp.where(rank <= self.k, 1.0 / jnp.log2(rank + 1.0), 0.0)
-        return AccuracyResult(float(jnp.sum(gain)), o.shape[0])
+        return jnp.stack([jnp.sum(gain), jnp.float32(o.shape[0])])
+
+    def from_stats(self, stats):
+        return AccuracyResult(float(stats[0]), float(stats[1]))
+
+    def apply(self, output, target):
+        return self.from_stats(self.stats(output, target))
 
     def __repr__(self):
         return f"NDCG@{self.k}"
@@ -198,13 +251,19 @@ class TreeNNAccuracy(ValidationMethod):
     """Accuracy on the root prediction of a tree output [B, N, C]
     (reference TreeNNAccuracy — uses the first node's scores)."""
 
-    def apply(self, output, target):
+    def stats(self, output, target):
         o = output[:, 0, :] if output.ndim == 3 else output
         t = jnp.asarray(target)
         t = t[:, 0] if t.ndim >= 2 else t
         pred = jnp.argmax(o, axis=-1)
         correct = jnp.sum((pred == t.astype(jnp.int32) - 1).astype(jnp.float32))
-        return AccuracyResult(float(correct), o.shape[0])
+        return jnp.stack([correct, jnp.float32(o.shape[0])])
+
+    def from_stats(self, stats):
+        return AccuracyResult(float(stats[0]), float(stats[1]))
+
+    def apply(self, output, target):
+        return self.from_stats(self.stats(output, target))
 
     def __repr__(self):
         return "TreeNNAccuracy"

@@ -16,9 +16,10 @@ TPU translation of the topology model:
                   reference: cores per executor
   engine_type   — 'xla' | 'pallas-preferred' (reference MklBlas | MklDnn,
                   Engine.scala:35-38)
-There are no thread pools: XLA owns device parallelism; host-side IO
-threading lives in the data pipeline (MTImageFeatureToBatch) and the native
-loader.
+There are no compute thread pools: XLA owns device parallelism. Host-side
+IO threading lives in the data pipeline — `io_threads` sizes the
+prefetcher's worker pool (dataset/prefetch.py, the reference's
+MTImageFeatureToBatch thread pool) — and the native loader.
 """
 
 from __future__ import annotations
@@ -36,7 +37,9 @@ _DEFAULTS: Dict[str, Any] = {
     # DistriOptimizer.scala:863)
     "failure_retry_times": 5,
     "failure_retry_interval_s": 120,
-    # data pipeline host threads (reference bigdl.Parameter.syncPoolSize etc.)
+    # data pipeline host threads: the default worker count for the
+    # prefetching input pipeline (dataset/prefetch.py, the reference's
+    # MTImageFeatureToBatch pool / bigdl.Parameter.syncPoolSize)
     "io_threads": 4,
     # singleton check (reference bigdl.check.singleton, Engine.scala:263)
     "check_singleton": False,
@@ -70,16 +73,26 @@ class _Engine:
         """Initialize topology + config. Idempotent; later calls only merge
         config overrides (reference Engine.init, Engine.scala:105)."""
         with self._lock:
+            # merge env + overrides into a candidate first: a rejected
+            # init must leave the live config untouched
+            merged = dict(self.config)
             for k, v in os.environ.items():
                 if k.startswith(_ENV_PREFIX):
                     key = k[len(_ENV_PREFIX):].lower()
-                    if key in self.config:
-                        self.config[key] = type(_DEFAULTS.get(key, v))(
+                    if key in merged:
+                        merged[key] = type(_DEFAULTS.get(key, v))(
                             _coerce(v, _DEFAULTS.get(key)))
             for k, v in overrides.items():
-                if k not in self.config:
+                if k not in merged:
                     raise KeyError(f"unknown Engine config key: {k}")
-                self.config[k] = v
+                merged[k] = v
+            io = merged["io_threads"]
+            if not isinstance(io, int) or isinstance(io, bool) or io < 1:
+                raise ValueError(
+                    f"io_threads must be a positive int, got {io!r} — it "
+                    "sizes the input-pipeline worker pool "
+                    "(dataset/prefetch.py)")
+            self.config.update(merged)
             # distributed join happens on whichever init() call first asks
             # for it — even if a library already ran a plain init()
             if self.config["distributed"] and not self._distributed_started:

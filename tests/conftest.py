@@ -23,3 +23,32 @@ import jax  # noqa: E402
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", False)
 jax.config.update("jax_default_matmul_precision", "highest")
+
+import threading  # noqa: E402
+import time  # noqa: E402
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True, scope="session")
+def _no_nondaemon_thread_leaks():
+    """Fail the suite if any test leaks a non-daemon thread.
+
+    The input pipeline's prefetch workers are deliberately non-daemon
+    (dataset/prefetch.py) so a missed close() is a VISIBLE failure here
+    instead of a silently accumulating pool — this guard is the
+    structural backstop for every future pipeline regression. The check
+    runs at session teardown with a short grace window for threads that
+    are mid-join."""
+    before = {t for t in threading.enumerate() if not t.daemon}
+    yield
+    deadline = time.time() + 10.0
+    while True:
+        leaked = [t for t in threading.enumerate()
+                  if not t.daemon and t.is_alive() and t not in before]
+        if not leaked or time.time() > deadline:
+            break
+        time.sleep(0.2)
+    assert not leaked, (
+        f"non-daemon threads leaked by the test session: {leaked} — "
+        "a prefetch pipeline (or other worker pool) was not closed")

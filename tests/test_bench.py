@@ -25,6 +25,19 @@ def test_bench_lenet_host_pipeline_variant():
     assert tp > 0
 
 
+def test_bench_input_pipeline_ab_runs():
+    """The --input-cost-ms A/B (serial vs prefetched input pipeline)
+    produces the json contract; tiny segment counts keep it a smoke
+    test — the real measurement is recorded in docs/PERF.md."""
+    from bigdl_tpu.tools.bench_cli import bench_input_pipeline
+    out = bench_input_pipeline(0.0, segments=2, seg_iters=3)
+    assert out["metric"] == "input_pipeline_ab"
+    assert out["serial_records_per_sec"] > 0
+    assert out["prefetch_records_per_sec"] > 0
+    assert out["speedup"] > 0
+    assert out["workers"] == 1  # supply-rate matching at zero cost
+
+
 def test_accel_probe_bounded():
     from bigdl_tpu.tools.bench_cli import _accel_responsive
     # the probe subprocess inherits the REAL session backend (the axon
