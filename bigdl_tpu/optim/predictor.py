@@ -8,8 +8,6 @@ broadcast + instance pool collapses into XLA's compiled executable reuse.
 
 from __future__ import annotations
 
-import queue
-import threading
 from collections import deque
 from typing import Iterable, List, Optional, Sequence
 
@@ -74,18 +72,19 @@ class LocalPredictor:
             model = ConversionUtils.convert(model, inference=True)
         self.model = model
         self.batch_size = batch_size
-        self._jitted = None
+        # build the jit wrapper eagerly: jax.jit is free until first call,
+        # and concurrent first callers (the serving engine's warmup vs
+        # live traffic) must not race a lazy assignment
+        final_model = model
+
+        def fwd(params, state, x):
+            out, _ = functional_apply(final_model, params, x, state=state,
+                                      training=False)
+            return out
+
+        self._jitted = jax.jit(fwd)
 
     def _forward(self, params, state, x):
-        if self._jitted is None:
-            model = self.model
-
-            def fwd(params, state, x):
-                out, _ = functional_apply(model, params, x, state=state,
-                                          training=False)
-                return out
-
-            self._jitted = jax.jit(fwd)
         return self._jitted(params, state, x)
 
     # dispatched-but-unfetched forwards kept in flight: batch k+1 (and a
@@ -191,25 +190,47 @@ class DistriPredictor(LocalPredictor):
 
 
 class PredictionService:
-    """Thread-safe serving (PredictionService.scala:56-67). The reference
-    needed an instance pool because module objects mutate during forward;
-    XLA compiled executables are immutable and thread-safe, so concurrent
-    predict() calls just share one executable — no pool, no lock. Only the
-    one-time compile is guarded."""
+    """Thread-safe serving (PredictionService.scala:56-67), now a facade
+    over the dynamic micro-batching engine (`bigdl_tpu.serving`). The
+    reference pooled module instances because they mutate during forward;
+    here concurrent predict() calls coalesce into padded micro-batches on
+    one immutable XLA executable per shape bucket — N concurrent callers
+    cost one batched forward, not N batch-1 forwards. (This also removes
+    the old cold-start double forward: the first call used to run
+    `_forward` once under the compile lock and then AGAIN for its result;
+    the engine runs each batch exactly once.)
 
-    def __init__(self, model: Module, batch_size: int = 32):
-        self.predictor = LocalPredictor(model, batch_size)
+    API-compatible: `predict(sample) -> np.ndarray` per-sample row. New:
+    `close()` (joins the engine's non-daemon dispatcher — call it, or use
+    the service as a context manager), plus engine knobs (`max_wait_ms`,
+    `admission`, `buckets`, ...) forwarded via keyword arguments.
+
+    The facade defaults `max_wait_ms=0`: a legacy serial caller blocked
+    on its own future CANNOT produce a second request, so holding the
+    gather window open would charge every call the full wait for
+    nothing. Concurrent callers still coalesce through the backlog that
+    accumulates while the dispatcher runs the previous batch; pass
+    `max_wait_ms=...` explicitly to trade latency for fuller batches."""
+
+    def __init__(self, model: Module, batch_size: int = 32, **engine_kw):
+        from bigdl_tpu.serving import InferenceEngine
+        engine_kw.setdefault("max_wait_ms", 0.0)
+        self.engine = InferenceEngine(model, max_batch_size=batch_size,
+                                      **engine_kw)
         # serve from the predictor's CONVERTED copy, never the caller's model
-        self.model = self.predictor.model
-        self._compile_lock = threading.Lock()
+        self.predictor = self.engine._pred
+        self.model = self.engine.model
 
-    def predict(self, sample: Sample) -> np.ndarray:
-        params = self.model.ensure_params()
-        x = jnp.asarray(sample.feature)[None]
-        if self.predictor._jitted is None:
-            with self._compile_lock:
-                self.predictor._forward(params, self.model._state, x)
-        y = self.predictor._forward(params, self.model._state, x)
-        if isinstance(y, Table):
-            y = y[1]
-        return np.asarray(y)[0]
+    def predict(self, sample: Sample,
+                timeout: Optional[float] = None) -> np.ndarray:
+        return self.engine.predict(sample, timeout=timeout)
+
+    def close(self):
+        """Drain queued requests and join the dispatcher thread."""
+        self.engine.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

@@ -1,0 +1,31 @@
+"""bigdl_tpu.serving — dynamic micro-batching inference engine.
+
+BigDL 2.0 grew Cluster Serving (arXiv 2204.01715 §4) over the original
+training stack: queued requests, arrival-rate batching, backpressure, and
+latency reporting. This package is that layer rebuilt TPU-native and
+in-process: an `InferenceEngine` that concurrent clients `submit()`
+`Sample`s to and get futures back, with
+
+- micro-batching under a `(max_batch_size, max_wait_ms)` policy,
+- power-of-two shape buckets so the jitted forward compiles once per
+  bucket (`warmup()` precompiles them all),
+- a bounded queue with blocking or reject-on-full admission, per-request
+  deadlines, and error isolation per batch,
+- drain-then-shutdown `close()` joining the non-daemon dispatcher, and
+- queue-wait / batch-size / latency histograms plus queue-depth and
+  bucket-hit-rate gauges through `observability.Telemetry` sinks.
+
+`optim.predictor.PredictionService` is the API-compatible facade over this
+engine. See docs/serving.md for architecture and tuning.
+"""
+
+from bigdl_tpu.serving.engine import (EngineClosedError, InferenceEngine,
+                                      QueueFullError, ServingError,
+                                      ServingTimeoutError, default_buckets)
+from bigdl_tpu.serving.stats import WindowedHistogram
+
+__all__ = [
+    "InferenceEngine", "default_buckets", "WindowedHistogram",
+    "ServingError", "QueueFullError", "ServingTimeoutError",
+    "EngineClosedError",
+]

@@ -523,6 +523,112 @@ def bench_input_pipeline(input_cost_ms: float, batch_size: int = 256,
     return out
 
 
+def bench_serving_ab(clients: int = 8, segments: int = 20,
+                     seg_requests: int = 64, max_batch: int = 32,
+                     max_wait_ms: float = 2.0):
+    """Serving A/B: closed-loop concurrent clients, single-sample serial
+    forwards vs the micro-batching engine (bigdl_tpu/serving).
+
+    Serial mode is the pre-engine `PredictionService` path: every request
+    pays its own batch-1 jitted forward + fetch, so N concurrent callers
+    queue N tiny executions on the device. Engine mode submits the same
+    closed loop through `InferenceEngine`, which coalesces concurrent
+    requests into padded micro-batches. Both modes run the SAME converted
+    model and warmed executables; measurement uses the alternated
+    pair-ratio estimator from docs/PERF.md (strictly alternated
+    serial/engine segments, per-pair throughput ratios, median) so
+    container machine-speed drift cancels inside each pair. Prints ONE
+    json line: serial and engine requests/sec, the speedup, and the
+    engine's p50/p95/p99 request latency."""
+    import threading
+
+    import jax.numpy as jnp
+
+    import bigdl_tpu.nn as nn_
+    from bigdl_tpu.dataset.sample import Sample
+    from bigdl_tpu.optim.predictor import LocalPredictor
+    from bigdl_tpu.serving import InferenceEngine
+
+    model = (nn_.Sequential().add(nn_.Reshape([784]))
+             .add(nn_.Linear(784, 256)).add(nn_.Tanh())
+             .add(nn_.Linear(256, 256)).add(nn_.Tanh())
+             .add(nn_.Linear(256, 10)).add(nn_.LogSoftMax()))
+    model.ensure_params()
+    rs = np.random.RandomState(0)
+    samples = [Sample(rs.rand(28, 28).astype(np.float32))
+               for _ in range(64)]
+
+    serial_pred = LocalPredictor(model, batch_size=max_batch)
+    sp_params = serial_pred.model.ensure_params()
+    sp_state = serial_pred.model._state
+
+    def serial_one(s):
+        y = serial_pred._forward(sp_params, sp_state,
+                                 jnp.asarray(s.feature)[None])
+        return np.asarray(y)[0]
+
+    engine = InferenceEngine(model, max_batch_size=max_batch,
+                             max_wait_ms=max_wait_ms)
+    engine.warmup(samples[0])
+    serial_one(samples[0])  # compile the batch-1 path too
+
+    per_client = max(1, seg_requests // clients)
+
+    def run_mode(fn):
+        """One closed-loop segment: every client issues its requests
+        back-to-back; returns requests/sec over the segment wall time."""
+        barrier = threading.Barrier(clients + 1)
+
+        def worker(k):
+            barrier.wait()
+            for i in range(per_client):
+                fn(samples[(k * 31 + i) % len(samples)])
+
+        threads = [threading.Thread(target=worker, args=(k,))
+                   for k in range(clients)]
+        for t in threads:
+            t.start()
+        barrier.wait()
+        t0 = time.perf_counter()
+        for t in threads:
+            t.join()
+        return clients * per_client / (time.perf_counter() - t0)
+
+    try:
+        run_mode(serial_one)  # throwaway pair: allocator/scheduler warmup
+        run_mode(lambda s: engine.predict(s, timeout=60.0))
+        serial_rates, pair_ratios = [], []
+        for _ in range(segments):
+            s_rps = run_mode(serial_one)
+            e_rps = run_mode(lambda s: engine.predict(s, timeout=60.0))
+            serial_rates.append(s_rps)
+            pair_ratios.append(e_rps / s_rps)
+        stats = engine.stats()
+    finally:
+        engine.close()
+
+    serial = float(np.median(serial_rates))
+    speedup = float(np.median(pair_ratios))
+    out = {
+        "metric": "serving_ab",
+        "clients": clients,
+        "max_batch_size": max_batch,
+        "max_wait_ms": max_wait_ms,
+        "serial_rps": round(serial, 1),
+        # derived from the drift-robust pair-ratio median, same policy as
+        # the input-pipeline A/B
+        "engine_rps": round(serial * speedup, 1),
+        "speedup": round(speedup, 3),
+        "engine_batch_size_p50": stats.get("batch_size_p50"),
+        "engine_bucket_hit_rate": stats.get("bucket_hit_rate"),
+    }
+    for k in ("latency_ms_p50", "latency_ms_p95", "latency_ms_p99"):
+        if k in stats:
+            out[f"engine_{k}"] = stats[k]
+    print(json.dumps(out), flush=True)
+    return out
+
+
 def bench_baseline_configs():
     """One stderr line per remaining BASELINE.md config (the headline
     already covers ResNet-50): LeNet-5, Inception-v1, PTB LSTM, and
@@ -872,6 +978,8 @@ def main():
     # child processes inherit it.
     argv = []
     input_cost_ms = None
+    serve = False
+    serve_clients = 8
     it = iter(sys.argv[1:])
     for a in it:
         if a == "--telemetry":
@@ -883,8 +991,24 @@ def main():
             input_cost_ms = float(a.split("=", 1)[1])
         elif a == "--input-cost-ms":
             input_cost_ms = float(next(it, "0"))
+        elif a == "--serve":
+            serve = True
+        elif a.startswith("--serve-clients="):
+            serve = True
+            serve_clients = int(a.split("=", 1)[1])
+        elif a == "--serve-clients":
+            serve = True
+            serve_clients = int(next(it, "8"))
         else:
             argv.append(a)
+    if serve:
+        # serving A/B (closed-loop concurrent clients, serial batch-1 vs
+        # micro-batching engine) — measurable off-TPU; one json line on
+        # stdout, see docs/PERF.md "Serving"
+        logging.getLogger("bigdl_tpu.optim").setLevel(logging.WARNING)
+        _configure_compile_cache()
+        bench_serving_ab(clients=serve_clients)
+        return
     if input_cost_ms is not None:
         # standalone input-pipeline A/B (serial vs prefetch, synthetic
         # per-batch augmentation sleep) — measurable off-TPU; one json

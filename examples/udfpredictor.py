@@ -62,12 +62,15 @@ def main(argv=None):
     rows = [t for t, _ in synthetic_corpus(n_per_class=args.rows, seed=7)]
     truth = [l for _, l in synthetic_corpus(n_per_class=args.rows, seed=7)]
     try:
-        import pandas as pd
-        df = pd.DataFrame({"text": rows})
-        df["prediction"] = df["text"].apply(classify_udf)
-        preds = df["prediction"].tolist()
-    except ImportError:
-        preds = [classify_udf(t) for t in rows]
+        try:
+            import pandas as pd
+            df = pd.DataFrame({"text": rows})
+            df["prediction"] = df["text"].apply(classify_udf)
+            preds = df["prediction"].tolist()
+        except ImportError:
+            preds = [classify_udf(t) for t in rows]
+    finally:
+        service.close()  # join the serving engine's dispatcher thread
     acc = float(np.mean(np.asarray(preds) == np.asarray(truth)))
     print(f"UDF accuracy over {len(rows)} rows: {acc}")
     return acc

@@ -38,6 +38,20 @@ def test_bench_input_pipeline_ab_runs():
     assert out["workers"] == 1  # supply-rate matching at zero cost
 
 
+def test_bench_serving_ab_runs():
+    """The --serve A/B (closed-loop serial vs micro-batching engine)
+    produces the json contract; tiny segment counts keep it a smoke
+    test — the real measurement is recorded in docs/PERF.md."""
+    from bigdl_tpu.tools.bench_cli import bench_serving_ab
+    out = bench_serving_ab(clients=2, segments=2, seg_requests=8,
+                           max_batch=8)
+    assert out["metric"] == "serving_ab"
+    assert out["serial_rps"] > 0
+    assert out["engine_rps"] > 0
+    assert out["speedup"] > 0
+    assert out["engine_bucket_hit_rate"] == 1.0  # warmup covers all buckets
+
+
 def test_accel_probe_bounded():
     from bigdl_tpu.tools.bench_cli import _accel_responsive
     # the probe subprocess inherits the REAL session backend (the axon

@@ -53,6 +53,12 @@ class TestExamples:
         acc = main(["--rows", "4"])
         assert acc > 0.5
 
+    def test_serving(self):
+        from examples.serving import main
+        acc = main(["--n", "192", "--clients", "4", "--requests", "64",
+                    "--max-epoch", "3"])
+        assert acc > 0.8
+
     def test_keras_mnist_cnn(self):
         from examples.keras_mnist_cnn import main
         score = main(["--nb-epoch", "1", "--batch-size", "64"])
