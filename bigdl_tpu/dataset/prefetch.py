@@ -43,6 +43,8 @@ import threading
 import time
 from typing import Callable, Iterator, Optional
 
+from bigdl_tpu.resilience import faults
+
 logger = logging.getLogger("bigdl_tpu.dataset")
 
 
@@ -61,13 +63,22 @@ class ThreadedPrefetcher:
 
     def __init__(self, source: Iterator, fn: Optional[Callable] = None,
                  depth: int = 2, workers: int = 1,
-                 deterministic: bool = True, name: str = "prefetch"):
+                 deterministic: bool = True, name: str = "prefetch",
+                 retry_policy=None):
         if depth < 1:
             raise ValueError(f"depth must be >= 1, got {depth}")
         if workers < 1:
             raise ValueError(f"workers must be >= 1, got {workers}")
         self._source = iter(source)
         self._fn = fn
+        # bounded in-worker retry of TRANSIENT per-item failures (flaky
+        # remote reads in a decode stage): the item keeps its seq ticket,
+        # so a retried item lands in the same output position and the
+        # deterministic-mode ordering contract is unchanged. Permanent
+        # failures (and exhausted retries) still propagate to the
+        # consumer. Only the per-item fn retries — a raw `next()` on the
+        # source cannot re-run once its iterator has raised.
+        self._retry = retry_policy
         self._depth = depth
         # wake workers once `hyst` slots are free (burst refill); the
         # remaining depth - hyst buffered items cover the refill latency,
@@ -158,18 +169,34 @@ class ThreadedPrefetcher:
                         self._pulled += 1
                 t0 = time.perf_counter()
                 if self._fn is not None:
-                    try:
-                        item = self._fn(item)
-                    except StopIteration as e:
+                    def apply(item=item, seq=seq):
+                        # chaos site: no-op unless a FaultInjector is
+                        # installed; inside the retried callable so an
+                        # injected transient flake exercises the retry.
+                        # A StopIteration from fn is converted to a
+                        # SENTINEL here, not an exception: it is a
+                        # deterministic logic error that must bypass the
+                        # retry (re-running it replays identically) AND
+                        # must not reach the policy as a StopIteration
+                        # (an unknown exception type it would retry).
+                        faults.fire("prefetch.worker", seq=seq)
+                        try:
+                            return True, self._fn(item)
+                        except StopIteration as e:
+                            return False, e
+                    ok, item = apply() if self._retry is None \
+                        else self._retry.call(apply)
+                    if not ok:
                         # PEP-479 analogue: a StopIteration escaping the
-                        # per-item fn would read as clean stream exhaustion
-                        # in the consumer — surface it as a hard error
-                        # (e.g. an elementwise-marked stage that yielded
-                        # nothing for an item) instead of silent truncation
+                        # per-item fn would read as clean stream
+                        # exhaustion in the consumer — surface it as a
+                        # hard error (e.g. an elementwise-marked stage
+                        # that yielded nothing for an item) instead of
+                        # silent truncation
                         raise RuntimeError(
                             "prefetch fn raised StopIteration — an "
-                            "elementwise transformer produced no output "
-                            "for an item") from e
+                            "elementwise transformer produced no "
+                            "output for an item") from item
                 dt += time.perf_counter() - t0
                 with self._lock:
                     self._busy_s += dt
@@ -341,7 +368,8 @@ class InputPipeline:
 
 def build_input_pipeline(dataset, train: bool = True, depth: int = 2,
                          workers: Optional[int] = None,
-                         deterministic: bool = True) -> InputPipeline:
+                         deterministic: bool = True,
+                         retry_policy=None) -> InputPipeline:
     """Build the prefetching input pipeline for a dataset.
 
     `workers=None` takes `Engine.io_threads` (the reference's data-plane
@@ -351,7 +379,13 @@ def build_input_pipeline(dataset, train: bool = True, depth: int = 2,
     deterministic order exact); the stateful remainder (batching) runs in
     one ordered background stage. Chains with no parallel-safe prefix fall
     back to a single background puller — the whole chain still overlaps
-    the consumer, which is the first-order win."""
+    the consumer, which is the first-order win.
+
+    `retry_policy` (a `resilience.RetryPolicy`) arms bounded in-worker
+    retry of transient per-item failures in the parallel stage — one
+    flaky remote read no longer kills the whole training run, and the
+    deterministic-mode ordering contract is preserved (the retried item
+    keeps its sequence ticket)."""
     from bigdl_tpu.dataset.dataset import _TransformedDataSet
     if workers is None:
         from bigdl_tpu.utils.engine import Engine
@@ -374,7 +408,7 @@ def build_input_pipeline(dataset, train: bool = True, depth: int = 2,
             par = ThreadedPrefetcher(
                 base.data(train), fn=prefix.apply_one, depth=depth,
                 workers=workers, deterministic=deterministic,
-                name="prefetch-map")
+                name="prefetch-map", retry_policy=retry_policy)
             if rest is None:
                 return InputPipeline([par])
             # ordered tail stage: batching consumes the (reordered)
@@ -385,6 +419,14 @@ def build_input_pipeline(dataset, train: bool = True, depth: int = 2,
         logger.warning(
             "prefetch: transformer chain has no element-wise prefix; "
             "falling back to a single background pipeline thread")
-    # single puller over the full chain (or an untransformed dataset)
+    # single puller over the full chain (or an untransformed dataset).
+    # No per-item fn runs here, so there is nothing the retry policy can
+    # safely re-run (a source iterator that raised cannot be re-pulled)
+    # — say so instead of silently ignoring the knob.
+    if retry_policy is not None:
+        logger.warning(
+            "prefetch: retry_policy is ignored on the single-puller "
+            "fallback path — only the per-item element-wise stage can "
+            "retry (workers > 1 with an element-wise chain prefix)")
     return InputPipeline([ThreadedPrefetcher(
         dataset.data(train), depth=depth, workers=1, name="prefetch")])

@@ -22,6 +22,8 @@ import os
 import time
 from typing import Dict, List, Optional
 
+from bigdl_tpu.resilience import faults
+
 
 def host_rss_mb() -> Optional[float]:
     """Current resident set size of this process in MB (from
@@ -160,6 +162,11 @@ class Telemetry:
         return self
 
     def emit(self, record: Dict):
+        # chaos site: a FaultInjector plan can make the sink path flake
+        # here, proving observability failures stay non-fatal to the
+        # system being observed (the serving engine catches and keeps
+        # serving — tests/test_resilience.py)
+        faults.fire("telemetry.sink", record_type=record.get("type"))
         record.setdefault("time", time.time())
         self.sink.emit(record)
 

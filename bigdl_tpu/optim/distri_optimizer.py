@@ -44,6 +44,8 @@ from bigdl_tpu.optim.metrics import Timer
 from bigdl_tpu.optim.trigger import Trigger
 from bigdl_tpu.parallel.mesh import build_mesh, shard_batch
 from bigdl_tpu.parallel.sharding import ShardingRules, infer_param_specs
+from bigdl_tpu.resilience import faults
+from bigdl_tpu.resilience.retry import RetryPolicy
 from bigdl_tpu.utils.table import Table
 
 logger = logging.getLogger("bigdl_tpu.optim")
@@ -53,19 +55,36 @@ class DistriOptimizer(BaseOptimizer):
     """Synchronous data-parallel (+ optional tensor-parallel) SGD on a mesh.
 
     Failure handling parity (DistriOptimizer.scala:862-943): `optimize`
-    wraps the step loop in a retry that reloads the newest checkpoint
-    (bigdl.failure.retryTimes equivalent = `retry_times`).
+    wraps the step loop in a retry that reloads the newest VALID
+    checkpoint (bigdl.failure.retryTimes equivalent = `retry_times`),
+    upgraded past the reference in three ways (bigdl_tpu.resilience):
+
+    - backoff is exponential with full jitter (the reference sleeps a
+      fixed `retry_interval_s` — a thundering herd when a fleet restarts
+      against one store) under an optional wall-clock retry budget,
+    - classified-PERMANENT errors (shape bugs, type errors — see
+      `RetryPolicy`) abort immediately instead of burning every retry on
+      a failure that replays identically,
+    - the checkpoint reload verifies digests and falls back through older
+      snapshots when the newest is corrupt (quarantining it) rather than
+      dying inside the retry with an unpickling error.
+
+    Pass `retry_policy` to replace the default
+    `RetryPolicy(max_retries=retry_times, base_delay_s=retry_interval_s)`;
+    each retry emits a `retry` telemetry event.
     """
 
     def __init__(self, model: Module, dataset, criterion: Criterion,
                  mesh: Optional[Mesh] = None,
                  sharding_rules: Optional[ShardingRules] = None,
-                 retry_times: int = 5, retry_interval_s: float = 1.0):
+                 retry_times: int = 5, retry_interval_s: float = 1.0,
+                 retry_policy: Optional[RetryPolicy] = None):
         super().__init__(model, dataset, criterion)
         self.mesh = mesh or build_mesh()
         self.rules = sharding_rules or ShardingRules()
         self.retry_times = retry_times
         self.retry_interval_s = retry_interval_s
+        self.retry_policy = retry_policy
         self._step = None
         self._param_shardings = None
         self._pristine_params = None
@@ -174,9 +193,22 @@ class DistriOptimizer(BaseOptimizer):
         return jax.jit(step, donate_argnums=(0, 1, 6))
 
     # ------------------------------------------------------------------ #
+    def _retry_policy(self) -> RetryPolicy:
+        """The active retry policy: the one passed in, else the
+        reference-equivalent default built from retry_times /
+        retry_interval_s (backoff now jittered-exponential, classified)."""
+        if self.retry_policy is None:
+            self.retry_policy = RetryPolicy(
+                max_retries=self.retry_times,
+                base_delay_s=self.retry_interval_s,
+                name="distri_optimizer")
+        return self.retry_policy
+
     def optimize(self) -> Module:
         self._maybe_optimize_graph()
+        policy = self._retry_policy()
         attempt = 0
+        backoff_spent = 0.0
         last_failure = time.time()
         while True:
             try:
@@ -189,24 +221,36 @@ class DistriOptimizer(BaseOptimizer):
                     self._close_data_pipeline(self._active_pipeline)
             except (KeyboardInterrupt, SystemExit):
                 raise
-            except Exception as e:  # retry from newest checkpoint
+            except Exception as e:  # retry from newest valid checkpoint
                 attempt += 1
-                # space failures: reset count if they are far apart
+                # space failures: reset count/budget if they are far apart
                 if time.time() - last_failure > 120:
                     attempt = 1
+                    backoff_spent = 0.0
                 last_failure = time.time()
-                if attempt > self.retry_times or self.checkpoint_path is None:
+                delay = None if self.checkpoint_path is None else \
+                    policy.next_delay(attempt, backoff_spent, e)
+                if delay is None:
+                    # permanent error, retries exhausted, budget gone, or
+                    # nothing to reload from — surface it NOW (a shape
+                    # error no longer burns every retry replaying itself)
                     self._telemetry_run_abort(e)
                     raise
                 logger.warning(
                     f"Optimization failed ({e!r}); retry {attempt}/"
-                    f"{self.retry_times} from latest checkpoint")
+                    f"{policy.max_retries} from latest checkpoint in "
+                    f"{delay:.3f}s")
                 if self.telemetry is not None:
                     # close the aborted attempt in the stream: consumers
                     # pair each run_start with a run_end OR a run_retry
                     self.telemetry.event("run_retry", attempt=attempt,
                                          error=repr(e))
-                # same loader as cold-start resume — handles both the
+                    self.telemetry.event(
+                        "retry", policy=policy.name, attempt=attempt,
+                        delay_s=round(delay, 6), error=repr(e),
+                        transient=True)
+                # same loader as cold-start resume — digest-verified,
+                # falls back through older snapshots, handles both the
                 # pickle and the orbax-sharded checkpoint formats
                 if self.resume_from_latest_checkpoint():
                     pass
@@ -217,7 +261,9 @@ class DistriOptimizer(BaseOptimizer):
                     # failing again with "Array has been deleted"
                     self.model.set_params(self._pristine_params)
                     self.model._state = self._pristine_state
-                time.sleep(self.retry_interval_s)
+                backoff_spent += delay
+                if delay > 0:
+                    policy.sleep(delay)
 
     def _optimize_impl(self) -> Module:
         mesh = self.mesh
@@ -301,6 +347,10 @@ class DistriOptimizer(BaseOptimizer):
         pending = fetch_and_place()
         while pending is not None and not self.end_trigger(driver_state):
             batch, x, y = pending
+            # chaos hook: a no-op unless a FaultInjector is installed —
+            # lets tests crash the loop at an exact iteration and drive
+            # the retry/reload machinery deterministically
+            faults.fire("train.step", step=driver_state["neval"] + 1)
             lr = self.optim_method.current_lr()
             with self._span("step dispatch", step=driver_state["neval"] + 1):
                 params, opt_state, new_ms, loss, rng_dev, aux = step(

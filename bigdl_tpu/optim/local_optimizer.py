@@ -28,6 +28,7 @@ from bigdl_tpu.optim.metrics import Metrics, Timer
 from bigdl_tpu.optim.optim_method import OptimMethod, SGD
 from bigdl_tpu.optim.trigger import Trigger, every_epoch
 from bigdl_tpu.optim.validation import ValidationMethod
+from bigdl_tpu.resilience import faults
 from bigdl_tpu.utils.table import Table
 
 logger = logging.getLogger("bigdl_tpu.optim")
@@ -108,14 +109,23 @@ class BaseOptimizer:
     setEndWhen = set_end_when
 
     def set_checkpoint(self, path: str, trigger: Trigger,
-                       sharded: bool = False):
+                       sharded: bool = False,
+                       keep_last_n: Optional[int] = None):
         """`sharded=True` writes the array payload via orbax with every
         process saving only its addressable shards (multi-host scale
         path, serialization/sharded_checkpoint.py); default is the
-        host-side pickle format."""
+        host-side durable pickle format (atomic rename + sha256 digests,
+        serialization/checkpoint.py). `keep_last_n` bounds disk: after
+        each successful save the oldest valid checkpoints beyond the
+        newest n are pruned."""
+        if keep_last_n is not None and keep_last_n < 1:
+            # fail at configure time, not at the first trigger mid-run
+            raise ValueError(
+                f"keep_last_n must be >= 1, got {keep_last_n}")
         self.checkpoint_path = path
         self.checkpoint_trigger = trigger
         self.checkpoint_sharded = sharded
+        self.checkpoint_keep_last_n = keep_last_n
         return self
 
     setCheckpoint = set_checkpoint
@@ -131,26 +141,21 @@ class BaseOptimizer:
         process calls this after a crash/SIGKILL and continues the run —
         params, optimizer slots (Adam moments / SGD velocity), epoch and
         iteration counters, and the mid-epoch data position all resume.
-        Returns False when there is nothing to resume from."""
-        import json as _json
+        Returns False when there is nothing to resume from.
 
-        from bigdl_tpu.serialization.checkpoint import (latest_checkpoint,
+        Resilience: loads through `load_latest_valid` — checkpoints are
+        digest-verified on read, a corrupt newest snapshot is quarantined
+        (telemetry `checkpoint_quarantined`) and resume falls back to the
+        next older one instead of dying on an unpickling error."""
+        from bigdl_tpu.serialization.checkpoint import (load_latest_valid,
                                                         restore_optim_method)
-        from bigdl_tpu.utils import filesystem as fsys
         if getattr(self, "checkpoint_path", None) is None:
             return False
-        ck = latest_checkpoint(self.checkpoint_path)
-        if ck is None:
+        got = load_latest_valid(self.checkpoint_path,
+                                telemetry=self.telemetry)
+        if got is None:
             return False
-        with fsys.open_file(fsys.join(ck, "manifest.json"), "r") as f:
-            manifest = _json.load(f)
-        if manifest.get("sharded"):
-            from bigdl_tpu.serialization.sharded_checkpoint import (
-                load_checkpoint_sharded)
-            params, mstate, oblob = load_checkpoint_sharded(ck)
-        else:
-            from bigdl_tpu.serialization.checkpoint import load_checkpoint
-            params, mstate, oblob = load_checkpoint(ck)
+        _, params, mstate, oblob = got
         self.model.set_params(params)
         self.model._state = mstate or {}
         restore_optim_method(self.optim_method, oblob)
@@ -296,7 +301,8 @@ class BaseOptimizer:
 
     def set_prefetch(self, depth: Optional[int] = None,
                      workers: Optional[int] = None,
-                     deterministic: bool = True):
+                     deterministic: bool = True,
+                     retry_policy=None):
         """Enable the pipelined host data plane (dataset/prefetch.py):
         background worker threads run the transformer chain into a bounded
         queue so the driver only pays a queue pop before starting the next
@@ -310,6 +316,9 @@ class BaseOptimizer:
         refill bursts wait out the driver's GIL slices.
         `deterministic=True` keeps batch order byte-identical to serial
         iteration (reordering buffer); `False` yields in completion order.
+        `retry_policy` (a `resilience.RetryPolicy`) arms bounded
+        in-worker retry of transient per-item failures (flaky remote
+        reads) without breaking deterministic ordering.
         Caveat: across EPOCH BOUNDARIES the `shuffle()` interleaving is
         timing-dependent under prefetch, so multi-epoch streams (and
         their checkpoint-resume replay) are approximate — disable
@@ -330,7 +339,8 @@ class BaseOptimizer:
         if depth < 1:
             raise ValueError(f"depth must be >= 1, got {depth}")
         self._prefetch = {"depth": int(depth), "workers": int(workers),
-                          "deterministic": bool(deterministic)}
+                          "deterministic": bool(deterministic),
+                          "retry_policy": retry_policy}
         return self
 
     setPrefetch = set_prefetch
@@ -642,11 +652,20 @@ class BaseOptimizer:
             save_checkpoint_sharded(self.checkpoint_path, self.model,
                                     params, model_state, self.optim_method,
                                     opt_slots=opt_slots, tag=tag)
+            keep = getattr(self, "checkpoint_keep_last_n", None)
+            if keep is not None and jax.process_index() == 0:
+                # retention applies to sharded checkpoints too; only the
+                # lead process prunes (every host scans the same store)
+                from bigdl_tpu.serialization.checkpoint import (
+                    prune_checkpoints)
+                prune_checkpoints(self.checkpoint_path, keep)
             return
         from bigdl_tpu.serialization.checkpoint import save_checkpoint
         save_checkpoint(self.checkpoint_path, self.model, params, model_state,
                         self.optim_method, opt_slots=opt_slots, tag=tag,
-                        overwrite=self.overwrite_checkpoint)
+                        overwrite=self.overwrite_checkpoint,
+                        keep_last_n=getattr(self, "checkpoint_keep_last_n",
+                                            None))
 
     def _validation_batches(self):
         """Yield MiniBatches whether the dataset holds Samples or batches."""
@@ -795,6 +814,9 @@ class LocalOptimizer(BaseOptimizer):
         pending = fetch_and_place()
         while pending is not None and not self.end_trigger(driver_state):
             batch, x, y = pending
+            # chaos hook (resilience/faults.py): no-op unless a
+            # FaultInjector is installed
+            faults.fire("train.step", step=driver_state["neval"] + 1)
             lr = self.optim_method.current_lr()
             self.rng, step_rng = jax.random.split(self.rng)
             with self._span("step dispatch", step=driver_state["neval"] + 1):

@@ -1,0 +1,43 @@
+"""bigdl_tpu.resilience — deterministic fault injection and recovery.
+
+The reference's whole robustness story is "retry the job and reload the
+newest snapshot" (DL/optim/DistriOptimizer.scala:862-943); this package is
+that story made testable and production-shaped. Three pieces, each usable
+alone:
+
+- `faults` — a seeded, plan-driven `FaultInjector` with named sites
+  threaded through serialization, both optimizers, the prefetch data
+  plane, remote filesystem IO, and the serving engine. A near-zero-cost
+  no-op when disabled; deterministic crashes at any chosen point when
+  installed — chaos tests are ordinary unit tests.
+- `retry` — `RetryPolicy`: exponential backoff with full jitter, a
+  wall-clock retry budget, and transient-vs-permanent classification so
+  deterministic failures (a shape error) stop burning retries.
+- `breaker` — `CircuitBreaker`: consecutive-failure trip, fast-fail
+  shedding while open, half-open probe recovery. The serving engine keys
+  one per shape bucket.
+
+Recovery events (`fault_injected`, `retry`, `circuit_open`,
+`circuit_close`, `checkpoint_verified`, `checkpoint_quarantined`) flow
+through `observability.Telemetry`. See docs/resilience.md.
+"""
+
+from bigdl_tpu.resilience.breaker import (CLOSED, HALF_OPEN, OPEN,
+                                          CircuitBreaker)
+from bigdl_tpu.resilience.faults import (KNOWN_SITES, FaultInjector,
+                                         FaultSpec, InjectedFault,
+                                         PermanentInjectedFault,
+                                         TransientInjectedFault,
+                                         active_injector, fire)
+from bigdl_tpu.resilience.retry import (DEFAULT_PERMANENT,
+                                        DEFAULT_TRANSIENT,
+                                        RetryBudgetExhausted, RetryPolicy)
+
+# constants (KNOWN_SITES, DEFAULT_TRANSIENT/PERMANENT, CLOSED/OPEN/
+# HALF_OPEN) are importable but stay out of __all__ — the generated
+# docs/LAYERS.md surface indexes classes and functions
+__all__ = [
+    "FaultInjector", "FaultSpec", "fire", "active_injector",
+    "InjectedFault", "TransientInjectedFault", "PermanentInjectedFault",
+    "RetryPolicy", "RetryBudgetExhausted", "CircuitBreaker",
+]

@@ -1,11 +1,20 @@
-from bigdl_tpu.serialization.checkpoint import (load_checkpoint,
+from bigdl_tpu.serialization.checkpoint import (CheckpointCorruptError,
+                                                latest_checkpoint,
+                                                load_checkpoint,
+                                                load_latest_valid,
+                                                prune_checkpoints,
+                                                quarantine_checkpoint,
                                                 save_checkpoint,
-                                                latest_checkpoint)
+                                                valid_checkpoints,
+                                                verify_checkpoint)
 from bigdl_tpu.serialization.module_serializer import (ModuleSerializer,
                                                        register_module,
                                                        registered_modules)
 
 __all__ = ["load_checkpoint", "save_checkpoint", "latest_checkpoint",
+           "valid_checkpoints", "verify_checkpoint", "load_latest_valid",
+           "quarantine_checkpoint", "prune_checkpoints",
+           "CheckpointCorruptError",
            "ModuleSerializer", "register_module", "registered_modules"]
 from bigdl_tpu.serialization.sharded_checkpoint import (restore_sharded,
                                                         save_sharded)

@@ -1,99 +1,435 @@
-"""Checkpoint save/load.
+"""Durable checkpoint save/load (format v2).
 
 Parity: the reference checkpoints the whole module via protobuf plus each
 OptimMethod via Java serialization into versioned files
 (AbstractOptimizer.checkpoint:206, DistriOptimizer.scala:855-860), and the
 retry loop reloads the newest snapshot (getLatestFile:966). Here a
-checkpoint is a directory of .npz pytrees + a JSON manifest — all host-side
-numpy, so sharded device arrays are gathered once (the reference similarly
-gathers weight partitions in getModel:646).
+checkpoint is a directory of pickled pytrees + a JSON manifest — all
+host-side numpy, so sharded device arrays are gathered once (the reference
+similarly gathers weight partitions in getModel:646).
+
+Durability contract (v2, this file; chaos-swept in tests/test_resilience.py):
+
+- **Atomic**: every file is written into a hidden `.tmp-*` staging dir
+  which is renamed into place only after the manifest lands — a crash at
+  ANY point mid-save leaves either the previous checkpoint set intact or
+  an ignorable staging dir, never a half-written snapshot that
+  `latest_checkpoint` could pick up. Re-saving an EXISTING tag moves the
+  old dir aside and restores it if the publish fails; only a hard kill
+  inside that two-rename window can leave the displaced copy hidden in
+  a `.replaced-*` dir (older tags are untouched either way).
+- **Verified**: the manifest carries a sha256 digest per payload file;
+  `load_checkpoint` re-hashes on read and raises `CheckpointCorruptError`
+  on mismatch (bit rot, torn writes on non-atomic remote stores).
+- **Recoverable**: `load_latest_valid` walks checkpoints newest-first,
+  quarantines any PROVEN corrupt (digest mismatch / undecodable —
+  renamed to a hidden `.corrupt-*` dir, `checkpoint_quarantined`
+  telemetry; transient read failures fall back but leave the snapshot
+  in place), and returns the newest GOOD one — a corrupt newest
+  snapshot degrades resume by one interval instead of killing the
+  retry loop with an unpickling error.
+- **Bounded**: `keep_last_n` retention prunes the oldest valid
+  checkpoints after each successful save.
+
+v1 checkpoints (no `files` digests) still load; verification is skipped.
 
 Paths may be URIs (file://, hdfs://, s3://, gs://, memory://): every IO
 goes through `bigdl_tpu.utils.filesystem`, matching the reference's
 hadoop-FS scheme resolution (DL/utils/File.scala, HdfsSpec.scala) —
 checkpointing to a remote store needs no code change, just the URI.
+Remote IO additionally rides the filesystem module's `RetryPolicy`.
 """
 
 from __future__ import annotations
 
+import hashlib
 import json
+import logging
 import os
 import pickle
 import re
 import time
-from typing import Any, Dict, Optional, Tuple
+from typing import Any, Dict, List, Optional, Tuple
 
 import jax
 import numpy as np
 
+from bigdl_tpu.resilience import faults
 from bigdl_tpu.utils import filesystem as fsys
+
+logger = logging.getLogger("bigdl_tpu.serialization")
+
+FORMAT_V2 = "bigdl_tpu.checkpoint.v2"
+
+
+class CheckpointCorruptError(RuntimeError):
+    """A checkpoint failed digest verification or could not be decoded."""
+
+
+def _tag_sort_key(tag: str):
+    """Natural sort key: digit runs compare numerically, so iter9 < iter25
+    — the deterministic tie-break when two manifests carry equal times."""
+    return tuple(int(p) if p.isdigit() else p
+                 for p in re.split(r"(\d+)", str(tag)))
+
+
+class _Sha256Tee:
+    """File-object wrapper feeding sha256 + a byte count as pickle
+    streams through — the payload is never materialized as one in-memory
+    blob (a multi-GB params pytree would otherwise coexist with its full
+    pickle byte string at checkpoint time)."""
+
+    def __init__(self, f):
+        self._f = f
+        self.sha = hashlib.sha256()
+        self.nbytes = 0
+
+    def write(self, b):
+        self.sha.update(b)
+        self.nbytes += len(b)
+        return self._f.write(b)
+
+
+class _Sha256Reader:
+    """Read-side twin of `_Sha256Tee`: hashes bytes as pickle pulls them
+    through, so verify-on-load never materializes the payload as one
+    in-memory blob alongside the unpickled pytree."""
+
+    def __init__(self, f):
+        self._f = f
+        self.sha = hashlib.sha256()
+
+    def read(self, n=-1):
+        b = self._f.read(n)
+        self.sha.update(b)
+        return b
+
+    def readline(self, n=-1):
+        b = self._f.readline(n)
+        self.sha.update(b)
+        return b
+
+
+_HASH_CHUNK = 1 << 20
+
+
+def _check_digest(ckpt_dir: str, fname: str, got: str, want: str) -> None:
+    if got != want:
+        raise CheckpointCorruptError(
+            f"digest mismatch for {fname} in {ckpt_dir}: "
+            f"manifest {want[:12]}…, file {got[:12]}…")
+
+
+def _dump_pickle(path: str, payload) -> Dict:
+    with fsys.open_file(path, "wb") as f:
+        tee = _Sha256Tee(f)
+        pickle.dump(payload, tee, protocol=pickle.DEFAULT_PROTOCOL)
+    return {"sha256": tee.sha.hexdigest(), "bytes": tee.nbytes}
 
 
 def save_checkpoint(path: str, model, params, model_state, optim_method,
-                    opt_slots=None, tag: str = "", overwrite: bool = True) -> str:
+                    opt_slots=None, tag: str = "", overwrite: bool = True,
+                    keep_last_n: Optional[int] = None) -> str:
     """Write <path>/<tag or timestamp>/ with params.pkl, state.pkl,
-    optim.pkl, manifest.json. `opt_slots` = the device-side optimizer slot
-    pytree (Adam m/v/t, SGD velocity) — the reference serializes the full
-    OptimMethod state Table, so resume must not reset moments. Returns the
-    checkpoint dir."""
+    optim.pkl, manifest.json — staged in a hidden tmp dir and renamed into
+    place so a crash mid-save never publishes a partial snapshot.
+    `opt_slots` = the device-side optimizer slot pytree (Adam m/v/t, SGD
+    velocity) — the reference serializes the full OptimMethod state Table,
+    so resume must not reset moments. `keep_last_n` prunes the oldest
+    valid checkpoints after the save commits. Returns the checkpoint dir.
+    """
+    if keep_last_n is not None and keep_last_n < 1:
+        # validate BEFORE any IO: a bad retention knob must not surface
+        # as a failure after the snapshot already committed
+        raise ValueError(f"keep_last_n must be >= 1, got {keep_last_n}")
     name = tag or time.strftime("%Y%m%d_%H%M%S")
     ckpt_dir = fsys.join(path, name)
     if fsys.exists(ckpt_dir) and not overwrite:
         raise FileExistsError(ckpt_dir)
-    fsys.makedirs(ckpt_dir, exist_ok=True)
-
-    params_np = jax.tree_util.tree_map(np.asarray, jax.device_get(params))
-    with fsys.open_file(fsys.join(ckpt_dir, "params.pkl"), "wb") as f:
-        pickle.dump(params_np, f)
-    state_np = {k: jax.tree_util.tree_map(np.asarray, v)
-                for k, v in (model_state or {}).items()}
-    with fsys.open_file(fsys.join(ckpt_dir, "state.pkl"), "wb") as f:
-        pickle.dump(state_np, f)
-    optim_blob = {
-        "class": type(optim_method).__name__,
-        "state": dict(optim_method.state),
-        "hyper": {k: v for k, v in vars(optim_method).items()
-                  if isinstance(v, (int, float, bool, str))},
-        "slots": (jax.tree_util.tree_map(np.asarray, jax.device_get(opt_slots))
-                  if opt_slots is not None else None),
-    }
-    with fsys.open_file(fsys.join(ckpt_dir, "optim.pkl"), "wb") as f:
-        pickle.dump(optim_blob, f)
-    manifest = {
-        "format": "bigdl_tpu.checkpoint.v1",
-        "model": getattr(model, "name", "model"),
-        "time": time.time(),
-        "tag": name,
-    }
-    with fsys.open_file(fsys.join(ckpt_dir, "manifest.json"), "w") as f:
-        json.dump(manifest, f, indent=2)
+    tmp_dir = fsys.join(path, f".tmp-{name}-{os.getpid()}")
+    fsys.makedirs(tmp_dir, exist_ok=True)
+    displaced = None
+    try:
+        params_np = jax.tree_util.tree_map(np.asarray,
+                                           jax.device_get(params))
+        state_np = {k: jax.tree_util.tree_map(np.asarray, v)
+                    for k, v in (model_state or {}).items()}
+        optim_blob = {
+            "class": type(optim_method).__name__,
+            "state": dict(optim_method.state),
+            "hyper": {k: v for k, v in vars(optim_method).items()
+                      if isinstance(v, (int, float, bool, str))},
+            "slots": (jax.tree_util.tree_map(
+                np.asarray, jax.device_get(opt_slots))
+                if opt_slots is not None else None),
+        }
+        files: Dict[str, Dict] = {}
+        for fname, site, payload in (
+                ("params.pkl", "ckpt.write.params", params_np),
+                ("state.pkl", "ckpt.write.state", state_np),
+                ("optim.pkl", "ckpt.write.optim", optim_blob)):
+            faults.fire(site, path=ckpt_dir, file=fname)
+            files[fname] = _dump_pickle(fsys.join(tmp_dir, fname),
+                                        payload)
+        manifest = {
+            "format": FORMAT_V2,
+            "model": getattr(model, "name", "model"),
+            "time": time.time(),
+            "tag": name,
+            "files": files,
+        }
+        faults.fire("ckpt.write.manifest", path=ckpt_dir)
+        with fsys.open_file(fsys.join(tmp_dir, "manifest.json"), "w") as f:
+            json.dump(manifest, f, indent=2)
+        # commit: the rename is the publication point — everything before
+        # it is invisible to latest_checkpoint/valid_checkpoints. An
+        # existing same-tag dir is renamed ASIDE (not deleted) first, so
+        # a failed publish can restore it — deleting it up front would
+        # lose BOTH copies if the publish rename then failed.
+        faults.fire("ckpt.commit", path=ckpt_dir)
+        if fsys.exists(ckpt_dir):
+            displaced = fsys.join(path, f".replaced-{name}-{os.getpid()}")
+            fsys.rename(ckpt_dir, displaced)
+        fsys.rename(tmp_dir, ckpt_dir)
+        if displaced is not None:
+            try:
+                fsys.rmtree(displaced)
+            except Exception as e:
+                logger.warning("could not remove displaced checkpoint %s "
+                               "(%r)", displaced, e)
+    except BaseException:
+        try:  # publish failed after the old dir moved aside: restore it
+            if displaced is not None and not fsys.exists(ckpt_dir):
+                fsys.rename(displaced, ckpt_dir)
+        except Exception:
+            pass
+        try:  # best-effort cleanup; the hidden name keeps a leftover
+            fsys.rmtree(tmp_dir)  # staging dir out of checkpoint scans
+        except Exception:
+            pass
+        raise
+    if keep_last_n is not None:
+        prune_checkpoints(path, keep_last_n)
     return ckpt_dir
+
+
+def _read_manifest(mf_path: str) -> Optional[Dict]:
+    """Parse one manifest, or None (with a warning) when it is missing or
+    unreadable — a truncated manifest.json must never kill a resume scan
+    with a JSONDecodeError; its checkpoint is simply not a candidate."""
+    try:
+        with fsys.open_file(mf_path, "r") as f:
+            return json.load(f)
+    except FileNotFoundError:
+        return None
+    except Exception as e:
+        logger.warning("skipping checkpoint with unreadable manifest %s "
+                       "(%r)", mf_path, e)
+        return None
+
+
+def _scan_checkpoints(path: str) -> List[Tuple[str, Dict]]:
+    """(dir, parsed manifest) pairs under `path`, newest first — ONE
+    manifest read per candidate, shared by every consumer on the resume
+    path (each read retries on remote stores; re-reading per layer
+    tripled the round-trips)."""
+    if not fsys.isdir(path):
+        return []
+    found = []
+    for d in fsys.listdir(path):
+        if d.startswith("."):
+            continue
+        manifest = _read_manifest(fsys.join(path, d, "manifest.json"))
+        if manifest is None:
+            continue
+        t = manifest.get("time", 0) or 0
+        found.append((float(t), _tag_sort_key(manifest.get("tag", d)),
+                      fsys.join(path, d), manifest))
+    found.sort(key=lambda e: e[:2], reverse=True)
+    return [(p, m) for _, _, p, m in found]
+
+
+def valid_checkpoints(path: str) -> List[str]:
+    """Checkpoint dirs under `path` with a readable manifest, newest
+    first (manifest time; equal times tie-break deterministically by
+    natural tag order). Hidden entries — `.tmp-*` staging dirs and
+    `.corrupt-*` quarantine dirs — are never candidates."""
+    return [p for p, _ in _scan_checkpoints(path)]
 
 
 def latest_checkpoint(path: str) -> Optional[str]:
     """Newest checkpoint dir under path (reference getLatestFile:966)."""
-    if not fsys.isdir(path):
+    cks = valid_checkpoints(path)
+    return cks[0] if cks else None
+
+
+def verify_checkpoint(ckpt_dir: str) -> Dict:
+    """Re-hash every manifest-listed payload file; returns the manifest.
+    Raises `CheckpointCorruptError` on a missing/unreadable manifest, a
+    missing file, or a digest mismatch. v1 manifests (no `files`) pass
+    vacuously."""
+    manifest = _read_manifest(fsys.join(ckpt_dir, "manifest.json"))
+    if manifest is None:
+        raise CheckpointCorruptError(
+            f"missing or unreadable manifest in {ckpt_dir}")
+    for fname, meta in (manifest.get("files") or {}).items():
+        want = meta.get("sha256")
+        if not want:
+            continue
+        h = hashlib.sha256()
+        try:
+            with fsys.open_file(fsys.join(ckpt_dir, fname), "rb") as f:
+                for chunk in iter(lambda: f.read(_HASH_CHUNK), b""):
+                    h.update(chunk)
+        except Exception as e:
+            raise CheckpointCorruptError(
+                f"checkpoint file {fname} unreadable in {ckpt_dir}: "
+                f"{e!r}") from e
+        _check_digest(ckpt_dir, fname, h.hexdigest(), want)
+    return manifest
+
+
+def load_checkpoint(ckpt_dir: str, verify: bool = True,
+                    manifest: Optional[Dict] = None) \
+        -> Tuple[Any, Dict, Dict]:
+    """Returns (params, model_state, optim_blob). With `verify` (default)
+    every payload is re-hashed as it streams through the unpickler and
+    checked against the manifest digest — corruption surfaces as
+    `CheckpointCorruptError` (from the digest check, or from the decode
+    failure corrupt bytes usually trigger first) instead of a confusing
+    downstream error. v1 checkpoints load unverified. Pass an
+    already-parsed `manifest` to skip the extra manifest read (the
+    resume scan does)."""
+    if manifest is None:
+        manifest = _read_manifest(fsys.join(ckpt_dir, "manifest.json"))
+    files = (manifest or {}).get("files") or {}
+
+    def read(fname):
+        meta = files.get(fname)
+        want = meta.get("sha256") if (verify and meta) else None
+        with fsys.open_file(fsys.join(ckpt_dir, fname), "rb") as f:
+            src = _Sha256Reader(f) if want else f
+            try:
+                payload = pickle.load(src)
+            except OSError:
+                raise  # a failing READ is not proven corruption — it
+                # must fall back without quarantining the snapshot
+            except Exception as e:
+                raise CheckpointCorruptError(
+                    f"cannot decode {fname} in {ckpt_dir}: {e!r}") from e
+            if want:
+                # hash any bytes past the pickle STOP opcode too — the
+                # manifest digest covers the whole file
+                for chunk in iter(lambda: f.read(_HASH_CHUNK), b""):
+                    src.sha.update(chunk)
+                _check_digest(ckpt_dir, fname, src.sha.hexdigest(), want)
+        return payload
+
+    return read("params.pkl"), read("state.pkl"), read("optim.pkl")
+
+
+def _event_safe(telemetry, kind: str, **fields):
+    """Emit a telemetry event without letting a broken sink (full disk
+    under a JsonlSink) kill the resume path it is narrating."""
+    if telemetry is None:
+        return
+    try:
+        telemetry.event(kind, **fields)
+    except Exception:
+        logger.exception("telemetry emit of %s failed; record dropped",
+                         kind)
+
+
+def quarantine_checkpoint(ckpt_dir: str) -> Optional[str]:
+    """Move a bad checkpoint out of the resume scan: rename it to a
+    hidden `.corrupt-<tag>` sibling (kept for forensics, invisible to
+    `valid_checkpoints`). Returns the new path, or None when the rename
+    itself failed (the dir is then still skipped per-scan by its broken
+    digests)."""
+    s = str(ckpt_dir).rstrip("/")
+    if fsys.is_uri(s):
+        parent, name = s.rsplit("/", 1)
+    else:
+        parent, name = os.path.dirname(s), os.path.basename(s)
+    base = fsys.join(parent, f".corrupt-{name}")
+    dest = base
+    n = 1
+    while fsys.exists(dest):
+        n += 1
+        dest = f"{base}-{n}"
+    try:
+        fsys.rename(ckpt_dir, dest)
+        return dest
+    except Exception as e:
+        logger.warning("could not quarantine corrupt checkpoint %s (%r)",
+                       ckpt_dir, e)
         return None
-    best, best_t = None, -1.0
-    for d in fsys.listdir(path):
-        mf = fsys.join(path, d, "manifest.json")
-        if fsys.exists(mf):
-            with fsys.open_file(mf, "r") as f:
-                t = json.load(f).get("time", 0)
-            if t > best_t:
-                best, best_t = fsys.join(path, d), t
-    return best
 
 
-def load_checkpoint(ckpt_dir: str) -> Tuple[Any, Dict, Dict]:
-    """Returns (params, model_state, optim_blob)."""
-    with fsys.open_file(fsys.join(ckpt_dir, "params.pkl"), "rb") as f:
-        params = pickle.load(f)
-    with fsys.open_file(fsys.join(ckpt_dir, "state.pkl"), "rb") as f:
-        model_state = pickle.load(f)
-    with fsys.open_file(fsys.join(ckpt_dir, "optim.pkl"), "rb") as f:
-        optim_blob = pickle.load(f)
-    return params, model_state, optim_blob
+def load_latest_valid(path: str, quarantine: bool = True, telemetry=None):
+    """Resume entry point: walk checkpoints newest-first, return
+    `(ckpt_dir, params, model_state, optim_blob)` from the newest one
+    that verifies and decodes — sharded (orbax) and pickle formats both
+    load. Checkpoints PROVEN corrupt (digest mismatch / undecodable —
+    `CheckpointCorruptError`) are quarantined (telemetry
+    `checkpoint_quarantined`) and the scan falls back to the next older
+    one; any other load failure (e.g. a remote-store outage outliving
+    the IO retry budget, an orbax read error) also falls back but leaves
+    the snapshot IN PLACE — a transient blip must never rename healthy
+    checkpoints out of the scan. The survivor emits
+    `checkpoint_verified`. None when nothing under `path` is loadable."""
+    for ckpt, manifest in _scan_checkpoints(path):
+        try:
+            if manifest.get("sharded"):
+                from bigdl_tpu.serialization.sharded_checkpoint import (
+                    load_checkpoint_sharded)
+                params, mstate, oblob = load_checkpoint_sharded(ckpt)
+            else:
+                params, mstate, oblob = load_checkpoint(ckpt, verify=True,
+                                                        manifest=manifest)
+        except (KeyboardInterrupt, SystemExit):
+            raise
+        except Exception as e:
+            corrupt = isinstance(e, CheckpointCorruptError)
+            logger.warning("checkpoint %s failed to load (%r); falling "
+                           "back to the next older snapshot%s", ckpt, e,
+                           "" if corrupt else " (left in place: failure "
+                           "is not proven corruption)")
+            _event_safe(telemetry,
+                        "checkpoint_quarantined" if corrupt
+                        else "checkpoint_unreadable",
+                        path=str(ckpt), error=repr(e))
+            if quarantine and corrupt:
+                quarantine_checkpoint(ckpt)
+            continue
+        _event_safe(telemetry, "checkpoint_verified", path=str(ckpt),
+                    format=manifest.get("format", "v1"),
+                    tag=manifest.get("tag"))
+        return ckpt, params, mstate, oblob
+    return None
+
+
+def prune_checkpoints(path: str, keep_last_n: int) -> List[str]:
+    """Retention: delete all but the newest `keep_last_n` VALID
+    checkpoints under `path` (hidden tmp/quarantine dirs are untouched).
+    Returns the removed dirs. Failures to remove are logged, never
+    raised — retention must not fail a successful save."""
+    if keep_last_n < 1:
+        raise ValueError(f"keep_last_n must be >= 1, got {keep_last_n}")
+    removed = []
+    try:
+        victims = valid_checkpoints(path)[keep_last_n:]
+    except Exception as e:
+        logger.warning("retention scan of %s failed (%r); prune skipped "
+                       "for this save", path, e)
+        return removed
+    for victim in victims:
+        try:
+            fsys.rmtree(victim)
+            removed.append(victim)
+        except Exception as e:
+            logger.warning("retention could not remove %s (%r)", victim, e)
+    return removed
 
 
 def restore_optim_method(optim_method, optim_blob: Dict):

@@ -21,11 +21,13 @@ engine. See docs/serving.md for architecture and tuning.
 
 from bigdl_tpu.serving.engine import (EngineClosedError, InferenceEngine,
                                       QueueFullError, ServingError,
-                                      ServingTimeoutError, default_buckets)
+                                      ServingTimeoutError,
+                                      ServingUnavailableError,
+                                      default_buckets)
 from bigdl_tpu.serving.stats import WindowedHistogram
 
 __all__ = [
     "InferenceEngine", "default_buckets", "WindowedHistogram",
     "ServingError", "QueueFullError", "ServingTimeoutError",
-    "EngineClosedError",
+    "ServingUnavailableError", "EngineClosedError",
 ]

@@ -28,6 +28,13 @@ trade that identity for the smaller padded forward.
 Robustness contracts (all under test):
 - a failed batch (bad feature shape, trace error) rejects only its OWN
   requests; the engine keeps serving,
+- with `breaker=...` armed, a PERSISTENTLY failing batch domain (one
+  shape bucket) trips a per-bucket circuit breaker
+  (resilience/breaker.py): its requests then fast-fail with
+  `ServingUnavailableError` instead of each paying a doomed forward,
+  half-open probe batches recover it, transitions emit
+  `circuit_open`/`circuit_close` telemetry, and `health()` reports the
+  degraded domains,
 - a request whose deadline lapses in the queue gets `ServingTimeoutError`
   while its batch neighbors complete normally,
 - `close(drain=True)` stops admission, finishes every queued request, and
@@ -55,6 +62,9 @@ from typing import Dict, List, Optional, Sequence
 import numpy as np
 
 from bigdl_tpu.dataset.sample import Sample
+from bigdl_tpu.resilience import faults
+from bigdl_tpu.resilience.breaker import (CLOSED, HALF_OPEN, OPEN,
+                                          CircuitBreaker)
 from bigdl_tpu.serving.stats import WindowedHistogram
 from bigdl_tpu.utils.table import Table
 
@@ -100,6 +110,13 @@ class ServingTimeoutError(ServingError, TimeoutError):
 
 class EngineClosedError(ServingError):
     """The engine is shut down (or shutting down) and not accepting work."""
+
+
+class ServingUnavailableError(ServingError):
+    """Fast-fail shed: this request's shape bucket has its circuit
+    breaker OPEN (too many consecutive batch failures) — the request was
+    refused WITHOUT paying a forward. Retry after the breaker's reset
+    timeout, or route elsewhere."""
 
 
 def default_buckets(max_batch_size: int) -> List[int]:
@@ -179,6 +196,19 @@ class InferenceEngine:
         `serving_stats` records every `emit_every` batches and a final
         `serving_summary` on close.
     tracer : optional `observability.SpanTracer` for per-phase spans.
+    breaker : optional dict of `resilience.CircuitBreaker` kwargs
+        (`failure_threshold`, `reset_timeout_s`, `probe_successes`,
+        `clock`) arming one circuit breaker per (feature-signature,
+        bucket) batch domain. A bucket whose batches keep failing trips
+        open: its requests then shed instantly with
+        `ServingUnavailableError` instead of each paying a doomed
+        forward (per-batch error isolation stops one bad batch killing
+        its neighbors; the breaker stops a persistently bad bucket
+        burning EVERY request routed at it). After `reset_timeout_s` one
+        probe batch tests the water (half-open) and recovery closes the
+        circuit. Transitions emit `circuit_open`/`circuit_half_open`/
+        `circuit_close` telemetry events; `health()` reports per-bucket
+        breaker state. None (default) disables the breaker.
     start : spawn the dispatcher immediately; `False` lets tests stage a
         full queue deterministically, then `start()`.
     """
@@ -189,7 +219,8 @@ class InferenceEngine:
                  buckets: Optional[Sequence[int]] = None,
                  inflight: int = 2, convert: bool = True,
                  telemetry=None, tracer=None, emit_every: int = 50,
-                 hist_window: int = 8192, start: bool = True):
+                 hist_window: int = 8192,
+                 breaker: Optional[Dict] = None, start: bool = True):
         if queue_capacity < 1:
             raise ValueError(
                 f"queue_capacity must be >= 1, got {queue_capacity}")
@@ -240,9 +271,11 @@ class InferenceEngine:
         self.batch_sizes = WindowedHistogram(hist_window)  # requests/batch
         self._n = {"submitted": 0, "completed": 0, "failed": 0,
                    "timed_out": 0, "rejected": 0, "cancelled": 0,
-                   "batches": 0, "bucket_hits": 0, "rows": 0,
+                   "shed": 0, "batches": 0, "bucket_hits": 0, "rows": 0,
                    "padded_rows": 0}
         self._compiled = set()  # (signature, bucket) pairs seen/warmed
+        self._breaker_cfg = dict(breaker) if breaker is not None else None
+        self._breakers: Dict[tuple, CircuitBreaker] = {}  # under _slock
 
         _LIVE_ENGINES.add(self)
         if start:
@@ -489,6 +522,39 @@ class InferenceEngine:
                 return b
         return self.buckets[-1]  # unreachable: gather caps at buckets[-1]
 
+    # ------------------------------------------------------------ breaker
+    @staticmethod
+    def _bucket_label(sig, bucket: int) -> str:
+        """Human/JSON-friendly batch-domain label: bucket size plus the
+        per-feature shape:dtype signature."""
+        shapes = "|".join(
+            "x".join(map(str, shape)) + f":{dtype}" for shape, dtype in sig)
+        return f"b{bucket}[{shapes}]"
+
+    def _breaker_for(self, sig, bucket: int) -> Optional[CircuitBreaker]:
+        """The (lazily-created) circuit breaker guarding one
+        (signature, bucket) batch domain; None when breakers are off."""
+        if self._breaker_cfg is None:
+            return None
+        key = (sig, bucket)
+        with self._slock:
+            br = self._breakers.get(key)
+            if br is None:
+                br = CircuitBreaker(
+                    name=self._bucket_label(sig, bucket),
+                    on_transition=self._on_breaker_transition,
+                    **self._breaker_cfg)
+                self._breakers[key] = br
+            return br
+
+    def _on_breaker_transition(self, old: str, new: str,
+                               br: CircuitBreaker):
+        kind = {OPEN: "circuit_open", CLOSED: "circuit_close"}.get(
+            new, "circuit_half_open")
+        logger.warning("serving circuit %s: %s -> %s", br.name, old, new)
+        self._emit_safe({"type": "event", "event": kind,
+                         "bucket": br.name, "from": old, "to": new})
+
     def _forward_arrays(self, arrs: List[np.ndarray]):
         import jax.numpy as jnp
         x = Table(*[jnp.asarray(a) for a in arrs]) if len(arrs) > 1 \
@@ -506,12 +572,32 @@ class InferenceEngine:
 
     def _dispatch(self, reqs: List[_Request]):
         """Pad a group up to its bucket and launch the (async) jitted
-        forward. A failure here resolves ONLY this group's futures."""
+        forward. A failure here resolves ONLY this group's futures; with
+        breakers armed, an OPEN bucket sheds its group instantly with
+        `ServingUnavailableError` — no forward is paid."""
         n = len(reqs)
         bucket = self._bucket_for(n)
         sig = reqs[0].signature()
+        br = self._breaker_for(sig, bucket)
+        if br is not None and not br.allow():
+            with self._slock:  # count before resolving (stats consistency)
+                self._n["shed"] += n
+            for r in reqs:
+                _resolve(r.future, exc=ServingUnavailableError(
+                    f"circuit open for batch domain {br.name}; request "
+                    "shed without a forward"))
+            return None
+        # a batch admitted while HALF_OPEN is THE probe; batches admitted
+        # while closed carry probe=False so an outcome arriving after a
+        # later trip (inflight pipelining) cannot masquerade as probe
+        # evidence — only the dispatcher thread dispatches, so the state
+        # read here is consistent with the allow() above
+        probe = br is not None and br.state == HALF_OPEN
         try:
             with self._span("serve dispatch", n=n, bucket=bucket):
+                # chaos site: no-op unless a FaultInjector is installed —
+                # plans target one bucket via the sig/bucket context
+                faults.fire("serve.forward", bucket=bucket, n=n, sig=sig)
                 cols = [np.stack(c) for c in
                         zip(*(r.features for r in reqs))]
                 if bucket > n:
@@ -525,6 +611,8 @@ class InferenceEngine:
             with self._slock:  # count before resolving (stats consistency)
                 self._n["failed"] += n
                 self._n["batches"] += 1
+            if br is not None:
+                br.record_failure(probe=probe)
             for r in reqs:
                 _resolve(r.future, exc=ServingError(
                     f"batch forward failed: {e!r}"))
@@ -537,22 +625,29 @@ class InferenceEngine:
             self._n["bucket_hits"] += int(hit)
             self._n["rows"] += bucket
             self._n["padded_rows"] += bucket - n
-        return reqs, y
+        return reqs, y, br, probe
 
     def _complete(self, batch):
         """Blocking device->host fetch of the OLDEST in-flight batch; newer
-        batches keep the device busy meanwhile."""
-        reqs, y = batch
+        batches keep the device busy meanwhile. The batch's breaker (if
+        armed) learns the final outcome here — a batch only counts as a
+        success once its results actually reached the host, and only a
+        half-open-admitted probe batch may close/re-trip the circuit."""
+        reqs, y, br, probe = batch
         try:
             with self._span("serve fetch", n=len(reqs)):
                 arr = np.asarray(y)
         except Exception as e:
             with self._slock:  # count before resolving (stats consistency)
                 self._n["failed"] += len(reqs)
+            if br is not None:
+                br.record_failure(probe=probe)
             for r in reqs:
                 _resolve(r.future, exc=ServingError(
                     f"batch fetch failed: {e!r}"))
             return
+        if br is not None:
+            br.record_success(probe=probe)
         now = time.perf_counter()
         with self._slock:
             self._n["completed"] += len(reqs)
@@ -582,3 +677,28 @@ class InferenceEngine:
         out.update(self.latency.snapshot("latency_ms", scale=1e3))
         out.update(self.batch_sizes.snapshot("batch_size", digits=1))
         return out
+
+    def health(self) -> Dict:
+        """Liveness/readiness surface (the load-balancer probe):
+
+        - `status`: "ok" (serving, all circuits closed), "degraded" (at
+          least one batch domain's breaker is open/half-open — OTHER
+          domains still serve), or "closed" (engine shut down).
+        - `open_buckets`: the degraded batch-domain labels.
+        - `breakers`: per-domain `CircuitBreaker.snapshot()` dicts
+          (state, consecutive failures, times opened, shed count).
+        - `queue_depth` / `queue_capacity`: admission headroom.
+        """
+        with self._lock:
+            depth = len(self._q)
+            closing = self._closing
+        with self._slock:
+            breakers = dict(self._breakers)
+        snaps = {br.name: br.snapshot() for br in breakers.values()}
+        open_buckets = sorted(name for name, s in snaps.items()
+                              if s["state"] != CLOSED)
+        status = "closed" if closing else \
+            ("degraded" if open_buckets else "ok")
+        return {"status": status, "open_buckets": open_buckets,
+                "breakers": snaps, "queue_depth": depth,
+                "queue_capacity": self.queue_capacity}

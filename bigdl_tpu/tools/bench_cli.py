@@ -629,6 +629,89 @@ def bench_serving_ab(clients: int = 8, segments: int = 20,
     return out
 
 
+def bench_chaos(crash_at: int = 8, iters: int = 16, ckpt_every: int = 4,
+                batch_size: int = 64, n_samples: int = 1024,
+                keep_last_n: int = 3):
+    """Chaos drill: measure MTTR (mean time to recovery) of the training
+    retry loop under a deterministic injected fault plan.
+
+    Runs an MNIST-shaped MLP through the REAL `DistriOptimizer` loop with
+    durable checkpointing every `ckpt_every` iterations, installs a
+    `FaultInjector` that crashes `train.step` at iteration `crash_at`
+    (transient class), and lets the resilience machinery recover: the
+    retry policy backs off with jitter, reloads the newest VALID
+    checkpoint, and resumes. MTTR is read from the telemetry stream
+    itself — the wall-clock gap between the `fault_injected` event and
+    the first post-fault `step` record — so the figure measures exactly
+    what an operator's dashboard would show. Prints ONE json line:
+    MTTR, retry count, lost iterations (re-trained since the reload
+    point), and the final step count as the recovery proof."""
+    import shutil
+    import tempfile
+
+    import bigdl_tpu.nn as nn_
+    import bigdl_tpu.optim as optim
+    from bigdl_tpu.observability import InMemorySink, Telemetry
+    from bigdl_tpu.optim.optimizer import Optimizer
+    from bigdl_tpu.optim.trigger import max_iteration, several_iteration
+    from bigdl_tpu.resilience import FaultInjector, FaultSpec, RetryPolicy
+
+    rs = np.random.RandomState(0)
+    X = rs.rand(n_samples, 28, 28).astype(np.float32)
+    Y = (rs.randint(0, 10, n_samples) + 1).astype(np.int32)
+    model = (nn_.Sequential().add(nn_.Reshape([784]))
+             .add(nn_.Linear(784, 128)).add(nn_.Tanh())
+             .add(nn_.Linear(128, 10)).add(nn_.LogSoftMax()))
+    sink = InMemorySink()
+    telemetry = Telemetry(sink, resources=False)
+    ckpt_dir = tempfile.mkdtemp(prefix="bigdl_tpu_chaos_")
+    opt = Optimizer(model, (X, Y), nn_.ClassNLLCriterion(),
+                    batch_size=batch_size, local=False,
+                    retry_policy=RetryPolicy(max_retries=3,
+                                             base_delay_s=0.05,
+                                             seed=0, name="chaos"))
+    opt.set_optim_method(optim.SGD(learning_rate=0.05, momentum=0.9))
+    opt.set_end_when(max_iteration(iters))
+    opt.set_checkpoint(ckpt_dir, several_iteration(ckpt_every),
+                       keep_last_n=keep_last_n)
+    opt.set_telemetry(telemetry)
+    plan = FaultInjector(FaultSpec("train.step", at_hit=crash_at),
+                         telemetry=telemetry)
+    try:
+        with plan:
+            opt.optimize()
+    finally:
+        shutil.rmtree(ckpt_dir, ignore_errors=True)
+
+    t_fault = next((r["time"] for r in sink.records
+                    if r.get("event") == "fault_injected"), None)
+    post = [r for r in sink.records
+            if r.get("type") == "step" and t_fault is not None
+            and r["time"] > t_fault]
+    retries = [r for r in sink.records if r.get("event") == "retry"]
+    final_step = int(opt.optim_method.state.get("neval", 0))
+    # recovery = the loop trained a step again after the fault; "lost
+    # work" = iterations re-trained because the reload point trails the
+    # crash point
+    recovered = bool(post) and final_step >= iters
+    out = {
+        "metric": "chaos_mttr",
+        "fault_site": "train.step",
+        "crash_at_iteration": crash_at,
+        "recovered": recovered,
+        "mttr_s": round(post[0]["time"] - t_fault, 4) if post else None,
+        "retries": len(retries),
+        "backoff_s": round(sum(r.get("delay_s", 0.0) for r in retries), 4),
+        "lost_iterations": (crash_at - 1) - min(
+            (int(r["step"]) for r in post), default=crash_at) + 1
+        if post else None,
+        "final_step": final_step,
+        "checkpoint_every": ckpt_every,
+    }
+    print(json.dumps(out), flush=True)
+    return out
+
+
 def bench_baseline_configs():
     """One stderr line per remaining BASELINE.md config (the headline
     already covers ResNet-50): LeNet-5, Inception-v1, PTB LSTM, and
@@ -980,6 +1063,8 @@ def main():
     input_cost_ms = None
     serve = False
     serve_clients = 8
+    chaos = False
+    chaos_crash_at = 8
     it = iter(sys.argv[1:])
     for a in it:
         if a == "--telemetry":
@@ -999,8 +1084,22 @@ def main():
         elif a == "--serve-clients":
             serve = True
             serve_clients = int(next(it, "8"))
+        elif a == "--chaos":
+            chaos = True
+        elif a.startswith("--chaos-crash-at="):
+            chaos = True
+            chaos_crash_at = int(a.split("=", 1)[1])
         else:
             argv.append(a)
+    if chaos:
+        # chaos drill: deterministic injected fault -> retry/reload ->
+        # MTTR from the telemetry stream; measurable off-TPU; one json
+        # line on stdout, see docs/resilience.md
+        logging.getLogger("bigdl_tpu.optim").setLevel(logging.ERROR)
+        logging.getLogger("bigdl_tpu.resilience").setLevel(logging.ERROR)
+        _configure_compile_cache()
+        bench_chaos(crash_at=chaos_crash_at)
+        return
     if serve:
         # serving A/B (closed-loop concurrent clients, serial batch-1 vs
         # micro-batching engine) — measurable off-TPU; one json line on

@@ -17,6 +17,16 @@ in-process fake the tests run against, standing in for a remote store.
 
 Helpers mirror the subset of `os`/`open` the framework uses, each taking
 a path-or-URI.
+
+Resilience: every REMOTE operation (scheme-qualified paths other than
+file://) runs under a `bigdl_tpu.resilience.RetryPolicy` — exponential
+backoff + full jitter over transient failures, no retry of permanent
+ones — because s3/gs/hdfs calls fail transiently as a matter of course
+and a single blip must not kill a training run mid-checkpoint. Local
+paths bypass the wrapper entirely (the hot path costs nothing new).
+Swap the policy with `set_io_retry_policy` (tests use a no-sleep seeded
+policy); each attempt passes the `fs.remote_io` fault-injection site, so
+chaos tests can make any remote call flake deterministically.
 """
 
 from __future__ import annotations
@@ -24,6 +34,45 @@ from __future__ import annotations
 import os
 import posixpath
 from typing import List, Optional, Tuple
+
+from bigdl_tpu.resilience import faults
+
+_IO_RETRY = None  # lazily-built default RetryPolicy (see io_retry_policy)
+
+
+def io_retry_policy():
+    """The RetryPolicy guarding remote operations (3 retries, 0.2s base
+    full-jitter backoff, 5s cap). Classified permanent beyond the
+    defaults: ImportError (a missing fsspec backend driver — retrying
+    cannot install it) and FileNotFoundError (a missing object is a
+    definitive answer, and checkpoint scans probe for absent manifests
+    as a matter of course — burning three backoff sleeps per miss would
+    tax every resume scan)."""
+    global _IO_RETRY
+    if _IO_RETRY is None:
+        from bigdl_tpu.resilience.retry import (DEFAULT_PERMANENT,
+                                                RetryPolicy)
+        _IO_RETRY = RetryPolicy(
+            max_retries=3, base_delay_s=0.2, max_delay_s=5.0,
+            permanent=DEFAULT_PERMANENT + (ImportError,
+                                           FileNotFoundError),
+            name="fs.remote_io")
+    return _IO_RETRY
+
+
+def set_io_retry_policy(policy) -> None:
+    """Replace the remote-IO RetryPolicy (None restores the default)."""
+    global _IO_RETRY
+    _IO_RETRY = policy
+
+
+def _remote(op: str, path, fn):
+    """Run one remote call under the IO retry policy, passing the
+    `fs.remote_io` fault site on every attempt."""
+    def attempt():
+        faults.fire("fs.remote_io", op=op, path=str(path))
+        return fn()
+    return io_retry_policy().call(attempt)
 
 
 def is_uri(path: str) -> bool:
@@ -70,21 +119,21 @@ def open_file(path: str, mode: str = "rb"):
     if scheme is None:
         return open(local, mode)
     import fsspec
-    return fsspec.open(path, mode).open()
+    return _remote("open", path, lambda: fsspec.open(path, mode).open())
 
 
 def exists(path: str) -> bool:
     scheme, local = _split(path)
     if scheme is None:
         return os.path.exists(local)
-    return _fs(scheme).exists(path)
+    return _remote("exists", path, lambda: _fs(scheme).exists(path))
 
 
 def isdir(path: str) -> bool:
     scheme, local = _split(path)
     if scheme is None:
         return os.path.isdir(local)
-    return _fs(scheme).isdir(path)
+    return _remote("isdir", path, lambda: _fs(scheme).isdir(path))
 
 
 def makedirs(path: str, exist_ok: bool = True) -> None:
@@ -92,7 +141,8 @@ def makedirs(path: str, exist_ok: bool = True) -> None:
     if scheme is None:
         os.makedirs(local, exist_ok=exist_ok)
     else:
-        _fs(scheme).makedirs(path, exist_ok=exist_ok)
+        _remote("makedirs", path,
+                lambda: _fs(scheme).makedirs(path, exist_ok=exist_ok))
 
 
 def listdir(path: str) -> List[str]:
@@ -101,7 +151,8 @@ def listdir(path: str) -> List[str]:
     if scheme is None:
         return os.listdir(local)
     return [posixpath.basename(p.rstrip("/"))
-            for p in _fs(scheme).ls(path, detail=False)]
+            for p in _remote("listdir", path,
+                             lambda: _fs(scheme).ls(path, detail=False))]
 
 
 def remove(path: str) -> None:
@@ -109,7 +160,53 @@ def remove(path: str) -> None:
     if scheme is None:
         os.remove(local)
     else:
-        _fs(scheme).rm(path)
+        _remote("remove", path, lambda: _fs(scheme).rm(path))
+
+
+def rename(src: str, dst: str) -> None:
+    """Rename/move a file or directory tree. Locally this is os.rename —
+    atomic within a filesystem, which is what makes the checkpoint
+    commit-by-rename durable. Remote object stores have no rename at
+    all, and fsspec's recursive mv (copy+delete) cannot be blind-retried:
+    a second attempt over a half-moved tree hits FileNotFoundError on
+    the already-deleted entries, and a mid-copy failure leaves a visible
+    partial destination. So remote moves are decomposed into per-file
+    copies — each idempotent and individually retried, with
+    manifest.json ordered LAST so a torn checkpoint publish has no
+    manifest and stays invisible to resume scans — followed by a source
+    delete that treats FileNotFoundError as already-done."""
+    scheme, local_src = _split(src)
+    _, local_dst = _split(dst)
+    if scheme is None:
+        os.rename(local_src, local_dst)
+        return
+    fs = _fs(scheme)
+    sp_src = fs._strip_protocol(str(src)).rstrip("/")
+    sp_dst = fs._strip_protocol(str(dst)).rstrip("/")
+    if _remote("isdir", src, lambda: fs.isdir(sp_src)):
+        names = _remote("find", src, lambda: fs.find(sp_src))
+        for f in sorted(names, key=lambda p: (
+                posixpath.basename(p) == "manifest.json", p)):
+            rel = f[len(sp_src):].lstrip("/")
+            target = posixpath.join(sp_dst, rel) if rel else sp_dst
+            _remote("copy", f, lambda f=f, t=target: fs.copy(f, t))
+    else:
+        _remote("copy", src, lambda: fs.copy(sp_src, sp_dst))
+    try:
+        _remote("rm", src, lambda: fs.rm(sp_src, recursive=True))
+    except FileNotFoundError:
+        pass  # delete half already completed on a prior attempt
+
+
+def rmtree(path: str) -> None:
+    """Remove a directory tree (file trees on remote stores)."""
+    scheme, local = _split(path)
+    if scheme is None:
+        import shutil
+        shutil.rmtree(local)
+    else:
+        _remote("rmtree", path,
+                lambda: _fs(scheme).rm(path, recursive=True))
 
 
 def glob(pattern: str) -> List[str]:
@@ -139,4 +236,5 @@ def glob(pattern: str) -> List[str]:
         prefix = f"{scheme}:///"
     else:
         prefix = f"{scheme}://"
-    return sorted(prefix + p.lstrip("/") for p in fs.glob(pattern))
+    matches = _remote("glob", pattern, lambda: fs.glob(pattern))
+    return sorted(prefix + p.lstrip("/") for p in matches)

@@ -82,6 +82,7 @@ def main(out_path=None):
     import bigdl_tpu.ops as ops
     import bigdl_tpu.optim as optim
     import bigdl_tpu.parallel as parallel
+    import bigdl_tpu.resilience as resilience
 
     out_path = out_path or os.path.join(
         os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
@@ -105,6 +106,8 @@ def main(out_path=None):
               _rows(optim, _public(optim)))
         _emit(f, "bigdl_tpu.parallel — mesh, sharding, pp/ep/sp",
               _rows(parallel, _public(parallel)))
+        _emit(f, "bigdl_tpu.resilience — fault injection, retry, breaker",
+              _rows(resilience, _public(resilience)))
     return out_path
 
 

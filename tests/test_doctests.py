@@ -16,12 +16,13 @@ import bigdl_tpu.nn
 import bigdl_tpu.ops
 import bigdl_tpu.optim
 import bigdl_tpu.parallel
+import bigdl_tpu.resilience
 import bigdl_tpu.serving
 import bigdl_tpu.tensor
 
 _PACKAGES = (bigdl_tpu.nn, bigdl_tpu.keras, bigdl_tpu.ops,
              bigdl_tpu.parallel, bigdl_tpu.optim, bigdl_tpu.tensor,
-             bigdl_tpu.dataset, bigdl_tpu.serving)
+             bigdl_tpu.dataset, bigdl_tpu.serving, bigdl_tpu.resilience)
 
 
 def _modules_with_doctests():
