@@ -1,20 +1,31 @@
-"""bigdl_tpu.observability — spans, run telemetry, and train-loop health.
+"""bigdl_tpu.observability — spans, telemetry, health, and attribution.
 
 The reference framework's observability is the `Metrics` phase table
 (DL/optim/Metrics.scala:36-103) plus TensorBoard scalars; on a compiled
 runtime that is not enough — XLA hides per-op boundaries, so a training run
 needs first-class host-side instrumentation to leave a machine-readable
-record. Three layers, each usable alone:
+record. Six layers, each usable alone:
 
 - `spans` — nested host-side trace spans with `jax.profiler.TraceAnnotation`
   integration, exportable as Chrome/Perfetto trace JSON so host phases line
   up with the XLA device trace.
 - `telemetry` — structured per-step run metrics (loss, lr, throughput,
   step time, optional grad/param norms, host RSS, device memory) fanned out
-  to pluggable sinks (JSONL file, in-memory, TrainSummary bridge).
+  to pluggable sinks (JSONL file, in-memory, TrainSummary bridge), with a
+  declared per-record-type field contract (`RECORD_SCHEMAS`).
 - `health` — train-loop guards: NaN/Inf loss+gradient guard (warn /
   skip-step / raise), slow-step straggler detection, and throughput-
   regression warnings.
+- `costs` + `compilation` — performance attribution: per-executable FLOPs /
+  bytes-accessed from XLA's cost model (jaxpr-walk fallback), a peak-FLOPs
+  chip registry feeding per-step MFU, and a lowering/compile wrapper that
+  emits `compile` records (recompile storms become visible in the stream).
+- `flight` — the always-on crash flight recorder: a bounded ring of recent
+  records + spans, auto-dumped to disk on `run_abort` / `fault_injected` /
+  NaN-guard raise.
+- `export` — `PrometheusTextSink` + stdlib `MetricsServer`: the scrapeable
+  `/metrics` surface for step gauges, serving counters/quantiles, and
+  per-bucket circuit-breaker state.
 
 Both `LocalOptimizer` and `DistriOptimizer` accept these via
 `set_tracer` / `set_telemetry` / `set_health_monitors`.
@@ -22,19 +33,32 @@ Both `LocalOptimizer` and `DistriOptimizer` accept these via
 
 from bigdl_tpu.observability.spans import SpanTracer
 from bigdl_tpu.observability.telemetry import (CompositeSink, InMemorySink,
-                                               JsonlSink, SummarySink,
-                                               Telemetry, TelemetrySink,
+                                               JsonlSink, RECORD_SCHEMAS,
+                                               SummarySink, Telemetry,
+                                               TelemetrySink,
                                                device_memory_stats,
-                                               host_rss_mb)
+                                               host_rss_mb,
+                                               sanitize_nonfinite,
+                                               validate_record)
 from bigdl_tpu.observability.health import (HealthMonitor, NanGuard,
                                             StragglerDetector,
                                             ThroughputMonitor,
                                             TrainingHealthError)
+from bigdl_tpu.observability.costs import (PEAK_BF16_FLOPS, jaxpr_flops,
+                                           executable_costs, mfu,
+                                           peak_flops)
+from bigdl_tpu.observability.compilation import CompiledFunction
+from bigdl_tpu.observability.flight import FlightRecorder
+from bigdl_tpu.observability.export import MetricsServer, PrometheusTextSink
 
 __all__ = [
     "SpanTracer",
     "Telemetry", "TelemetrySink", "JsonlSink", "InMemorySink",
     "SummarySink", "CompositeSink", "host_rss_mb", "device_memory_stats",
+    "RECORD_SCHEMAS", "validate_record", "sanitize_nonfinite",
     "HealthMonitor", "NanGuard", "StragglerDetector", "ThroughputMonitor",
     "TrainingHealthError",
+    "PEAK_BF16_FLOPS", "peak_flops", "executable_costs", "jaxpr_flops",
+    "mfu", "CompiledFunction", "FlightRecorder",
+    "PrometheusTextSink", "MetricsServer",
 ]

@@ -1,0 +1,196 @@
+"""Cost accounting: per-executable FLOPs, bytes accessed, and MFU.
+
+The bench driver computed MFU offline (bench_cli lowers the step a second
+time and divides by a hand-kept peak table); the telemetry stream itself
+had no notion of FLOPs, so nobody could read model efficiency off a run's
+records. This module makes cost a first-class telemetry input:
+
+- `executable_costs(compiled)` reads XLA's own cost model off a
+  `jax.stages.Compiled` (`flops`, `bytes accessed`) — authoritative where
+  the backend reports it (CPU and TPU both do today).
+- `jaxpr_flops(jaxpr)` is the fallback estimator for backends whose PJRT
+  plugin reports nothing: a jaxpr walk counting matmul/conv FLOPs exactly
+  and elementwise ops as one FLOP per output element, recursing through
+  pjit/scan/while sub-jaxprs (scan bodies scale by trip count).
+- `PEAK_BF16_FLOPS` / `peak_flops(device_kind)` is the small peak-FLOPs
+  chip registry (dense bf16 per chip). Unknown kinds — CPU included —
+  return None, and every derived MFU is then None (null in JSONL), never
+  a made-up number.
+- `mfu(flops, step_time_s, ...)` folds the three together:
+  achieved FLOP/s over the mesh peak.
+
+Example:
+    >>> from bigdl_tpu.observability.costs import peak_flops, mfu
+    >>> peak_flops("TPU v5e")
+    197000000000000.0
+    >>> peak_flops("cpu") is None
+    True
+    >>> mfu(197e12, step_time_s=2.0, device_kind="TPU v5e")
+    0.5
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Dict, Optional
+
+#: Dense bf16 peak FLOP/s per chip, matched by case-insensitive substring
+#: of the jax `device_kind` (first match wins; ordered most-specific
+#: first). The registry is deliberately small and explicit — an unknown
+#: chip yields None, which downstream reports as a null MFU rather than
+#: a wrong one.
+PEAK_BF16_FLOPS = (
+    ("v6", 918e12), ("trillium", 918e12),
+    ("v5p", 459e12), ("v5 lite", 197e12), ("v5e", 197e12), ("v5", 459e12),
+    ("v4", 275e12), ("v3", 123e12), ("v2", 45e12),
+)
+
+
+def peak_flops(device_kind) -> Optional[float]:
+    """Peak dense bf16 FLOP/s for a chip, from the registry; None for
+    unknown kinds (CPU, new chips not yet registered). Accepts a kind
+    string or a jax device object."""
+    kind = (device_kind if isinstance(device_kind, str)
+            else getattr(device_kind, "device_kind", "")).lower()
+    for key, peak in PEAK_BF16_FLOPS:
+        if key in kind:
+            return peak
+    return None
+
+
+def default_device_kind() -> str:
+    """The local backend's device kind (`jax.devices()[0].device_kind`),
+    cached after the first call — the registry lookup runs per sync point."""
+    global _DEVICE_KIND
+    if _DEVICE_KIND is None:
+        try:
+            import jax
+            _DEVICE_KIND = getattr(jax.devices()[0], "device_kind", "")
+        except Exception:
+            _DEVICE_KIND = ""
+    return _DEVICE_KIND
+
+
+_DEVICE_KIND: Optional[str] = None
+
+
+def executable_costs(compiled) -> Dict[str, Optional[float]]:
+    """`{"flops": ..., "bytes_accessed": ...}` from a
+    `jax.stages.Compiled`'s `cost_analysis()` (list- and dict-shaped
+    returns both handled). Missing/empty analysis — some PJRT plugins
+    return None — yields None values; callers fall back to
+    `jaxpr_flops`."""
+    out: Dict[str, Optional[float]] = {"flops": None, "bytes_accessed": None}
+    try:
+        cost = compiled.cost_analysis()
+    except Exception:
+        return out
+    if isinstance(cost, (list, tuple)):
+        cost = cost[0] if cost else None
+    if not isinstance(cost, dict):
+        return out
+    flops = cost.get("flops")
+    if flops is not None and math.isfinite(flops) and flops > 0:
+        out["flops"] = float(flops)
+    nbytes = cost.get("bytes accessed")
+    if nbytes is not None and math.isfinite(nbytes) and nbytes > 0:
+        out["bytes_accessed"] = float(nbytes)
+    return out
+
+
+def _prod(xs) -> float:
+    p = 1.0
+    for x in xs:
+        p *= x
+    return p
+
+
+def _dot_general_flops(eqn) -> float:
+    """2*B*M*N*K for a dot_general: batch dims B, contracting dims K,
+    remaining lhs dims M, remaining rhs dims N."""
+    lhs, rhs = (v.aval.shape for v in eqn.invars[:2])
+    (lc, rc), (lb, rb) = eqn.params["dimension_numbers"]
+    k = _prod(lhs[d] for d in lc)
+    b = _prod(lhs[d] for d in lb)
+    m = _prod(s for d, s in enumerate(lhs) if d not in set(lc) | set(lb))
+    n = _prod(s for d, s in enumerate(rhs) if d not in set(rc) | set(rb))
+    return 2.0 * b * m * n * k
+
+
+def _conv_flops(eqn) -> float:
+    """2 * output elements * kernel spatial size * in-channels /
+    feature_group_count for conv_general_dilated."""
+    rhs = eqn.invars[1].aval.shape
+    out = eqn.outvars[0].aval.shape
+    dn = eqn.params["dimension_numbers"]
+    groups = eqn.params.get("feature_group_count", 1) or 1
+    k_spatial = _prod(rhs[d] for d in dn.rhs_spec[2:])
+    in_ch = rhs[dn.rhs_spec[1]]
+    return 2.0 * _prod(out) * k_spatial * in_ch / groups
+
+
+def jaxpr_flops(jaxpr) -> float:
+    """Estimated FLOPs of a (closed) jaxpr: exact matmul/conv counts plus
+    one FLOP per output element for everything else, recursing through
+    call/pjit/custom-derivative sub-jaxprs and scaling scan bodies by
+    their trip count. A floor estimate — used only when the backend's
+    own cost model reports nothing."""
+    inner = getattr(jaxpr, "jaxpr", jaxpr)
+    total = 0.0
+    for eqn in inner.eqns:
+        name = eqn.primitive.name
+        try:
+            if name == "dot_general":
+                total += _dot_general_flops(eqn)
+                continue
+            if name == "conv_general_dilated":
+                total += _conv_flops(eqn)
+                continue
+        except Exception:
+            pass  # malformed params: fall through to the generic count
+        sub = None
+        for key in ("jaxpr", "call_jaxpr", "fun_jaxpr"):
+            if key in eqn.params:
+                sub = eqn.params[key]
+                break
+        if sub is not None:
+            body = jaxpr_flops(sub)
+            if name == "scan":
+                body *= eqn.params.get("length", 1) or 1
+            total += body
+            continue
+        if name == "while":
+            # trip count is data-dependent: count one body iteration
+            total += jaxpr_flops(eqn.params["body_jaxpr"])
+            continue
+        for out in eqn.outvars:
+            shape = getattr(getattr(out, "aval", None), "shape", None)
+            if shape is not None:
+                total += _prod(shape)
+    return total
+
+
+def jaxpr_eqn_count(jaxpr) -> int:
+    """Number of top-level equations in a (closed) jaxpr — the compile
+    record's coarse "how big is this program" figure."""
+    return len(getattr(jaxpr, "jaxpr", jaxpr).eqns)
+
+
+def mfu(flops: Optional[float], step_time_s: Optional[float],
+        device_kind: Optional[str] = None,
+        n_devices: int = 1) -> Optional[float]:
+    """Model FLOPs utilization: `flops / step_time_s` (achieved FLOP/s of
+    the whole program — for an SPMD step that is already the global-batch
+    count) over `n_devices * peak_flops(device_kind)`. None whenever any
+    input is missing/non-finite or the chip is not in the registry —
+    an unknown chip yields a null MFU, never a fabricated one."""
+    if flops is None or step_time_s is None:
+        return None
+    if not (math.isfinite(flops) and math.isfinite(step_time_s)) \
+            or flops <= 0 or step_time_s <= 0:
+        return None
+    peak = peak_flops(device_kind if device_kind is not None
+                      else default_device_kind())
+    if not peak:
+        return None
+    return flops / step_time_s / (peak * max(1, int(n_devices)))

@@ -1,0 +1,327 @@
+"""Metrics export: Prometheus text exposition over the telemetry stream.
+
+The serving-fleet roadmap item needs SLOs an external monitor can actually
+scrape; JSONL files and in-memory sinks are run artifacts, not a metrics
+surface. `PrometheusTextSink` is a `TelemetrySink` that folds the stream
+into current values — step gauges from the newest `step` record, serving
+counters/quantiles from the newest `serving_stats`/`serving_summary`, and
+per-bucket circuit-breaker states read live from `engine.health()` — and
+renders them in the Prometheus text exposition format (version 0.0.4:
+`# HELP` / `# TYPE` headers plus samples). `MetricsServer` exposes that
+render at `GET /metrics` on a stdlib `http.server` — no new dependencies,
+one non-daemon thread, `close()` joins it (the same thread-hygiene
+contract the serving dispatcher and prefetch workers are held to by the
+suite's leak fixture).
+
+    sink = PrometheusTextSink()
+    opt.set_telemetry(Telemetry(sink))
+    server = MetricsServer(sink, port=9100)   # or port=0 -> ephemeral
+    ...
+    server.close()
+"""
+
+from __future__ import annotations
+
+import logging
+import math
+import threading
+import weakref
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, List, Optional
+
+from bigdl_tpu.observability.telemetry import TelemetrySink
+
+logger = logging.getLogger("bigdl_tpu.observability")
+
+#: serving stats() counter fields exported as Prometheus counters.
+_SERVING_COUNTERS = ("submitted", "completed", "failed", "timed_out",
+                     "rejected", "cancelled", "shed", "batches",
+                     "bucket_hits", "rows", "padded_rows")
+#: serving stats() instantaneous fields exported as gauges.
+_SERVING_GAUGES = ("queue_depth", "bucket_hit_rate", "pad_fraction",
+                   "flops_per_step", "bytes_accessed", "mfu")
+#: histogram prefixes exported as Prometheus summaries (quantile labels).
+_SERVING_SUMMARIES = ("queue_wait_ms", "latency_ms", "batch_size")
+
+_BREAKER_STATE_VALUE = {"closed": 0, "half_open": 1, "open": 2}
+
+
+def _fmt(value) -> str:
+    if isinstance(value, bool):
+        return "1" if value else "0"
+    if isinstance(value, float):
+        if math.isnan(value):
+            return "NaN"
+        if math.isinf(value):
+            return "+Inf" if value > 0 else "-Inf"
+        return repr(value)
+    return str(value)
+
+
+def _escape_label(value: str) -> str:
+    return str(value).replace("\\", r"\\").replace("\n", r"\n") \
+        .replace('"', r'\"')
+
+
+class PrometheusTextSink(TelemetrySink):
+    """Fold telemetry records into scrapable current values.
+
+    Attach to a `Telemetry` like any sink; `render()` returns the full
+    exposition document. Serving engines registered via `track_engine`
+    contribute live per-bucket breaker-state gauges (from
+    `engine.health()`) at render time — breaker transitions are events,
+    but a scrape wants *state*. Engines are held weakly: a closed,
+    dropped engine disappears from the exposition instead of pinning
+    itself in memory."""
+
+    def __init__(self, namespace: str = "bigdl_tpu"):
+        self.namespace = namespace
+        self._lock = threading.Lock()
+        self._step: Dict = {}
+        self._serving: Dict = {}
+        self._counts: Dict[str, int] = {}  # records seen by type
+        self._engines: List = []  # (label, weakref) pairs
+
+    # ------------------------------------------------------------ ingest
+    def emit(self, record: Dict):
+        rtype = record.get("type")
+        with self._lock:
+            self._counts[rtype] = self._counts.get(rtype, 0) + 1
+            if rtype == "step":
+                self._step = dict(record)
+            elif rtype in ("serving_stats", "serving_summary"):
+                self._serving = dict(record)
+
+    def track_engine(self, engine,
+                     name: Optional[str] = None) -> "PrometheusTextSink":
+        """Include `engine.health()`'s breaker/queue state in every
+        render (weakly referenced). `name` becomes the `engine` label on
+        its samples — defaulting to `engine<N>` so two tracked engines
+        sharing a bucket shape never emit duplicate label sets (which a
+        Prometheus scraper rejects wholesale)."""
+        with self._lock:
+            if name is None:
+                name = f"engine{len(self._engines)}"
+            self._engines.append((name, weakref.ref(engine)))
+        return self
+
+    # ------------------------------------------------------------ render
+    def _sample(self, lines, name, mtype, help_, samples):
+        """Append one metric family: HELP/TYPE headers + (labels, value)
+        samples; families with no finite samples are skipped entirely."""
+        rows = []
+        for labels, value in samples:
+            if value is None or (isinstance(value, float)
+                                 and not math.isfinite(value)):
+                continue
+            rows.append((labels, value))
+        if not rows:
+            return
+        full = f"{self.namespace}_{name}"
+        lines.append(f"# HELP {full} {help_}")
+        lines.append(f"# TYPE {full} {mtype}")
+        for labels, value in rows:
+            label_s = ""
+            if labels:
+                inner = ",".join(f'{k}="{_escape_label(v)}"'
+                                 for k, v in labels.items())
+                label_s = "{" + inner + "}"
+            lines.append(f"{full}{label_s} {_fmt(value)}")
+
+    def render(self) -> str:
+        """The Prometheus text exposition document (text/plain;
+        version=0.0.4). Always ends with a newline."""
+        with self._lock:
+            step = dict(self._step)
+            serving = dict(self._serving)
+            counts = dict(self._counts)
+            engines = list(self._engines)
+        lines: List[str] = []
+        self._sample(lines, "telemetry_records_total", "counter",
+                     "Telemetry records ingested by this exporter.",
+                     [({"record_type": t}, n)
+                      for t, n in sorted(counts.items()) if t])
+        # --- step gauges: numeric fields of the newest step record
+        for field, help_ in (
+                ("step", "Latest training iteration number."),
+                ("epoch", "Current training epoch (1-based)."),
+                ("loss", "Latest synced training loss."),
+                ("lr", "Current learning rate."),
+                ("throughput", "Training records/sec over the last sync "
+                               "window."),
+                ("step_time_s", "Per-iteration wall time over the last "
+                                "sync window (seconds)."),
+                ("flops_per_step", "Model FLOPs per training step (XLA "
+                                   "cost model)."),
+                ("bytes_accessed", "Bytes accessed per training step (XLA "
+                                   "cost model)."),
+                ("mfu", "Model FLOPs utilization of the training step "
+                        "against registry peak."),
+                ("grad_norm", "Global gradient L2 norm."),
+                ("param_norm", "Global parameter L2 norm."),
+                ("host_rss_mb", "Driver process resident set size (MB)."),
+                ("prefetch_queue_depth", "Ready batches in the input "
+                                         "pipeline buffer."),
+        ):
+            val = step.get(field)
+            if isinstance(val, bool) or not isinstance(val, (int, float)):
+                continue
+            self._sample(lines, f"step_{field}", "gauge", help_,
+                         [(None, val)])
+        # --- serving counters / gauges / summaries
+        for field in _SERVING_COUNTERS:
+            val = serving.get(field)
+            if isinstance(val, (int, float)) and not isinstance(val, bool):
+                self._sample(lines, f"serving_{field}_total", "counter",
+                             f"Serving engine lifetime {field} count.",
+                             [(None, val)])
+        for field in _SERVING_GAUGES:
+            val = serving.get(field)
+            if isinstance(val, (int, float)) and not isinstance(val, bool):
+                self._sample(lines, f"serving_{field}", "gauge",
+                             f"Serving engine {field}.", [(None, val)])
+        for pre in _SERVING_SUMMARIES:
+            samples = []
+            for q in (50, 95, 99):
+                val = serving.get(f"{pre}_p{q}")
+                if isinstance(val, (int, float)):
+                    samples.append(({"quantile": f"0.{q}"}, val))
+            count = serving.get(f"{pre}_count")
+            if samples:
+                self._sample(lines, f"serving_{pre}", "summary",
+                             f"Serving {pre} over the recent window.",
+                             samples)
+                if isinstance(count, int):
+                    lines.append(
+                        f"{self.namespace}_serving_{pre}_count {count}")
+        # --- live breaker state per tracked engine
+        breaker_samples = []
+        health_samples = []
+        for ename, ref in engines:
+            eng = ref()
+            if eng is None:
+                continue
+            try:
+                health = eng.health()
+            except Exception:
+                logger.exception("engine.health() failed during render")
+                continue
+            health_samples.append(
+                ({"engine": ename,
+                  "status": health.get("status", "?")}, 1))
+            for bucket, snap in sorted(health.get("breakers", {}).items()):
+                state = snap.get("state")
+                breaker_samples.append(
+                    ({"bucket": bucket, "engine": ename},
+                     _BREAKER_STATE_VALUE.get(state)))
+        self._sample(lines, "serving_engine_up", "gauge",
+                     "Tracked serving engine liveness (label: status).",
+                     health_samples)
+        self._sample(lines, "serving_breaker_state", "gauge",
+                     "Per-bucket circuit breaker state "
+                     "(0=closed, 1=half_open, 2=open).", breaker_samples)
+        return "\n".join(lines) + "\n"
+
+
+# Servers still open at interpreter exit would hang shutdown on their
+# non-daemon serve thread; same backstop policy as the serving engine.
+_LIVE_SERVERS: "weakref.WeakSet" = weakref.WeakSet()
+
+
+def _close_live_servers():
+    for srv in list(_LIVE_SERVERS):
+        try:
+            srv.close()
+        except Exception:
+            pass
+
+
+try:
+    threading._register_atexit(_close_live_servers)
+except AttributeError:  # < 3.9: best effort only
+    import atexit
+    atexit.register(_close_live_servers)
+
+
+class MetricsServer:
+    """Serve a `PrometheusTextSink` at `GET /metrics` (stdlib only).
+
+    The serve loop runs on one NON-daemon thread — a leaked server is a
+    visible failure under the suite's thread-leak fixture, exactly like a
+    leaked dispatcher. Request-handler threads are daemonic and
+    short-lived. `close()` shuts the listener down and joins the serve
+    thread; idempotent; also usable as a context manager.
+
+    `port=0` binds an ephemeral port; read it back from `.port`.
+    """
+
+    def __init__(self, sink: PrometheusTextSink, host: str = "127.0.0.1",
+                 port: int = 0):
+        self.sink = sink
+        render = self._render  # late-bound via the server object
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_GET(self):  # noqa: N802 (stdlib naming)
+                if self.path.split("?", 1)[0] != "/metrics":
+                    self.send_error(404, "try /metrics")
+                    return
+                try:
+                    body = render().encode("utf-8")
+                except Exception:
+                    logger.exception("metrics render failed")
+                    self.send_error(500, "metrics render failed")
+                    return
+                self.send_response(200)
+                self.send_header("Content-Type",
+                                 "text/plain; version=0.0.4; "
+                                 "charset=utf-8")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, fmt, *args):
+                logger.debug("metrics server: " + fmt, *args)
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self._httpd.daemon_threads = True  # per-request threads only
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever,
+            name="bigdl-metrics-server", daemon=False)
+        self._closed = False
+        _LIVE_SERVERS.add(self)
+        self._thread.start()
+
+    def _render(self) -> str:
+        return self.sink.render()
+
+    @property
+    def port(self) -> int:
+        return self._httpd.server_address[1]
+
+    @property
+    def url(self) -> str:
+        host = self._httpd.server_address[0]
+        return f"http://{host}:{self.port}/metrics"
+
+    def close(self):
+        """Stop serving and join the serve thread. Idempotent."""
+        if self._closed:
+            return
+        self._closed = True
+        _LIVE_SERVERS.discard(self)
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._thread is not threading.current_thread():
+            self._thread.join()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    def __del__(self):  # backstop; callers close() explicitly
+        try:
+            self.close()
+        except Exception:
+            pass

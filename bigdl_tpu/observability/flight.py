@@ -1,0 +1,147 @@
+"""Crash flight recorder: a bounded ring of recent telemetry + spans.
+
+When a run dies — an injected fault, a NaN-guard abort, an unhandled
+exception — the JSONL stream (if one was even attached) holds the whole
+run, and the interesting part is the last few seconds. The
+`FlightRecorder` is the always-on cheap answer: every record passes
+through a fixed-size ring (`deque.append`, nothing else — no IO, no
+serialization in the happy path), and on a *trigger* record the ring is
+dumped to disk as one strict-JSON file: the crash context an operator
+reads first.
+
+Trigger records (see `DEFAULT_TRIGGERS`): `run_abort` (a loop died),
+`fault_injected` (a chaos plan fired — cause and the preceding steps land
+in one file), and a `nan_guard` event with `action="raise"` (the guard is
+about to abort the run). `dump(path)` also works on demand.
+
+Attach a `SpanTracer` (`attach_tracer`) and each dump carries the most
+recent span tail next to the records — both optimizers wire this up
+automatically when a tracer and a telemetry stream are both set.
+
+`Telemetry` creates one of these by default (`flight=` to replace or
+disable): crash forensics that cost one deque append per record.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import tempfile
+import threading
+import time
+from collections import deque
+from typing import Dict, List, Optional
+
+logger = logging.getLogger("bigdl_tpu.observability")
+
+#: (record type, event kind or None) pairs that auto-dump the ring.
+DEFAULT_TRIGGERS = ("run_abort", "fault_injected", "nan_guard_raise")
+
+
+def _default_dump_dir() -> str:
+    return os.environ.get("BIGDL_TPU_FLIGHT_DIR") or os.path.join(
+        tempfile.gettempdir(), "bigdl_tpu_flight")
+
+
+class FlightRecorder:
+    """Bounded ring of the last `capacity` telemetry records (+ span tail).
+
+    Usable standalone as a `TelemetrySink` (it only needs `emit`/`close`),
+    but normally lives on `Telemetry.flight`, fed before the real sinks so
+    a sink failure cannot starve the crash record.
+
+    Parameters
+    ----------
+    capacity : ring size in records.
+    dump_dir : where auto-dumps land (`flight_<pid>_<n>_<trigger>.json`).
+        Defaults to `$BIGDL_TPU_FLIGHT_DIR` or
+        `<tempdir>/bigdl_tpu_flight`.
+    span_tail : how many of the newest tracer spans each dump carries.
+    triggers : which events auto-dump (`DEFAULT_TRIGGERS`); pass `()` for
+        a record-only ring you dump manually.
+    """
+
+    def __init__(self, capacity: int = 512, dump_dir: Optional[str] = None,
+                 span_tail: int = 128, triggers=DEFAULT_TRIGGERS):
+        if capacity < 1:
+            raise ValueError(f"capacity must be >= 1, got {capacity}")
+        self.capacity = capacity
+        self.dump_dir = dump_dir or _default_dump_dir()
+        self.span_tail = span_tail
+        self.triggers = tuple(triggers)
+        self.tracer = None
+        self.last_dump_path: Optional[str] = None
+        self.dumps = 0
+        self._ring: deque = deque(maxlen=capacity)
+        self._lock = threading.Lock()
+
+    # ------------------------------------------------------------ recording
+    def attach_tracer(self, tracer) -> "FlightRecorder":
+        """Include `tracer`'s newest spans in every dump."""
+        self.tracer = tracer
+        return self
+
+    def _trigger_of(self, record: Dict) -> Optional[str]:
+        if record.get("type") != "event":
+            return None
+        kind = record.get("event")
+        if kind in ("run_abort", "fault_injected") and kind in self.triggers:
+            return kind
+        if kind == "nan_guard" and record.get("action") == "raise" \
+                and "nan_guard_raise" in self.triggers:
+            return "nan_guard_raise"
+        return None
+
+    def emit(self, record: Dict):
+        """Ring append; auto-dump when `record` is a trigger. Dump
+        failures are logged, never raised — the recorder must not take
+        down the run it is recording."""
+        with self._lock:
+            self._ring.append(record)
+        trigger = self._trigger_of(record)
+        if trigger is not None:
+            try:
+                self.dump(trigger=trigger)
+            except Exception:
+                logger.exception("flight-recorder auto-dump failed")
+
+    def records(self) -> List[Dict]:
+        """Snapshot of the ring, oldest first."""
+        with self._lock:
+            return list(self._ring)
+
+    def close(self):
+        pass  # nothing owned; sink-protocol compatibility
+
+    # ---------------------------------------------------------------- dump
+    def dump(self, path: Optional[str] = None,
+             trigger: str = "manual") -> str:
+        """Write the ring (and the span tail, when a tracer is attached)
+        to `path` — default: a fresh `flight_<pid>_<n>_<trigger>.json`
+        under `dump_dir` — as strict JSON (non-finite floats nulled with
+        `_nonfinite` markers, exactly like `JsonlSink`). Returns the
+        path."""
+        from bigdl_tpu.observability.telemetry import sanitize_nonfinite
+        with self._lock:
+            records = list(self._ring)
+            self.dumps += 1
+            n = self.dumps
+        doc = {"dumped_at": time.time(), "trigger": trigger,
+               "records": sanitize_nonfinite(records)}
+        if self.tracer is not None:
+            try:
+                doc["spans"] = sanitize_nonfinite(
+                    self.tracer.events[-self.span_tail:])
+            except Exception:
+                logger.exception("flight-recorder span capture failed")
+        if path is None:
+            os.makedirs(self.dump_dir, exist_ok=True)
+            path = os.path.join(
+                self.dump_dir, f"flight_{os.getpid()}_{n}_{trigger}.json")
+        with open(path, "w") as f:
+            json.dump(doc, f, allow_nan=False)
+        self.last_dump_path = path
+        logger.warning("flight recorder dumped %d records to %s "
+                       "(trigger: %s)", len(records), path, trigger)
+        return path

@@ -97,15 +97,22 @@ class StragglerDetector(HealthMonitor):
     instead of silently absorbed."""
 
     def __init__(self, factor: float = 3.0, window: int = 64,
-                 min_history: int = 8):
+                 min_history: int = 8, warmup: int = 1):
         self.factor = factor
         self.min_history = min_history
         self.history: deque = deque(maxlen=window)
         self.stragglers = 0
+        # the first sync window includes jit trace+compile time; seeding
+        # the rolling window with it made step 2 look 10-100x faster than
+        # p50 and every COLD run warn on its second record — skip it
+        self._warmup_left = max(0, int(warmup))
 
     def observe(self, record: Dict, telemetry=None):
         dt = record.get("step_time_s")
         if dt is None or not math.isfinite(dt):
+            return
+        if self._warmup_left > 0:
+            self._warmup_left -= 1
             return
         if len(self.history) >= self.min_history:
             p50 = statistics.median(self.history)
@@ -128,15 +135,22 @@ class ThroughputMonitor(HealthMonitor):
     throughput" failure mode made loud."""
 
     def __init__(self, tolerance: float = 0.3, window: int = 20,
-                 min_history: int = 5):
+                 min_history: int = 5, warmup: int = 1):
         self.tolerance = tolerance
         self.min_history = min_history
         self.history: deque = deque(maxlen=window)
         self.regressions = 0
+        # mirror StragglerDetector: the compile-laden first window's
+        # throughput is artificially LOW, which would drag the rolling
+        # median down and mask (or invert into) false regressions
+        self._warmup_left = max(0, int(warmup))
 
     def observe(self, record: Dict, telemetry=None):
         tp = record.get("throughput")
         if tp is None or not math.isfinite(tp):
+            return
+        if self._warmup_left > 0:
+            self._warmup_left -= 1
             return
         if len(self.history) >= self.min_history:
             med = statistics.median(self.history)

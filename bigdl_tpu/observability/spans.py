@@ -49,13 +49,14 @@ class SpanTracer:
         self._events: deque = deque(maxlen=max_events)
         self.dropped_events = 0
         self._lock = threading.Lock()
-        # perf_counter supplies monotonic durations; the wall base anchors
-        # them to absolute epoch time for cross-trace alignment
+        # monotonic offsets supply the durations (an NTP step mid-run can
+        # never produce a negative span); the wall base, sampled once,
+        # anchors them to absolute epoch time for cross-trace alignment
         self._wall0_us = time.time() * 1e6
-        self._perf0 = time.perf_counter()
+        self._mono0 = time.monotonic()
 
     def _now_us(self) -> float:
-        return self._wall0_us + (time.perf_counter() - self._perf0) * 1e6
+        return self._wall0_us + (time.monotonic() - self._mono0) * 1e6
 
     @contextlib.contextmanager
     def span(self, name: str, cat: str = "host", **args):

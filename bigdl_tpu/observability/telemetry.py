@@ -9,15 +9,25 @@ sink. Record types:
 - `run_start`  — one per `optimize()` call: run config (devices, model).
 - `step`       — one per sync point (= per iteration at sync_interval 1).
 - `event`      — health-monitor findings (nan_guard, straggler, ...).
+- `compile`    — one per distinct compiled signature (observability/
+                 compilation.py): lower/compile seconds, FLOPs, cache hit.
 - `run_end`    — final step count plus the `Metrics.as_dict()` phase table.
 
-Every record carries `time` (epoch seconds). The step schema is documented
+The serving engine adds `serving_stats`/`serving_summary` through the same
+sinks. Every record type's field contract is declared in `RECORD_SCHEMAS`
+(checked by `validate_record`, pinned by tests) and documented
 field-by-field in docs/observability.md.
+
+Every record carries `time` (epoch seconds — absolute, so streams overlay
+on Perfetto device traces). Durations inside records (`step_time_s`,
+`lower_s`, ...) are measured with monotonic clocks by their producers; an
+NTP step skews `time`, never a duration.
 """
 
 from __future__ import annotations
 
 import json
+import math
 import os
 import time
 from typing import Dict, List, Optional
@@ -54,6 +64,28 @@ def device_memory_stats() -> List[Dict]:
     return out
 
 
+def sanitize_nonfinite(obj):
+    """Strict-JSON view of a record: non-finite floats become `null`, and
+    a dict field additionally gains a sibling `"<field>_nonfinite": true`
+    marker so consumers can tell "loss was NaN" from "loss was absent".
+    Recurses through nested dicts/lists; everything else passes through
+    unchanged. (`json.dumps` default `allow_nan=True` emits bare `NaN`
+    tokens, which strict parsers reject.)"""
+    if isinstance(obj, dict):
+        out = {}
+        for k, v in obj.items():
+            if isinstance(v, float) and not math.isfinite(v):
+                out[k] = None
+                out[k + "_nonfinite"] = True
+            else:
+                out[k] = sanitize_nonfinite(v)
+        return out
+    if isinstance(obj, (list, tuple)):
+        return [None if isinstance(v, float) and not math.isfinite(v)
+                else sanitize_nonfinite(v) for v in obj]
+    return obj
+
+
 class TelemetrySink:
     """A destination for telemetry records. Subclasses implement `emit`
     (one flat JSON-safe dict per call); `close` is optional."""
@@ -67,7 +99,12 @@ class TelemetrySink:
 
 class JsonlSink(TelemetrySink):
     """Append records to a JSONL file, one JSON object per line, flushed
-    per record so a crashed run still leaves its stream on disk."""
+    per record so a crashed run still leaves its stream on disk.
+
+    Every line is STRICT JSON: non-finite floats are encoded as `null`
+    with a sibling `<field>_nonfinite: true` marker (see
+    `sanitize_nonfinite`) — a NaN loss must not poison downstream strict
+    parsers with a bare `NaN` token."""
 
     def __init__(self, path: str, append: bool = True):
         self.path = path
@@ -77,7 +114,8 @@ class JsonlSink(TelemetrySink):
         self._f = open(path, "a" if append else "w")
 
     def emit(self, record: Dict):
-        self._f.write(json.dumps(record) + "\n")
+        self._f.write(json.dumps(sanitize_nonfinite(record),
+                                 allow_nan=False) + "\n")
         self._f.flush()
 
     def close(self):
@@ -137,6 +175,112 @@ class CompositeSink(TelemetrySink):
             s.close()
 
 
+_NUM = (int, float)
+_OPT_NUM = (int, float, type(None))
+_OPT_STR = (str, type(None))
+
+#: Declared field contract per record type — what sink consumers may rely
+#: on. `required` fields are always present (with the given types),
+#: `optional` fields are typed when present, and unless `open` is True any
+#: OTHER field is a contract violation (`<field>_nonfinite` markers from
+#: the strict-JSON encoding are always allowed). `event` is open: each
+#: monitor/resilience event carries its own context fields.
+RECORD_SCHEMAS: Dict[str, Dict] = {
+    "run_start": {
+        "required": {},
+        "optional": {"loop": str, "model": str, "optim_method": str,
+                     "backend": str, "n_devices": int, "sync_interval": int},
+    },
+    "step": {
+        "required": {"step": int},
+        "optional": {
+            "epoch": int, "loss": _OPT_NUM, "lr": _NUM,
+            "throughput": _NUM, "step_time_s": _NUM, "records": int,
+            "grad_norm": _NUM, "param_norm": _NUM, "nonfinite_steps": int,
+            "host_rss_mb": _NUM, "device_mem": list,
+            "prefetch_queue_depth": int, "prefetch_fetch_wait_s": _NUM,
+            "prefetch_worker_busy": _NUM,
+            "flops_per_step": _OPT_NUM, "bytes_accessed": _OPT_NUM,
+            "mfu": _OPT_NUM,
+        },
+    },
+    "event": {
+        "required": {"event": str},
+        "optional": {},
+        "open": True,
+    },
+    "compile": {
+        "required": {"label": str, "signature": str, "lower_s": _NUM,
+                     "compile_s": _NUM, "cache_hit": bool},
+        "optional": {"jaxpr_eqns": _OPT_NUM, "flops": _OPT_NUM,
+                     "bytes_accessed": _OPT_NUM},
+    },
+    "run_end": {
+        "required": {},
+        "optional": {"step": int, "epoch": int, "loss": _OPT_NUM,
+                     "metrics": dict},
+    },
+}
+
+_SERVING_FIELDS = {
+    "required": {"queue_depth": int, "submitted": int, "completed": int,
+                 "failed": int, "timed_out": int, "rejected": int,
+                 "cancelled": int, "shed": int, "batches": int,
+                 "bucket_hits": int, "rows": int, "padded_rows": int,
+                 "bucket_hit_rate": _OPT_NUM, "pad_fraction": _OPT_NUM,
+                 "queue_wait_ms_count": int, "latency_ms_count": int,
+                 "batch_size_count": int},
+    "optional": {
+        **{f"{pre}_p{q}": _NUM
+           for pre in ("queue_wait_ms", "latency_ms", "batch_size")
+           for q in (50, 95, 99)},
+        "flops_per_step": _OPT_NUM, "bytes_accessed": _OPT_NUM,
+        "mfu": _OPT_NUM,
+    },
+}
+RECORD_SCHEMAS["serving_stats"] = _SERVING_FIELDS
+RECORD_SCHEMAS["serving_summary"] = _SERVING_FIELDS
+
+
+def validate_record(record: Dict):
+    """Check one telemetry record against `RECORD_SCHEMAS`; raises
+    `ValueError` naming the first violation (unknown type, missing/
+    mistyped field, undeclared field on a closed record type). Used by the
+    contract tests; cheap enough for a validating sink."""
+    rtype = record.get("type")
+    if rtype not in RECORD_SCHEMAS:
+        raise ValueError(f"unknown record type {rtype!r}")
+    if not isinstance(record.get("time"), (int, float)):
+        raise ValueError(f"{rtype}: missing/mistyped 'time'")
+    schema = RECORD_SCHEMAS[rtype]
+    fields = {**schema["required"], **schema["optional"]}
+
+    def check(name, types):
+        val = record[name]
+        ok = isinstance(val, types if isinstance(types, tuple)
+                        else (types,))
+        # bools are ints in python; don't let True satisfy an int field
+        if ok and isinstance(val, bool) and bool not in (
+                types if isinstance(types, tuple) else (types,)):
+            ok = False
+        if not ok:
+            raise ValueError(
+                f"{rtype}.{name}: {type(val).__name__} not in "
+                f"{types}")
+
+    for name, types in schema["required"].items():
+        if name not in record:
+            raise ValueError(f"{rtype}: missing required field {name!r}")
+        check(name, types)
+    for name in record:
+        if name in ("type", "time") or name.endswith("_nonfinite"):
+            continue
+        if name in fields:
+            check(name, fields[name])
+        elif not schema.get("open"):
+            raise ValueError(f"{rtype}: undeclared field {name!r}")
+
+
 class Telemetry:
     """The optimizer-facing collector.
 
@@ -149,13 +293,22 @@ class Telemetry:
       fused by XLA) and report them per step.
     - `resources=True` — sample host RSS and device memory stats with
       every step record (procfs read + PJRT query, host-side only).
+    - `flight` — the always-on crash flight recorder
+      (observability/flight.py): every record also lands in a bounded
+      ring, auto-dumped to disk on `run_abort` / `fault_injected` /
+      NaN-guard `raise`. Pass a configured `FlightRecorder` to control
+      capacity/dump dir, or `False` to disable.
     """
 
     def __init__(self, *sinks: TelemetrySink, grad_norms: bool = False,
-                 resources: bool = True):
+                 resources: bool = True, flight=None):
+        from bigdl_tpu.observability.flight import FlightRecorder
         self.sink = CompositeSink(*sinks)
         self.grad_norms = grad_norms
         self.resources = resources
+        if flight is None:
+            flight = FlightRecorder()
+        self.flight = flight or None  # False/0 -> disabled
 
     def add_sink(self, sink: TelemetrySink) -> "Telemetry":
         self.sink.sinks.append(sink)
@@ -168,6 +321,9 @@ class Telemetry:
         # serving — tests/test_resilience.py)
         faults.fire("telemetry.sink", record_type=record.get("type"))
         record.setdefault("time", time.time())
+        if self.flight is not None:
+            # ring first: a failing sink must not starve the crash record
+            self.flight.emit(record)
         self.sink.emit(record)
 
     def run_start(self, **fields):

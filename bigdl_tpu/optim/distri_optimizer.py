@@ -98,6 +98,12 @@ class DistriOptimizer(BaseOptimizer):
         keeps the executable on the backend's fastest single-chip path."""
         return int(np.prod(self.mesh.devices.shape)) == 1
 
+    @property
+    def _n_compute_devices(self) -> int:
+        """MFU denominator: the SPMD step's cost analysis counts the
+        whole-mesh program, so peak scales by the mesh size."""
+        return int(np.prod(self.mesh.devices.shape))
+
     def _place(self, params, model_state, opt_state):
         mesh = self.mesh
         if self._single_device:
@@ -189,8 +195,19 @@ class DistriOptimizer(BaseOptimizer):
 
         # jit with sharding propagated from the placed inputs; XLA SPMD
         # partitions the computation and inserts the ICI collectives;
-        # donated: params, optimizer slots, and the rng chain
-        return jax.jit(step, donate_argnums=(0, 1, 6))
+        # donated: params, optimizer slots, and the rng chain. With
+        # telemetry attached, the compile-telemetry wrapper emits one
+        # `compile` record per distinct (x, y) signature and carries the
+        # executable's FLOP count for step-record attribution; without
+        # it the plain jit fast path is kept (attribution is
+        # observability — an unobserved run must not pay for it)
+        if self.telemetry is None:
+            return jax.jit(step, donate_argnums=(0, 1, 6))
+        from bigdl_tpu.observability.compilation import CompiledFunction
+        return CompiledFunction(
+            step, label=f"distri.step/{type(self.model).__name__}",
+            telemetry=self.telemetry, sig_argnums=(3, 4),
+            donate_argnums=(0, 1, 6))
 
     # ------------------------------------------------------------------ #
     def _retry_policy(self) -> RetryPolicy:
@@ -282,7 +299,7 @@ class DistriOptimizer(BaseOptimizer):
             self._resume_slots = None
         else:
             opt_state = self.optim_method.init_state(params)
-        step = self._build_step()
+        step = self._step_fn = self._build_step()
         driver_state = self.optim_method.state
         # per-host shard feeds this loop; scale records by host count so
         # epoch triggers fire on global progress

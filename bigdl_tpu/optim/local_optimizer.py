@@ -391,8 +391,12 @@ class BaseOptimizer:
         (observability.Telemetry): one `step` record per sync point plus
         run_start/run_end, fanned out to its sinks. With
         `Telemetry(grad_norms=True)` the jitted step also computes the
-        global gradient/parameter L2 norms per step."""
+        global gradient/parameter L2 norms per step. Step records carry
+        cost attribution (`flops_per_step`, `bytes_accessed`, `mfu`) read
+        off the compiled step executable, and every distinct step
+        signature emits one `compile` record."""
         self.telemetry = telemetry
+        self._link_flight()
         return self
 
     setTelemetry = set_telemetry
@@ -403,9 +407,18 @@ class BaseOptimizer:
         spans, exportable as Chrome/Perfetto trace JSON
         (observability.spans)."""
         self.tracer = tracer
+        self._link_flight()
         return self
 
     setTracer = set_tracer
+
+    def _link_flight(self):
+        """Give the telemetry's crash flight recorder (when both are
+        attached) the tracer, so auto-dumps carry the span tail next to
+        the record tail."""
+        flight = getattr(self.telemetry, "flight", None)
+        if flight is not None and self.tracer is not None:
+            flight.attach_tracer(self.tracer)
 
     def set_health_monitors(self, *monitors):
         """Attach health monitors (observability.health): each observes
@@ -542,6 +555,12 @@ class BaseOptimizer:
             aux["param_norm"] = self._global_norm(new[0])
         return new, aux
 
+    @property
+    def _n_compute_devices(self) -> int:
+        """Devices the step's FLOP count is spread over (MFU denominator):
+        1 for the local loop; the mesh size for DistriOptimizer."""
+        return 1
+
     def _observe_sync(self, driver_state, loss_val, lr, throughput,
                       step_time_s, records, aux_pending):
         """Host side of a sync point: resolve the pending in-step aux
@@ -554,6 +573,17 @@ class BaseOptimizer:
                "loss": loss_val, "lr": self._lr_scalar(lr),
                "throughput": throughput, "step_time_s": step_time_s,
                "records": records}
+        info = getattr(getattr(self, "_step_fn", None), "last_info", None)
+        if info is not None:
+            # cost attribution off the compiled step executable
+            # (observability/costs.py): the SPMD step's FLOP count covers
+            # the global batch, so MFU divides by the whole-mesh peak —
+            # null (never fabricated) on chips outside the registry
+            from bigdl_tpu.observability import costs
+            rec["flops_per_step"] = info.get("flops")
+            rec["bytes_accessed"] = info.get("bytes_accessed")
+            rec["mfu"] = costs.mfu(info.get("flops"), step_time_s,
+                                   n_devices=self._n_compute_devices)
         if self._active_pipeline is not None:
             # input-pipeline health gauges (docs/observability.md):
             # instantaneous ready-batch depth, cumulative driver
@@ -766,7 +796,20 @@ class LocalOptimizer(BaseOptimizer):
                 (new_params, new_opt, new_ms))
             return new_params, new_opt, new_ms, loss, aux
 
-        return jax.jit(step)
+        # with telemetry attached, route the step through the
+        # compile-telemetry wrapper: one `compile` record per distinct
+        # step signature, FLOPs/bytes off the executable for the step
+        # records' attribution fields. Signature = the batch args only —
+        # param/opt trees keep constant avals within a run. Without
+        # telemetry the plain jit path (and its C++ fast dispatch) is
+        # kept — attribution is observability, and an unobserved run
+        # must not pay for it
+        if self.telemetry is None:
+            return jax.jit(step)
+        from bigdl_tpu.observability.compilation import CompiledFunction
+        return CompiledFunction(
+            step, label=f"local.step/{type(self.model).__name__}",
+            telemetry=self.telemetry, sig_argnums=(3, 4))
 
     def _optimize_impl(self) -> Module:
         self._maybe_optimize_graph()
@@ -780,7 +823,7 @@ class LocalOptimizer(BaseOptimizer):
             self._resume_slots = None
         else:
             opt_state = self.optim_method.init_state(params)
-        step = self._build_step()
+        step = self._step_fn = self._build_step()
         state = self.optim_method.state  # epoch/neval bookkeeping
         driver_state = state
         epoch_size = self.dataset.size()

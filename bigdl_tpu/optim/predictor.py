@@ -34,9 +34,15 @@ def _iter_modules(root: Module):
 
 
 class LocalPredictor:
-    """Single-device batched inference (DL/optim/LocalPredictor.scala)."""
+    """Single-device batched inference (DL/optim/LocalPredictor.scala).
+
+    `instrument=True` routes the jitted forward through the
+    observability compile wrapper (per-signature compile records + cost
+    info for attribution) — the serving engine turns it on; standalone
+    predictors keep the plain jit fast path, mirroring the optimizers'
+    "an unobserved run must not pay" rule."""
     def __init__(self, model: Module, batch_size: int = 32,
-                 convert: bool = True):
+                 convert: bool = True, instrument: bool = False):
         if convert:
             # inference-graph rewrites (BN fold, noise elision) — the
             # reference converts via IR here too (DistriOptimizer.scala:552).
@@ -82,7 +88,20 @@ class LocalPredictor:
                                       training=False)
             return out
 
-        self._jitted = jax.jit(fwd)
+        if instrument:
+            # compile-telemetry wrapper (observability/compilation.py):
+            # silent until a telemetry stream is attached to it (the
+            # serving engine attaches its own + a serving label), but
+            # always tracking per-signature cost info. Signature = the
+            # input batch only — params/state avals are fixed per
+            # predictor
+            from bigdl_tpu.observability.compilation import (
+                CompiledFunction)
+            self._jitted = CompiledFunction(
+                fwd, label=f"predict.forward/{type(final_model).__name__}",
+                sig_argnums=(2,))
+        else:
+            self._jitted = jax.jit(fwd)
 
     def _forward(self, params, state, x):
         return self._jitted(params, state, x)

@@ -45,7 +45,10 @@ Robustness contracts (all under test):
 Telemetry: queue-wait / batch-size / end-to-end-latency histograms
 (p50/p95/p99) plus queue-depth and bucket-hit-rate gauges flow through the
 existing `observability.Telemetry` sinks as `serving_stats` records, and
-every dispatch/fetch phase lands in an attached `SpanTracer`.
+every dispatch/fetch phase lands in an attached `SpanTracer`. Bucket
+warmup/traffic compiles emit `compile` records (the predictor's jit runs
+through the observability compile wrapper), and stats carry per-batch
+FLOPs plus lifetime serving MFU (null off the chip registry).
 """
 
 from __future__ import annotations
@@ -241,7 +244,7 @@ class InferenceEngine:
                 raise ValueError(f"buckets must be distinct, got {buckets}")
         from bigdl_tpu.optim.predictor import LocalPredictor
         self._pred = LocalPredictor(model, batch_size=buckets[-1],
-                                    convert=convert)
+                                    convert=convert, instrument=True)
         self.model = self._pred.model  # the CONVERTED serving copy
         self._params = self.model.ensure_params()
         self._state = self.model._state
@@ -274,6 +277,20 @@ class InferenceEngine:
                    "shed": 0, "batches": 0, "bucket_hits": 0, "rows": 0,
                    "padded_rows": 0}
         self._compiled = set()  # (signature, bucket) pairs seen/warmed
+        # cost attribution (observability/costs.py): cumulative FLOPs /
+        # bytes of dispatched batches, read off the compiled bucket
+        # executables; the engine's MFU is averaged over its whole
+        # serving lifetime (idle time included — that IS serving MFU)
+        self._flops_total = 0.0
+        self._bytes_total = 0.0
+        self._t0_mono = time.monotonic()
+        # route the predictor's compile telemetry into this engine's
+        # stream under a serving label — bucket warmup cost and recompile
+        # storms then show up as `compile` records
+        jw = self._pred._jitted
+        if hasattr(jw, "label"):
+            jw.label = f"serving.forward/{type(self.model).__name__}"
+            jw.telemetry = telemetry
         self._breaker_cfg = dict(breaker) if breaker is not None else None
         self._breakers: Dict[tuple, CircuitBreaker] = {}  # under _slock
 
@@ -618,6 +635,7 @@ class InferenceEngine:
                     f"batch forward failed: {e!r}"))
             return None
         self.batch_sizes.record(n)
+        info = getattr(self._pred._jitted, "last_info", None)
         with self._slock:
             hit = (sig, bucket) in self._compiled
             self._compiled.add((sig, bucket))
@@ -625,6 +643,9 @@ class InferenceEngine:
             self._n["bucket_hits"] += int(hit)
             self._n["rows"] += bucket
             self._n["padded_rows"] += bucket - n
+            if info is not None:
+                self._flops_total += info.get("flops") or 0.0
+                self._bytes_total += info.get("bytes_accessed") or 0.0
         return reqs, y, br, probe
 
     def _complete(self, batch):
@@ -668,11 +689,24 @@ class InferenceEngine:
             depth = len(self._q)
         with self._slock:
             n = dict(self._n)
+            flops_total, bytes_total = self._flops_total, self._bytes_total
         out = {"queue_depth": depth, **n}
         out["bucket_hit_rate"] = round(n["bucket_hits"] / n["batches"], 4) \
             if n["batches"] else None
         out["pad_fraction"] = round(n["padded_rows"] / n["rows"], 4) \
             if n["rows"] else None
+        # attribution: mean per-dispatched-batch cost plus lifetime MFU
+        # (cumulative FLOPs over wall time vs single-chip registry peak;
+        # null off the registry — CPU included)
+        from bigdl_tpu.observability import costs
+        batches = n["batches"]
+        out["flops_per_step"] = round(flops_total / batches, 1) \
+            if batches and flops_total else None
+        out["bytes_accessed"] = round(bytes_total / batches, 1) \
+            if batches and bytes_total else None
+        m = costs.mfu(flops_total or None,
+                      time.monotonic() - self._t0_mono)
+        out["mfu"] = round(m, 6) if m is not None else None
         out.update(self.queue_wait.snapshot("queue_wait_ms", scale=1e3))
         out.update(self.latency.snapshot("latency_ms", scale=1e3))
         out.update(self.batch_sizes.snapshot("batch_size", digits=1))

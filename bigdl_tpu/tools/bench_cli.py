@@ -42,21 +42,11 @@ import time
 
 import numpy as np
 
-# peak dense bf16 FLOP/s per chip, by jax device_kind substring
-_PEAK_BF16 = [
-    ("v6", 918e12), ("trillium", 918e12),
-    ("v5p", 459e12), ("v5 lite", 197e12), ("v5e", 197e12), ("v5", 459e12),
-    ("v4", 275e12), ("v3", 123e12), ("v2", 45e12),
-]
-
-
-def _peak_flops(device):
-    kind = (device if isinstance(device, str)
-            else getattr(device, "device_kind", "")).lower()
-    for key, peak in _PEAK_BF16:
-        if key in kind:
-            return peak
-    return None
+# peak dense bf16 FLOP/s chip registry: single source of truth lives in
+# the observability cost-accounting module (the telemetry stream computes
+# per-step MFU from the same table this offline report uses)
+from bigdl_tpu.observability.costs import (PEAK_BF16_FLOPS as _PEAK_BF16,
+                                           peak_flops as _peak_flops)
 
 
 def _step_flops(model, crit, method, params, state, batch_size, in_shape):
@@ -125,6 +115,15 @@ def _bench_telemetry(opt):
     finally:
         telemetry.close()
         tracer.export(stem + ".trace.json")
+        if os.environ.get("BIGDL_TPU_ATTRIBUTION"):
+            # --attribution: print the per-run attribution report
+            # (host-vs-device breakdown, MFU trend, top compile costs)
+            # to stderr right next to the phase table
+            try:
+                from bigdl_tpu.tools import metrics_cli
+                metrics_cli.report(stem + ".jsonl", out=sys.stderr)
+            except Exception as e:
+                print(f"attribution report failed: {e!r}", file=sys.stderr)
 
 
 def _framework_throughput(model, in_shape, n_class, batch_size, warmup,
@@ -1072,6 +1071,13 @@ def main():
                 _records_dir(), "telemetry")
         elif a.startswith("--telemetry="):
             os.environ["BIGDL_TPU_TELEMETRY"] = a.split("=", 1)[1]
+        elif a == "--attribution":
+            # implies --telemetry (needs the JSONL stream) and makes every
+            # telemetry-wired run print its attribution report on stderr;
+            # env-var passthrough so watchdogged children inherit it
+            os.environ["BIGDL_TPU_ATTRIBUTION"] = "1"
+            os.environ.setdefault("BIGDL_TPU_TELEMETRY", os.path.join(
+                _records_dir(), "telemetry"))
         elif a.startswith("--input-cost-ms="):
             input_cost_ms = float(a.split("=", 1)[1])
         elif a == "--input-cost-ms":

@@ -1,0 +1,178 @@
+"""Attribution report over a recorded telemetry JSONL stream.
+
+`python -m bigdl_tpu.tools.metrics_cli report run.jsonl` reads the strict
+JSONL a `JsonlSink` wrote (bench `--telemetry` / `--attribution` runs, or
+any `Telemetry(JsonlSink(...))` training run) and prints the
+performance-attribution tables the MFU push needs:
+
+- run header (loop, model, backend, devices, sync interval),
+- step summary: iterations, throughput, per-step wall time, MFU trend
+  (first half vs second half of the run — a falling trend means the run
+  never reached steady state or something is degrading),
+- host-vs-device phase breakdown from the run_end `Metrics` phase table
+  (data fetch / H2D / compute / checkpoint means per iteration),
+- top compile costs: the `compile` records sorted by compile seconds —
+  where warmup went, and whether traffic recompiled (cache_hit=false past
+  warmup is the recompile-storm smell),
+- event summary (nan_guard / straggler / retry / fault counts).
+
+Exit code 0 on a readable stream with at least one record; 2 otherwise.
+Used by docs/PERF.md updates and smoke-tested in tests/test_bench.py.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+from typing import Dict, List, Optional, TextIO
+
+
+def _raise_constant(tok):  # json parse_constant hook
+    raise ValueError(f"non-strict JSON token {tok!r}")
+
+
+def load_records(path: str) -> List[Dict]:
+    """Parse one strict-JSON record per line; raises on NaN/Infinity
+    tokens (the JsonlSink contract says they cannot appear)."""
+    records = []
+    with open(path) as f:
+        for i, line in enumerate(f, 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                records.append(json.loads(
+                    line, parse_constant=_raise_constant))
+            except ValueError as e:
+                raise ValueError(f"{path}:{i}: {e}") from None
+    return records
+
+
+def _mean(xs: List[float]) -> Optional[float]:
+    xs = [x for x in xs if isinstance(x, (int, float))]
+    return sum(xs) / len(xs) if xs else None
+
+
+def _fmt(x, unit="", digits=3) -> str:
+    if x is None:
+        return "-"
+    if isinstance(x, float):
+        if abs(x) >= 1e15 or (abs(x) < 1e-3 and x != 0):
+            return f"{x:.3e}{unit}"
+        x = round(x, digits)
+    return f"{x}{unit}"
+
+
+def report(path: str, out: TextIO = None) -> int:
+    """Print the attribution report for one run's JSONL; returns the
+    process exit code (0 = report printed)."""
+    out = out or sys.stdout
+    try:
+        records = load_records(path)
+    except (OSError, ValueError) as e:
+        print(f"metrics_cli: cannot read {path}: {e}", file=sys.stderr)
+        return 2
+    if not records:
+        print(f"metrics_cli: {path} holds no records", file=sys.stderr)
+        return 2
+
+    w = out.write
+    start = next((r for r in records if r.get("type") == "run_start"), {})
+    end = next((r for r in reversed(records)
+                if r.get("type") == "run_end"), {})
+    steps = [r for r in records if r.get("type") == "step"]
+    compiles = [r for r in records if r.get("type") == "compile"]
+    serving = [r for r in records
+               if r.get("type") in ("serving_stats", "serving_summary")]
+    events = [r for r in records if r.get("type") == "event"]
+
+    w(f"== run: {path} ==\n")
+    if start:
+        w("  " + "  ".join(
+            f"{k}={start[k]}" for k in ("loop", "model", "optim_method",
+                                        "backend", "n_devices",
+                                        "sync_interval") if k in start)
+          + "\n")
+
+    if steps:
+        half = max(1, len(steps) // 2)
+        w(f"\n-- steps ({len(steps)} sync points, "
+          f"final step {steps[-1].get('step')}) --\n")
+        rows = [
+            ("throughput (rec/s)", [s.get("throughput") for s in steps]),
+            ("step_time_s", [s.get("step_time_s") for s in steps]),
+            ("flops_per_step", [s.get("flops_per_step") for s in steps]),
+            ("bytes_accessed", [s.get("bytes_accessed") for s in steps]),
+            ("mfu", [s.get("mfu") for s in steps]),
+        ]
+        w(f"  {'metric':<20} {'mean':>12} {'first-half':>12} "
+          f"{'second-half':>12}\n")
+        for name, vals in rows:
+            w(f"  {name:<20} {_fmt(_mean(vals)):>12} "
+              f"{_fmt(_mean(vals[:half])):>12} "
+              f"{_fmt(_mean(vals[half:])):>12}\n")
+
+    metrics = end.get("metrics") or {}
+    if metrics:
+        w("\n-- host vs device phase table (seconds, per occurrence) --\n")
+        w(f"  {'phase':<28} {'mean':>10} {'total':>10} {'count':>7}\n")
+        for name, m in sorted(metrics.items(),
+                              key=lambda kv: -(kv[1].get("total") or 0)):
+            w(f"  {name:<28} {_fmt(m.get('mean'), digits=6):>10} "
+              f"{_fmt(m.get('total'), digits=3):>10} "
+              f"{m.get('count', 0):>7}\n")
+
+    if compiles:
+        total = sum(c.get("compile_s") or 0 for c in compiles)
+        hits = sum(1 for c in compiles if c.get("cache_hit"))
+        w(f"\n-- compiles ({len(compiles)} signatures, "
+          f"{_fmt(total)}s backend compile, {hits} cache hits) --\n")
+        w(f"  {'label':<30} {'compile_s':>10} {'lower_s':>9} "
+          f"{'eqns':>6} {'hit':>4}  signature\n")
+        for c in sorted(compiles,
+                        key=lambda c: -(c.get("compile_s") or 0))[:10]:
+            w(f"  {c.get('label', '?'):<30} "
+              f"{_fmt(c.get('compile_s')):>10} "
+              f"{_fmt(c.get('lower_s')):>9} "
+              f"{_fmt(c.get('jaxpr_eqns'), digits=0):>6} "
+              f"{'y' if c.get('cache_hit') else 'n':>4}  "
+              f"{c.get('signature', '')[:48]}\n")
+
+    if serving:
+        s = serving[-1]
+        w(f"\n-- serving (last of {len(serving)} snapshots) --\n")
+        for k in ("submitted", "completed", "failed", "timed_out", "shed",
+                  "batches", "bucket_hit_rate", "pad_fraction",
+                  "latency_ms_p50", "latency_ms_p99", "flops_per_step",
+                  "mfu"):
+            if k in s:
+                w(f"  {k:<20} {_fmt(s[k])}\n")
+
+    if events:
+        counts: Dict[str, int] = {}
+        for e in events:
+            counts[e.get("event", "?")] = counts.get(e.get("event", "?"),
+                                                     0) + 1
+        w("\n-- events --\n")
+        for kind, n in sorted(counts.items()):
+            w(f"  {kind:<24} {n}\n")
+    w("\n")
+    return 0
+
+
+def main(argv=None) -> int:
+    """CLI entry: `metrics_cli report <run.jsonl> [more.jsonl ...]`."""
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if not argv or argv[0] in ("-h", "--help") or argv[0] != "report" \
+            or len(argv) < 2:
+        print("usage: python -m bigdl_tpu.tools.metrics_cli report "
+              "<run.jsonl> [more.jsonl ...]", file=sys.stderr)
+        return 0 if argv and argv[0] in ("-h", "--help") else 2
+    rc = 0
+    for path in argv[1:]:
+        rc = max(rc, report(path))
+    return rc
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

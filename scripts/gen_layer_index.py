@@ -79,10 +79,12 @@ def _public(pkg):
 def main(out_path=None):
     import bigdl_tpu.keras as keras
     import bigdl_tpu.nn as nn
+    import bigdl_tpu.observability as observability
     import bigdl_tpu.ops as ops
     import bigdl_tpu.optim as optim
     import bigdl_tpu.parallel as parallel
     import bigdl_tpu.resilience as resilience
+    import bigdl_tpu.serving as serving
 
     out_path = out_path or os.path.join(
         os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
@@ -108,6 +110,11 @@ def main(out_path=None):
               _rows(parallel, _public(parallel)))
         _emit(f, "bigdl_tpu.resilience — fault injection, retry, breaker",
               _rows(resilience, _public(resilience)))
+        _emit(f, "bigdl_tpu.observability — spans, telemetry, health, "
+                 "attribution, export",
+              _rows(observability, _public(observability)))
+        _emit(f, "bigdl_tpu.serving — micro-batching inference engine",
+              _rows(serving, _public(serving)))
     return out_path
 
 

@@ -1,0 +1,547 @@
+"""Performance-attribution layer tests: FLOPs/MFU cost accounting on the
+step stream, compile telemetry, the crash flight recorder, Prometheus
+export, strict-JSONL encoding, health-monitor warm-up, and the declared
+record-schema contract (ISSUE 8 acceptance criteria)."""
+
+import json
+import math
+import os
+import threading
+import urllib.request
+
+import numpy as np
+import pytest
+
+import bigdl_tpu.nn as nn
+import bigdl_tpu.optim as optim
+from bigdl_tpu.dataset.dataset import LocalDataSet
+from bigdl_tpu.dataset.sample import MiniBatch, Sample
+from bigdl_tpu.observability import (FlightRecorder, InMemorySink, JsonlSink,
+                                     MetricsServer, NanGuard,
+                                     PrometheusTextSink, SpanTracer,
+                                     StragglerDetector, Telemetry,
+                                     ThroughputMonitor, executable_costs,
+                                     jaxpr_flops, mfu, peak_flops,
+                                     sanitize_nonfinite, validate_record)
+from bigdl_tpu.optim.distri_optimizer import DistriOptimizer
+from bigdl_tpu.optim.local_optimizer import LocalOptimizer
+
+
+# ------------------------------------------------------------------ #
+# cost accounting units
+# ------------------------------------------------------------------ #
+class TestCosts:
+    def test_peak_registry(self):
+        assert peak_flops("TPU v5e") == 197e12
+        assert peak_flops("TPU v5 lite") == 197e12
+        assert peak_flops("TPU v4") == 275e12
+        assert peak_flops("cpu") is None
+        assert peak_flops("") is None
+
+    def test_mfu_null_on_unknown_chip(self):
+        assert mfu(1e12, 0.1, device_kind="cpu") is None
+        assert mfu(None, 0.1, device_kind="TPU v5e") is None
+        assert mfu(1e12, float("nan"), device_kind="TPU v5e") is None
+
+    def test_mfu_value(self):
+        # 197 TFLOP over 2 s on one v5e = 98.5 TFLOP/s / 197 peak = 0.5
+        assert mfu(197e12, 2.0, device_kind="TPU v5e") == \
+            pytest.approx(0.5)
+        assert mfu(197e12, 1.0, device_kind="TPU v5e", n_devices=2) == \
+            pytest.approx(0.5)
+
+    def test_executable_costs_and_jaxpr_fallback(self):
+        import jax
+        import jax.numpy as jnp
+
+        def f(a, b):
+            return jnp.dot(a, b)
+
+        a = jnp.ones((8, 16))
+        b = jnp.ones((16, 4))
+        traced = jax.jit(f).trace(a, b)
+        compiled = traced.lower().compile()
+        cost = executable_costs(compiled)
+        # CPU backend reports: dot flops = 2*8*16*4 = 1024
+        assert cost["flops"] == pytest.approx(1024.0)
+        assert cost["bytes_accessed"] > 0
+        # the jaxpr-walk fallback counts the same matmul exactly
+        assert jaxpr_flops(traced.jaxpr) == pytest.approx(1024.0)
+
+
+# ------------------------------------------------------------------ #
+# optimizer integration (acceptance: LeNet LocalOptimizer run)
+# ------------------------------------------------------------------ #
+def _lenet_batches(n=3, batch=36, seed=0):
+    rs = np.random.RandomState(seed)
+    return [MiniBatch(rs.rand(batch, 28, 28).astype(np.float32),
+                      (rs.randint(0, 10, batch) + 1).astype(np.int32))
+            for _ in range(n)]
+
+
+def _lenet_opt(sink, iters=3, batch=36):
+    from bigdl_tpu.models.lenet import LeNet5
+    opt = LocalOptimizer(LeNet5(10), LocalDataSet(_lenet_batches(
+        batch=batch)), nn.ClassNLLCriterion())
+    opt.set_optim_method(optim.SGD(learning_rate=0.05))
+    opt.set_end_when(optim.max_iteration(iters))
+    opt.set_telemetry(Telemetry(sink, resources=False))
+    return opt
+
+
+class TestStepAttribution:
+    def test_lenet_step_records_carry_flops_and_mfu(self):
+        """Acceptance: a LeNet LocalOptimizer run with
+        Telemetry(InMemorySink()) produces step records carrying
+        flops_per_step > 0 and mfu (null on unknown chips), and exactly
+        one compile record per distinct step signature; re-running the
+        same shapes reports cache_hit=true."""
+        sink = InMemorySink()
+        _lenet_opt(sink).optimize()
+        steps = sink.steps()
+        assert len(steps) == 3
+        for r in steps:
+            assert r["flops_per_step"] > 0
+            assert r["bytes_accessed"] > 0
+            assert "mfu" in r and r["mfu"] is None  # CPU: off-registry
+        compiles = [r for r in sink.records if r["type"] == "compile"]
+        assert len(compiles) == 1  # one distinct (x, y) signature
+        assert compiles[0]["label"].startswith("local.step/")
+        assert compiles[0]["compile_s"] >= 0
+        assert compiles[0]["lower_s"] >= 0
+        assert compiles[0]["jaxpr_eqns"] > 0
+
+        # same shapes again: the stream reports the warm compile
+        sink2 = InMemorySink()
+        _lenet_opt(sink2, iters=2).optimize()
+        c2 = [r for r in sink2.records if r["type"] == "compile"]
+        assert len(c2) == 1
+        assert c2[0]["cache_hit"] is True
+
+    def test_distri_step_attribution(self):
+        rs = np.random.RandomState(1)
+        batches = [MiniBatch(rs.rand(16, 6).astype(np.float32),
+                             (rs.randint(0, 2, 16) + 1).astype(np.int32))
+                   for _ in range(3)]
+        model = (nn.Sequential().add(nn.Linear(6, 4)).add(nn.ReLU())
+                 .add(nn.Linear(4, 2)).add(nn.LogSoftMax()))
+        sink = InMemorySink()
+        opt = DistriOptimizer(model, LocalDataSet(batches),
+                              nn.ClassNLLCriterion())
+        opt.set_optim_method(optim.SGD(learning_rate=0.05))
+        opt.set_end_when(optim.max_iteration(3))
+        opt.set_telemetry(Telemetry(sink, resources=False))
+        opt.optimize()
+        steps = sink.steps()
+        assert steps and all(r["flops_per_step"] > 0 for r in steps)
+        compiles = [r for r in sink.records if r["type"] == "compile"]
+        assert len(compiles) == 1
+        assert compiles[0]["label"].startswith("distri.step/")
+
+    def test_fallback_clears_last_info_and_keeps_count(self):
+        """After the plain-jit fallback engages, last_info must read
+        None (absent attribution beats a stale signature's costs) and
+        the compile count must keep growing off the jit cache."""
+        import jax.numpy as jnp
+        from bigdl_tpu.observability import CompiledFunction
+        cf = CompiledFunction(lambda x: x + 1, label="t/fallback",
+                              sig_argnums=(0,))
+        cf(jnp.ones(3))
+        assert cf.last_info is not None
+        cf._aot_ok = False  # what any AOT failure flips
+        cf(jnp.ones(4))
+        assert cf.last_info is None
+        assert cf._cache_size() >= 2  # AOT entry + jit-cache entry
+
+    def test_serving_warmup_emits_compile_per_bucket_and_stats_costs(self):
+        from bigdl_tpu.serving import InferenceEngine
+        model = (nn.Sequential().add(nn.Linear(4, 2))
+                 .add(nn.LogSoftMax()))
+        sink = InMemorySink()
+        eng = InferenceEngine(model, max_batch_size=8, max_wait_ms=0.5,
+                              telemetry=Telemetry(sink, resources=False))
+        try:
+            eng.warmup(Sample(np.ones(4, np.float32)))
+            compiles = [r for r in sink.records if r["type"] == "compile"]
+            assert len(compiles) == len(eng.buckets)
+            assert all(c["label"].startswith("serving.forward/")
+                       for c in compiles)
+            eng.predict(Sample(np.ones(4, np.float32)))
+            stats = eng.stats()
+            assert stats["flops_per_step"] > 0
+            assert stats["bytes_accessed"] > 0
+            assert "mfu" in stats and stats["mfu"] is None  # CPU
+        finally:
+            eng.close()
+
+
+# ------------------------------------------------------------------ #
+# record schema contract (satellite)
+# ------------------------------------------------------------------ #
+class TestRecordSchemas:
+    def test_training_stream_validates(self):
+        sink = InMemorySink()
+        opt = _lenet_opt(sink)
+        opt.set_health_monitors(NanGuard(action="warn"))
+        opt.optimize()
+        types = {r["type"] for r in sink.records}
+        assert {"run_start", "step", "compile", "run_end"} <= types
+        for r in sink.records:
+            validate_record(r)
+
+    def test_serving_stream_validates(self):
+        from bigdl_tpu.serving import InferenceEngine
+        model = nn.Sequential().add(nn.Linear(4, 2)).add(nn.LogSoftMax())
+        sink = InMemorySink()
+        eng = InferenceEngine(model, max_batch_size=4, max_wait_ms=0.5,
+                              telemetry=Telemetry(sink, resources=False),
+                              emit_every=1)
+        try:
+            eng.warmup(Sample(np.ones(4, np.float32)))
+            for _ in range(3):
+                eng.predict(Sample(np.ones(4, np.float32)))
+        finally:
+            eng.close()
+        types = {r["type"] for r in sink.records}
+        assert {"compile", "serving_stats", "serving_summary"} <= types
+        for r in sink.records:
+            validate_record(r)
+
+    def test_event_and_jsonl_round_trip_validate(self, tmp_path):
+        path = str(tmp_path / "v.jsonl")
+        tel = Telemetry(JsonlSink(path), resources=False)
+        tel.step(step=1, loss=float("nan"), lr=0.1, throughput=10.0,
+                 step_time_s=0.01, records=4)
+        tel.event("fault_injected", site="train.step", hit=3, error="e")
+        tel.run_end(step=1, metrics={})
+        tel.close()
+        with open(path) as f:
+            for line in f:
+                validate_record(json.loads(line))
+
+    def test_rejects_contract_violations(self):
+        import time
+        with pytest.raises(ValueError):
+            validate_record({"type": "nope", "time": time.time()})
+        with pytest.raises(ValueError):  # missing required field
+            validate_record({"type": "compile", "time": time.time()})
+        with pytest.raises(ValueError):  # undeclared field, closed type
+            validate_record({"type": "step", "time": time.time(),
+                             "step": 1, "surprise": 1})
+        with pytest.raises(ValueError):  # mistyped
+            validate_record({"type": "step", "time": time.time(),
+                             "step": "one"})
+
+
+# ------------------------------------------------------------------ #
+# strict JSONL (satellite)
+# ------------------------------------------------------------------ #
+class TestStrictJsonl:
+    def _raise(self, tok):
+        raise AssertionError(f"non-strict token {tok!r} in stream")
+
+    def test_nonfinite_encoded_null_with_marker(self, tmp_path):
+        path = str(tmp_path / "s.jsonl")
+        tel = Telemetry(JsonlSink(path), resources=False)
+        tel.step(step=1, loss=float("nan"), throughput=float("inf"),
+                 step_time_s=0.5)
+        tel.run_end(step=1, loss=float("-inf"),
+                    metrics={"phase": {"mean": float("nan"), "count": 2}})
+        tel.close()
+        with open(path) as f:
+            recs = [json.loads(line, parse_constant=self._raise)
+                    for line in f]
+        step, end = recs
+        assert step["loss"] is None and step["loss_nonfinite"] is True
+        assert step["throughput"] is None
+        assert step["throughput_nonfinite"] is True
+        assert step["step_time_s"] == 0.5  # finite fields untouched
+        assert "step_time_s_nonfinite" not in step
+        assert end["loss"] is None and end["loss_nonfinite"] is True
+        assert end["metrics"]["phase"]["mean"] is None  # nested too
+        assert end["metrics"]["phase"]["mean_nonfinite"] is True
+        assert end["metrics"]["phase"]["count"] == 2
+
+    def test_sanitize_handles_lists(self):
+        out = sanitize_nonfinite({"xs": [1.0, float("nan"), "s"]})
+        assert out["xs"] == [1.0, None, "s"]
+
+    def test_training_nan_loss_stays_strict(self, tmp_path):
+        """A genuinely poisoned run's JSONL parses under strict JSON."""
+        path = str(tmp_path / "run.jsonl")
+        rs = np.random.RandomState(0)
+        batches = [MiniBatch(rs.rand(8, 6).astype(np.float32),
+                             (rs.randint(0, 2, 8) + 1).astype(np.int32))
+                   for _ in range(3)]
+        batches[1].get_input()[:] = np.nan
+        model = (nn.Sequential().add(nn.Linear(6, 2))
+                 .add(nn.LogSoftMax()))
+
+        class Ordered(LocalDataSet):  # poison lands on a known step
+            def data(self, train):
+                def looped():
+                    while True:
+                        yield from self.items
+                return looped() if train else iter(self.items)
+
+            def shuffle(self):
+                pass
+
+        opt = LocalOptimizer(model, Ordered(batches),
+                             nn.ClassNLLCriterion())
+        opt.set_optim_method(optim.SGD(learning_rate=0.05))
+        opt.set_end_when(optim.max_iteration(3))
+        opt.set_telemetry(Telemetry(JsonlSink(path), resources=False))
+        opt.optimize()
+        opt.telemetry.close()
+        with open(path) as f:
+            recs = [json.loads(line, parse_constant=self._raise)
+                    for line in f]
+        nan_steps = [r for r in recs if r.get("type") == "step"
+                     and r.get("loss_nonfinite")]
+        assert nan_steps and all(r["loss"] is None for r in nan_steps)
+
+
+# ------------------------------------------------------------------ #
+# health-monitor warm-up (satellite)
+# ------------------------------------------------------------------ #
+class TestMonitorWarmup:
+    def test_straggler_skips_compile_window(self):
+        d = StragglerDetector(factor=3.0, window=8, min_history=1)
+        # cold run: the first sync window is compile-contaminated and
+        # unrepresentative — it must seed nothing
+        d.observe({"step": 1, "step_time_s": 0.01})
+        assert list(d.history) == []
+        d.observe({"step": 2, "step_time_s": 0.04})
+        assert d.stragglers == 0  # would have tripped off the seed
+        d.observe({"step": 3, "step_time_s": 0.04})
+        d.observe({"step": 4, "step_time_s": 0.2})  # a REAL straggler
+        assert d.stragglers == 1
+
+    def test_throughput_monitor_skips_compile_window(self):
+        m = ThroughputMonitor(tolerance=0.3, window=8, min_history=1)
+        m.observe({"step": 1, "throughput": 2.0})  # compile-slow window
+        assert list(m.history) == []
+        m.observe({"step": 2, "throughput": 100.0})
+        m.observe({"step": 3, "throughput": 95.0})
+        assert m.regressions == 0
+        m.observe({"step": 4, "throughput": 50.0})  # a REAL regression
+        assert m.regressions == 1
+
+
+# ------------------------------------------------------------------ #
+# flight recorder (acceptance: fault injection auto-dumps)
+# ------------------------------------------------------------------ #
+class TestFlightRecorder:
+    def test_fault_injection_auto_dumps_tail(self, tmp_path):
+        """Acceptance: injecting a train.step fault via the existing
+        FaultInjector auto-dumps a flight-recorder file whose tail holds
+        the fault_injected event and the preceding step records."""
+        from bigdl_tpu.resilience import FaultInjector, FaultSpec
+        flight = FlightRecorder(dump_dir=str(tmp_path))
+        sink = InMemorySink()
+        rs = np.random.RandomState(0)
+        batches = [MiniBatch(rs.rand(8, 6).astype(np.float32),
+                             (rs.randint(0, 2, 8) + 1).astype(np.int32))
+                   for _ in range(4)]
+        model = (nn.Sequential().add(nn.Linear(6, 2))
+                 .add(nn.LogSoftMax()))
+        opt = LocalOptimizer(model, LocalDataSet(batches),
+                             nn.ClassNLLCriterion())
+        opt.set_optim_method(optim.SGD(learning_rate=0.05))
+        opt.set_end_when(optim.max_iteration(6))
+        tel = Telemetry(sink, resources=False, flight=flight)
+        opt.set_telemetry(tel)
+        opt.set_tracer(SpanTracer())  # dump should carry the span tail
+        plan = FaultInjector(FaultSpec("train.step", at_hit=3),
+                             telemetry=tel)
+        with plan:
+            with pytest.raises(Exception):
+                opt.optimize()
+        assert flight.last_dump_path is not None
+        assert os.path.dirname(flight.last_dump_path) == str(tmp_path)
+        with open(flight.last_dump_path) as f:
+            doc = json.load(f)
+        kinds = [(r.get("type"), r.get("event")) for r in doc["records"]]
+        # tail: the two steps that completed, then cause and effect
+        assert ("step", None) in kinds
+        assert ("event", "fault_injected") in kinds
+        assert ("event", "run_abort") in kinds
+        assert kinds.index(("event", "fault_injected")) > \
+            kinds.index(("step", None))
+        assert doc["spans"], "span tail missing from the dump"
+        assert doc["trigger"] in ("fault_injected", "run_abort")
+
+    def test_ring_is_bounded_and_manual_dump(self, tmp_path):
+        fl = FlightRecorder(capacity=4, dump_dir=str(tmp_path))
+        tel = Telemetry(InMemorySink(), resources=False, flight=fl)
+        for i in range(10):
+            tel.step(step=i, loss=0.1)
+        assert [r["step"] for r in fl.records()] == [6, 7, 8, 9]
+        path = fl.dump(str(tmp_path / "manual.json"))
+        with open(path) as f:
+            doc = json.load(f)
+        assert doc["trigger"] == "manual"
+        assert len(doc["records"]) == 4
+
+    def test_nan_guard_raise_dumps(self, tmp_path):
+        fl = FlightRecorder(dump_dir=str(tmp_path))
+        tel = Telemetry(InMemorySink(), resources=False, flight=fl)
+        g = NanGuard(action="raise")
+        from bigdl_tpu.observability import TrainingHealthError
+        with pytest.raises(TrainingHealthError):
+            g.observe({"step": 5, "loss": float("inf")}, tel)
+        assert fl.last_dump_path is not None
+        with open(fl.last_dump_path) as f:
+            doc = json.load(f)
+        assert doc["trigger"] == "nan_guard_raise"
+
+    def test_flight_disabled(self):
+        tel = Telemetry(InMemorySink(), resources=False, flight=False)
+        assert tel.flight is None
+        tel.step(step=1, loss=0.5)  # no ring, no crash
+
+
+# ------------------------------------------------------------------ #
+# Prometheus export (acceptance: /metrics valid exposition + clean join)
+# ------------------------------------------------------------------ #
+_SAMPLE_RE = __import__("re").compile(
+    r"^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^}]*\})? "
+    r"(-?\d+(\.\d+)?([eE][+-]?\d+)?|[+-]Inf|NaN)$")
+
+
+class TestPrometheusExport:
+    def _step_record(self):
+        return {"type": "step", "time": 1.0, "step": 7, "epoch": 1,
+                "loss": 0.25, "lr": 0.05, "throughput": 1234.5,
+                "step_time_s": 0.01, "records": 32,
+                "flops_per_step": 1.0e9, "bytes_accessed": 2.0e8,
+                "mfu": 0.303}
+
+    def test_metrics_server_serves_valid_exposition(self, tmp_path):
+        from bigdl_tpu.serving import InferenceEngine
+        model = nn.Sequential().add(nn.Linear(4, 2)).add(nn.LogSoftMax())
+        prom = PrometheusTextSink()
+        tel = Telemetry(prom, resources=False, flight=False)
+        eng = InferenceEngine(model, max_batch_size=4, max_wait_ms=0.5,
+                              telemetry=tel, emit_every=1,
+                              breaker={"failure_threshold": 2})
+        prom.track_engine(eng)
+        baseline = {t for t in threading.enumerate() if not t.daemon}
+        server = MetricsServer(prom)
+        try:
+            eng.warmup(Sample(np.ones(4, np.float32)))
+            eng.predict(Sample(np.ones(4, np.float32)))
+            prom.emit(self._step_record())  # a TPU-shaped step record
+            body = urllib.request.urlopen(server.url, timeout=10) \
+                .read().decode()
+        finally:
+            eng.close()
+            server.close()
+        lines = [l for l in body.splitlines() if l.strip()]
+        assert lines, "empty exposition"
+        helped, typed = set(), {}
+        for line in lines:
+            if line.startswith("# HELP "):
+                helped.add(line.split()[2])
+            elif line.startswith("# TYPE "):
+                typed[line.split()[2]] = line.split()[3]
+            else:
+                assert _SAMPLE_RE.match(line), f"bad sample line: {line!r}"
+        # every sample's family carries TYPE and HELP headers
+        for line in lines:
+            if not line.startswith("#"):
+                name = line.split("{")[0].split(" ")[0]
+                base = name
+                for suffix in ("_count",):
+                    if base.endswith(suffix) and base not in typed:
+                        base = base[: -len(suffix)]
+                assert base in typed and base in helped, name
+        # acceptance samples: step MFU gauge, serving latency quantiles,
+        # per-bucket breaker state
+        assert typed["bigdl_tpu_step_mfu"] == "gauge"
+        assert any(l.startswith("bigdl_tpu_step_mfu 0.303")
+                   for l in lines)
+        assert typed["bigdl_tpu_serving_latency_ms"] == "summary"
+        assert any(l.startswith('bigdl_tpu_serving_latency_ms{quantile='
+                                '"0.99"}') for l in lines)
+        assert typed["bigdl_tpu_serving_breaker_state"] == "gauge"
+        breaker_lines = [l for l in lines if l.startswith(
+            "bigdl_tpu_serving_breaker_state{bucket=")]
+        assert breaker_lines and all(l.endswith(" 0")
+                                     for l in breaker_lines)  # closed
+        assert typed["bigdl_tpu_serving_submitted_total"] == "counter"
+        # the serve thread joined: no non-daemon thread outlives close()
+        leaked = [t for t in threading.enumerate()
+                  if not t.daemon and t.is_alive() and t not in baseline]
+        assert not leaked, leaked
+
+    def test_close_idempotent_and_404(self):
+        prom = PrometheusTextSink()
+        prom.emit(self._step_record())
+        with MetricsServer(prom) as server:
+            url = f"http://127.0.0.1:{server.port}/other"
+            with pytest.raises(urllib.error.HTTPError):
+                urllib.request.urlopen(url, timeout=10)
+        server.close()  # second close is a no-op
+
+    def test_two_tracked_engines_render_unique_samples(self):
+        """Two engines sharing bucket shapes must not emit duplicate
+        label sets — a Prometheus scraper rejects the whole exposition;
+        the per-engine label disambiguates."""
+        from bigdl_tpu.serving import InferenceEngine
+        model = nn.Sequential().add(nn.Linear(4, 2)).add(nn.LogSoftMax())
+        prom = PrometheusTextSink()
+        engines = [InferenceEngine(model, max_batch_size=4,
+                                   max_wait_ms=0.5,
+                                   breaker={"failure_threshold": 2})
+                   for _ in range(2)]
+        try:
+            for e in engines:
+                prom.track_engine(e)
+                e.predict(Sample(np.ones(4, np.float32)))
+            body = prom.render()
+            samples = [l for l in body.splitlines()
+                       if l and not l.startswith("#")]
+            assert len(samples) == len(set(samples)), samples
+            assert sum("serving_breaker_state{" in l
+                       for l in samples) == 2
+            assert sum("serving_engine_up{" in l for l in samples) == 2
+        finally:
+            for e in engines:
+                e.close()
+
+    def test_render_skips_nonfinite_and_none(self):
+        prom = PrometheusTextSink()
+        rec = self._step_record()
+        rec["mfu"] = None
+        rec["throughput"] = float("nan")
+        prom.emit(rec)
+        body = prom.render()
+        assert "bigdl_tpu_step_mfu" not in body
+        assert "bigdl_tpu_step_throughput" not in body
+        assert "bigdl_tpu_step_loss 0.25" in body
+
+
+# ------------------------------------------------------------------ #
+# metrics_cli (satellite: CI smoke — report exits 0 on a LeNet run)
+# ------------------------------------------------------------------ #
+class TestMetricsCli:
+    def test_report_exits_zero_on_lenet_run(self, tmp_path, capsys):
+        from bigdl_tpu.tools import metrics_cli
+        path = str(tmp_path / "lenet.jsonl")
+        sink = JsonlSink(path)
+        opt = _lenet_opt(sink, iters=2)
+        opt.optimize()
+        opt.telemetry.close()
+        assert metrics_cli.main(["report", path]) == 0
+        out = capsys.readouterr().out
+        assert "flops_per_step" in out
+        assert "compiles" in out
+        assert "host vs device phase table" in out
+
+    def test_report_bad_path_exits_nonzero(self, tmp_path):
+        from bigdl_tpu.tools import metrics_cli
+        assert metrics_cli.main(
+            ["report", str(tmp_path / "missing.jsonl")]) == 2
+        assert metrics_cli.main([]) == 2

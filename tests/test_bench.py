@@ -128,3 +128,34 @@ def test_headline_child_plumbing():
     assert info["device_platform"] == "cpu"
     assert info["n_dev"] >= 1
     assert info["flops"] is None or info["flops"] > 0
+
+
+def test_bench_telemetry_attribution_passthrough(tmp_path, monkeypatch,
+                                                 capsys):
+    """--attribution: a telemetry-wired bench run prints the metrics_cli
+    attribution report to stderr after closing its JSONL stream."""
+    import bigdl_tpu.nn as nn
+    import bigdl_tpu.optim as optim
+    from bigdl_tpu.dataset.dataset import LocalDataSet
+    from bigdl_tpu.dataset.sample import MiniBatch
+    from bigdl_tpu.optim.local_optimizer import LocalOptimizer
+    from bigdl_tpu.tools.bench_cli import _bench_telemetry
+
+    monkeypatch.setenv("BIGDL_TPU_TELEMETRY", str(tmp_path))
+    monkeypatch.setenv("BIGDL_TPU_ATTRIBUTION", "1")
+    rs = np.random.RandomState(0)
+    batches = [MiniBatch(rs.rand(8, 6).astype(np.float32),
+                         (rs.randint(0, 2, 8) + 1).astype(np.int32))
+               for _ in range(2)]
+    model = nn.Sequential().add(nn.Linear(6, 2)).add(nn.LogSoftMax())
+    opt = LocalOptimizer(model, LocalDataSet(batches),
+                         nn.ClassNLLCriterion())
+    opt.set_optim_method(optim.SGD(learning_rate=0.05))
+    opt.set_end_when(optim.max_iteration(2))
+    with _bench_telemetry(opt):
+        opt.optimize()
+    err = capsys.readouterr().err
+    assert "host vs device phase table" in err
+    assert "flops_per_step" in err
+    jsonls = list(tmp_path.glob("bench_*_r*.jsonl"))
+    assert jsonls, "telemetry stream not recorded"

@@ -13,6 +13,7 @@ import pytest
 import bigdl_tpu.dataset
 import bigdl_tpu.keras
 import bigdl_tpu.nn
+import bigdl_tpu.observability
 import bigdl_tpu.ops
 import bigdl_tpu.optim
 import bigdl_tpu.parallel
@@ -22,7 +23,8 @@ import bigdl_tpu.tensor
 
 _PACKAGES = (bigdl_tpu.nn, bigdl_tpu.keras, bigdl_tpu.ops,
              bigdl_tpu.parallel, bigdl_tpu.optim, bigdl_tpu.tensor,
-             bigdl_tpu.dataset, bigdl_tpu.serving, bigdl_tpu.resilience)
+             bigdl_tpu.dataset, bigdl_tpu.serving, bigdl_tpu.resilience,
+             bigdl_tpu.observability)
 
 
 def _modules_with_doctests():
