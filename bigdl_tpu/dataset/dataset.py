@@ -36,11 +36,32 @@ class AbstractDataSet:
 
 class LocalDataSet(AbstractDataSet):
     """In-memory dataset; `train=True` iteration is infinite-with-reshuffle
-    like the reference's looped iterator (DataSet.scala:139-158)."""
+    like the reference's looped iterator (DataSet.scala:139-158).
+
+    Carries a restorable ITERATION CURSOR for checkpoint/resume: the rng
+    state and item order are snapshotted at each training-pass start (one
+    permutation draw), and epoch-boundary `shuffle()` calls landing
+    mid-pass record their stream position. `cursor()` captures all of it;
+    `restore_cursor()` reproduces the exact item stream — including the
+    boundary-shuffle interleaving under the driver's one-batch lookahead —
+    so a resumed run continues mid-epoch without replaying whole passes
+    (the pre-cursor `_fast_forward_data` fallback) and without assuming
+    the dataset rng sits at its origin."""
 
     def __init__(self, items: Sequence, seed: int = 1):
         self.items = list(items)
         self._rng = np.random.RandomState(seed)
+        # cursor bookkeeping: `_order` maps current positions to ORIGINAL
+        # item indices; `_pass_*` snapshot the state of the current
+        # training pass (set at each permutation draw)
+        self._order = list(range(len(self.items)))
+        self._pass_counter = 0
+        self._pass_rng_state = None
+        self._pass_order = None
+        self._pass_served = 0
+        self._pass_shuffles: list = []
+        self._replay_shuffles = None  # armed by restore_cursor
+        self._skip_items = 0          # armed by restore_cursor
 
     def data(self, train: bool) -> Iterator:
         if not train:
@@ -48,9 +69,33 @@ class LocalDataSet(AbstractDataSet):
 
         def looped():
             while True:
+                self._pass_counter += 1
+                self._pass_rng_state = self._rng.get_state()
+                self._pass_order = list(self._order)
+                self._pass_shuffles = []
+                self._pass_served = 0
                 idx = self._rng.permutation(len(self.items))
+                # one-shot restore support: re-apply the original run's
+                # mid-pass shuffle() calls at their recorded stream
+                # positions (the resumed driver won't call them — its
+                # epoch counters say mid-epoch), and silently drop the
+                # items the original already trained on
+                replay = self._replay_shuffles
+                self._replay_shuffles = None
+                skip = self._skip_items
+                self._skip_items = 0
                 for i in idx:
+                    while replay and replay[0] <= self._pass_served:
+                        replay.pop(0)
+                        self.shuffle()
+                    self._pass_served += 1
+                    if skip > 0:
+                        skip -= 1
+                        continue
                     yield self.items[i]
+                while replay:  # shuffle recorded at end-of-pass position
+                    replay.pop(0)
+                    self.shuffle()
 
         return looped()
 
@@ -58,7 +103,86 @@ class LocalDataSet(AbstractDataSet):
         return len(self.items)
 
     def shuffle(self):
-        self._rng.shuffle(self.items)
+        # shuffle by index permutation — draw-for-draw identical to
+        # `rng.shuffle(self.items)` (same Fisher-Yates over the same n) —
+        # so `_order` can track item positions for the cursor
+        idx = np.arange(len(self.items))
+        self._rng.shuffle(idx)
+        self.items = [self.items[i] for i in idx]
+        self._order = [self._order[i] for i in idx]
+        if self._pass_rng_state is not None:
+            self._pass_shuffles.append(self._pass_served)
+
+    def position(self) -> dict:
+        """The training stream's current position: which pass, and how
+        many items of it have been served. The optimizer samples this
+        after each pull so a checkpoint's cursor can point at the last
+        TRAINED batch (one pull behind the lookahead)."""
+        return {"pass": self._pass_counter, "served": self._pass_served}
+
+    def cursor(self, position: Optional[dict] = None) -> dict:
+        """Snapshot of the training stream at `position` (a `position()`
+        sample; default: here and now), checkpointable as part of the v2
+        optimizer blob. Captures the current pass's starting rng state +
+        item order (so the permutation re-draws identically), any
+        mid-pass shuffle positions, and how many items of the pass the
+        position has consumed. Raises `ValueError` for a position
+        outside the current pass (e.g. a single pull consumed more than
+        one whole pass) — callers fall back to full-pass replay."""
+        n = len(self.items)
+        if position is None:
+            position = self.position()
+        if self._pass_rng_state is None:  # no training pass started yet
+            return {"version": 2, "n_items": n,
+                    "pass_rng_state": self._rng.get_state(),
+                    "pass_order": list(self._order),
+                    "shuffles_at": [], "skip": 0}
+        if position["pass"] == self._pass_counter:
+            skip = int(position["served"])
+        elif position["pass"] == self._pass_counter - 1 and \
+                position["served"] >= n:
+            # the position sits exactly on the previous pass's end: the
+            # current pass (whose permutation the lookahead pull already
+            # drew) starts from item 0
+            skip = 0
+        else:
+            raise ValueError(
+                f"position {position} does not fall in the current pass "
+                f"({self._pass_counter})")
+        return {"version": 2, "n_items": n,
+                "pass_rng_state": self._pass_rng_state,
+                "pass_order": list(self._pass_order),
+                "shuffles_at": list(self._pass_shuffles),
+                "skip": skip}
+
+    def restore_cursor(self, cur: dict):
+        """Rewind this dataset to a `cursor()` snapshot: item order and
+        rng back to the captured pass start, boundary shuffles armed for
+        in-stream replay, already-trained items skipped inside the
+        reconstructed stream. Call BEFORE the first training pull.
+        Raises `ValueError` when the cursor does not match this dataset
+        (item count drift)."""
+        order = list(cur["pass_order"])
+        if cur.get("n_items") != len(self.items) or \
+                sorted(order) != list(range(len(self.items))):
+            raise ValueError(
+                f"cursor does not match this dataset: cursor has "
+                f"{cur.get('n_items')} items, dataset has "
+                f"{len(self.items)}")
+        # map back through the CURRENT order (the dataset may itself have
+        # been shuffled already — warm retry path), then into pass order
+        original = [None] * len(self.items)
+        for pos, oi in enumerate(self._order):
+            original[oi] = self.items[pos]
+        self.items = [original[oi] for oi in order]
+        self._order = order
+        self._rng.set_state(cur["pass_rng_state"])
+        self._replay_shuffles = list(cur.get("shuffles_at") or [])
+        self._skip_items = int(cur.get("skip", 0))
+        self._pass_rng_state = None
+        self._pass_order = None
+        self._pass_served = 0
+        self._pass_shuffles = []
 
 
 class DistributedDataSet(LocalDataSet):
@@ -100,6 +224,15 @@ class _TransformedDataSet(AbstractDataSet):
 
     def shuffle(self):
         self.base.shuffle()
+
+    def position(self) -> dict:
+        return self.base.position()
+
+    def cursor(self, position: Optional[dict] = None) -> dict:
+        return self.base.cursor(position=position)
+
+    def restore_cursor(self, cur: dict):
+        return self.base.restore_cursor(cur)
 
 
 class DataSet:

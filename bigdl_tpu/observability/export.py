@@ -74,11 +74,17 @@ class PrometheusTextSink(TelemetrySink):
     dropped engine disappears from the exposition instead of pinning
     itself in memory."""
 
+    #: membership/elastic events whose newest occurrence drives the
+    #: fleet-capacity gauges (`degraded_capacity`, `workers_alive`, ...).
+    _FLEET_EVENTS = ("worker_lost", "worker_joined", "elastic_shrink",
+                     "elastic_grow", "elastic_rebuild")
+
     def __init__(self, namespace: str = "bigdl_tpu"):
         self.namespace = namespace
         self._lock = threading.Lock()
         self._step: Dict = {}
         self._serving: Dict = {}
+        self._fleet: Dict = {}  # newest membership/elastic event
         self._counts: Dict[str, int] = {}  # records seen by type
         self._engines: List = []  # (label, weakref) pairs
 
@@ -91,6 +97,13 @@ class PrometheusTextSink(TelemetrySink):
                 self._step = dict(record)
             elif rtype in ("serving_stats", "serving_summary"):
                 self._serving = dict(record)
+            elif rtype == "event" and \
+                    record.get("event") in self._FLEET_EVENTS:
+                # MERGE, don't replace: worker_* events carry alive/total
+                # while elastic_* carry n_active/alive_workers — a
+                # wholesale swap would flap series in and out of the
+                # exposition (Prometheus reads that as staleness)
+                self._fleet.update(record)
 
     def track_engine(self, engine,
                      name: Optional[str] = None) -> "PrometheusTextSink":
@@ -134,6 +147,7 @@ class PrometheusTextSink(TelemetrySink):
         with self._lock:
             step = dict(self._step)
             serving = dict(self._serving)
+            fleet = dict(self._fleet)
             counts = dict(self._counts)
             engines = list(self._engines)
         lines: List[str] = []
@@ -168,6 +182,25 @@ class PrometheusTextSink(TelemetrySink):
                 continue
             self._sample(lines, f"step_{field}", "gauge", help_,
                          [(None, val)])
+        # --- fleet capacity: from the newest membership/elastic event,
+        # so a scrape sees a shrunken fleet the moment training degrades
+        # (0.0 = full capacity, 0.5 = half the devices lost)
+        if "alive" not in fleet and "alive_workers" in fleet:
+            fleet["alive"] = fleet["alive_workers"]  # elastic_* spelling
+        for field, name, help_ in (
+                ("degraded_capacity", "degraded_capacity",
+                 "Fraction of registered training device capacity "
+                 "currently lost (0 = full fleet)."),
+                ("alive", "workers_alive",
+                 "Worker-registry members currently alive."),
+                ("total", "workers_total",
+                 "Worker-registry members registered."),
+                ("n_active", "elastic_active_devices",
+                 "Devices the elastic training loop is running on."),
+        ):
+            val = fleet.get(field)
+            if isinstance(val, (int, float)) and not isinstance(val, bool):
+                self._sample(lines, name, "gauge", help_, [(None, val)])
         # --- serving counters / gauges / summaries
         for field in _SERVING_COUNTERS:
             val = serving.get(field)

@@ -89,6 +89,7 @@ class DistriOptimizer(BaseOptimizer):
         self._param_shardings = None
         self._pristine_params = None
         self._pristine_state = None
+        self._elastic = None
 
     # ------------------------------------------------------------------ #
     @property
@@ -223,6 +224,18 @@ class DistriOptimizer(BaseOptimizer):
 
     def optimize(self) -> Module:
         self._maybe_optimize_graph()
+        if self._preemption is not None:
+            # clear any stale latch from a previous preempted run before
+            # re-arming (train-more on the same instance must train)
+            self._preemption.reset()
+            self._preemption.install()
+        try:
+            return self._optimize_with_retry()
+        finally:
+            if self._preemption is not None:
+                self._preemption.uninstall()
+
+    def _optimize_with_retry(self) -> Module:
         policy = self._retry_policy()
         attempt = 0
         backoff_spent = 0.0
@@ -283,6 +296,11 @@ class DistriOptimizer(BaseOptimizer):
                     policy.sleep(delay)
 
     def _optimize_impl(self) -> Module:
+        if self._elastic is not None:
+            # elastic (preemption-tolerant) mode runs the deterministic
+            # per-replica loop; non-elastic-recoverable failures fall
+            # through to the same job-level retry wrapping this call
+            return self._optimize_elastic_impl()
         mesh = self.mesh
         params = self.model.ensure_params()
         model_state = self.model._state
@@ -308,6 +326,7 @@ class DistriOptimizer(BaseOptimizer):
             self.dataset.size() * num_hosts
         _, src = self._open_data_pipeline()
         data_iter = self._fast_forward_data(src, driver_state)
+        self._init_cursor_positions()
         n_dev = int(np.prod(mesh.devices.shape))
 
         def fetch_and_place():
@@ -335,6 +354,7 @@ class DistriOptimizer(BaseOptimizer):
                         "trigger fired; stopping early (train=True datasets "
                         "normally loop forever)")
                     return None
+                self._note_pull()
             with Timer(self.metrics, "put batch on mesh"), \
                     self._span("put batch on mesh"):
                 x = batch.get_input()
@@ -356,6 +376,7 @@ class DistriOptimizer(BaseOptimizer):
         loss_val = float("nan")  # last synced loss
         loss = None  # device array of the most recent step's loss
         lr = None
+        preempted = False
         aux_pending = []  # per-dispatch instrumentation scalars (tiny)
         # device-resident rng chain, advanced inside the donated step; a
         # COPY so self.rng survives donation and the retry path can seed a
@@ -447,6 +468,10 @@ class DistriOptimizer(BaseOptimizer):
                                           opt_slots=opt_state)
             if self.iteration_hook is not None:
                 self.iteration_hook(driver_state)
+            if self._check_preemption(params, model_state, opt_state,
+                                      driver_state, loss):
+                preempted = True
+                break
             if do_sync:
                 win.restart()  # exclude the tail work from the next window
 
@@ -458,12 +483,494 @@ class DistriOptimizer(BaseOptimizer):
             # partial tail window: guards/monitors still see those steps
             self._observe_sync(driver_state, loss_val, lr, float("nan"),
                                float("nan"), 0, aux_pending)
-        self._telemetry_run_end(driver_state)
+        if not preempted:  # a preempted run already closed with run_abort
+            self._telemetry_run_end(driver_state)
         # persist the advanced rng chain so a subsequent optimize() call
         # (resume / train-more) continues the dropout/noise stream instead
         # of replaying it (LocalOptimizer advances self.rng the same way)
         self.rng = jax.device_get(rng_dev)
         # gather back to host (reference getModel:646 pulls partitions)
+        self.model.set_params(jax.device_get(params))
+        self.model._state = jax.device_get(model_state)
+        return self.model
+
+
+    # ------------------------------------------------------------------ #
+    # Elastic (preemption-tolerant) mode
+    # ------------------------------------------------------------------ #
+    def set_elastic(self, logical_replicas: Optional[int] = None,
+                    registry=None, controller=None, min_devices: int = 1,
+                    max_recoveries_per_window: int = 8,
+                    enabled: bool = True):
+        """Arm elastic preemption-tolerant training: when a replica
+        device disappears mid-step (real, or injected at the
+        `mesh.device_loss` / `mesh.collective` fault sites), the loop
+        rolls back to the last committed sync boundary, rebuilds over the
+        surviving devices, re-shards params + optimizer state, and
+        deterministically REPLAYS the interrupted global batches; when
+        capacity returns (a `WorkerRegistry` heartbeat revives a lost
+        worker) it grows back at the next sync-window boundary.
+
+        Determinism contract: the global batch is always processed as
+        `logical_replicas` fixed logical gradient shards (default: the
+        mesh size at arm time), each computed by an IDENTICAL per-shard
+        executable on whichever device currently owns it, and reduced in
+        a FIXED sequential order on the lead device. The loss trajectory
+        at matched sample counts is therefore bit-identical across any
+        shrink/replay/grow history — plain SPMD resharding is not (the
+        partial-reduction order changes with the mesh shape; measured on
+        this backend). The price: per-shard dispatch + an explicit
+        fixed-order reduction instead of one fused SPMD step, and a host
+        params snapshot per commit window — elastic mode trades peak
+        throughput for survivable training, so prefer
+        `set_sync_interval(k)` > 1 to amortize commits.
+
+        Constraints: data-parallel only (mesh `model` axis must be 1),
+        the global batch must divide by `logical_replicas`, and gradient
+        accumulation is not supported (checked at optimize time).
+        `registry` defaults to one worker per mesh device with an
+        effectively infinite lease (in-process liveness comes from
+        exceptions + probes, not heartbeats); pass a
+        `SimulatedCluster(...).registry` or a real heartbeat-fed registry
+        to model multi-host fleets. `max_recoveries_per_window` bounds
+        consecutive recoveries between commits — a deterministic
+        "recoverable" error must eventually surface to the job-level
+        retry instead of livelocking the replay loop.
+        `set_elastic(enabled=False)` disarms.
+        """
+        if not enabled:
+            self._elastic = None
+            return self
+        shape = dict(zip(self.mesh.axis_names, self.mesh.devices.shape))
+        if shape.get("model", 1) != 1:
+            raise ValueError(
+                "elastic training is data-parallel only: build the mesh "
+                f"with model=1 (got model={shape.get('model')})")
+        from bigdl_tpu.resilience.elastic import ElasticController
+        from bigdl_tpu.resilience.membership import WorkerRegistry
+        if registry is None:
+            registry = WorkerRegistry(lease_s=float("inf"))
+            for i, d in enumerate(self.mesh.devices.reshape(-1)):
+                registry.register(f"worker{i}", [d])
+        if controller is None:
+            if logical_replicas is None:
+                logical_replicas = max(1, registry.total_devices())
+            controller = ElasticController(logical_replicas,
+                                           min_devices=min_devices)
+        if max_recoveries_per_window < 1:
+            raise ValueError(f"max_recoveries_per_window must be >= 1, "
+                             f"got {max_recoveries_per_window}")
+        self._elastic = {"registry": registry, "controller": controller,
+                         "max_recoveries": int(max_recoveries_per_window)}
+        return self
+
+    setElastic = set_elastic
+
+    def _build_elastic_shard_fn(self):
+        """One jitted per-logical-shard (loss, grads, new_state) fn. The
+        SAME function object serves every shard on every device — jax
+        caches one executable per device placement, and identical HLO on
+        identical device types is what makes shard results independent of
+        WHICH device computed them (the elastic determinism contract)."""
+        model, criterion = self.model, self.criterion
+        precision_scope = self._precision_scope
+        mixed = self._mixed_bf16
+        cast = self._cast_floats
+
+        def shard_step(params, model_state, x, y, rng):
+            def loss_fn(p):
+                with precision_scope():
+                    xc = cast(x, jnp.bfloat16) if mixed else x
+                    if mixed:
+                        p = cast(p, jnp.bfloat16)
+                    out, new_ms = functional_apply(model, p, xc,
+                                                   state=model_state,
+                                                   training=True, rng=rng)
+                    if mixed:
+                        out = cast(out, jnp.float32)
+                    return criterion.apply(out, y), new_ms
+            (l, new_ms), g = jax.value_and_grad(loss_fn,
+                                                has_aux=True)(params)
+            return l, g, new_ms
+
+        return jax.jit(shard_step)
+
+    def _build_elastic_combine(self, R0: int):
+        """Jitted fixed-order reduction + weight update on the lead
+        device: sum the R0 shard gradients SEQUENTIALLY (never a psum —
+        reduction order must not depend on the mesh shape), mean, clip,
+        update. Model-state float leaves average the same way."""
+        optim = self.optim_method
+        clip = self._clip_grads_expr
+
+        def combine(params, opt_state, lr, losses, grads, states):
+            g = grads[0]
+            for gi in grads[1:]:
+                g = jax.tree_util.tree_map(jnp.add, g, gi)
+            g = jax.tree_util.tree_map(lambda a: a / R0, g)
+            g = clip(g)
+            new_params, new_opt = optim.update(g, opt_state, params, lr)
+            loss = losses[0]
+            for li in losses[1:]:
+                loss = loss + li
+            loss = loss / R0
+
+            def avg(*ls):
+                a = ls[0]
+                if not (hasattr(a, "dtype")
+                        and jnp.issubdtype(a.dtype, jnp.floating)):
+                    return a  # counters etc. take shard 0's value
+                s = a
+                for o in ls[1:]:
+                    s = s + o
+                return s / R0
+
+            ms = states[0] if R0 == 1 else \
+                jax.tree_util.tree_map(avg, *states)
+            return new_params, new_opt, ms, loss
+
+        return jax.jit(combine)
+
+    @staticmethod
+    def _elastic_recoverable(e: BaseException) -> bool:
+        """Failures the elastic loop recovers from in-process: the
+        device-loss/collective vocabulary (real or injected) plus raw
+        backend runtime errors (a dying device usually surfaces as one).
+        Everything else propagates to the job-level retry."""
+        from bigdl_tpu.resilience.membership import (CollectiveError,
+                                                     DeviceLossError)
+        if isinstance(e, (DeviceLossError, CollectiveError)):
+            return True
+        return type(e).__name__ in ("XlaRuntimeError", "JaxRuntimeError")
+
+    @staticmethod
+    def _probe_dead_devices(devices) -> List:
+        """Liveness probe: a host->device->host round trip per device.
+        Devices that cannot complete it are reported dead (on a real
+        slice a preempted host's devices fail here; injected faults carry
+        their losses explicitly and skip the probe)."""
+        dead = []
+        for d in devices:
+            try:
+                x = jax.device_put(np.zeros((2,), np.float32), d)
+                np.asarray(jax.device_get(x))
+            except Exception:
+                dead.append(d)
+        return dead
+
+    def _optimize_elastic_impl(self) -> Module:
+        """The elastic driver loop: per-replica dispatch with
+        commit/rollback/replay.
+
+        Commit points (sync boundaries + epoch boundaries) snapshot
+        params / optimizer slots / model state / rng / driver counters to
+        host and clear the replay buffer; every host batch consumed since
+        the last commit is retained. On a recoverable failure: mark
+        losses in the registry, replan over survivors
+        (`elastic_shrink` / `elastic_rebuild`), restore the committed
+        snapshot onto the new lead, and feed the retained batches back
+        through the loop (`elastic_replay`) — bit-identical to the
+        uninterrupted trajectory because shards, shard rng streams, and
+        reduction order are all fixed by logical index, not by device.
+        Epoch boundaries always commit, so a rollback never crosses a
+        dataset reshuffle."""
+        import collections
+
+        from bigdl_tpu.resilience.elastic import InsufficientCapacityError
+
+        cfg = self._elastic
+        registry, controller = cfg["registry"], cfg["controller"]
+        R0 = controller.logical_replicas
+        max_recoveries = cfg.get("max_recoveries", 8)
+        if int(getattr(self, "grad_accum_steps", 1) or 1) > 1:
+            raise ValueError(
+                "elastic mode does not support gradient accumulation: "
+                "unset set_gradient_accumulation, or raise "
+                "logical_replicas instead (shards already bound peak "
+                "activation memory)")
+        if registry.telemetry is None and self.telemetry is not None:
+            registry.telemetry = self.telemetry
+        self._step_fn = None  # no compiled-step attribution in elastic mode
+
+        def place(tree, d):
+            return jax.tree_util.tree_map(
+                lambda l: jax.device_put(l, d), tree)
+
+        registry.sweep()
+        total_dev = registry.total_devices()
+        plan = controller.plan(registry.alive_devices(), total_dev)
+        lead = plan.lead
+
+        params = place(self.model.ensure_params(), lead)
+        model_state = place(self.model._state, lead)
+        resume_slots = getattr(self, "_resume_slots", None)
+        if resume_slots is not None:
+            opt_state = place(jax.tree_util.tree_map(np.asarray,
+                                                     resume_slots), lead)
+            self._resume_slots = None
+        else:
+            opt_state = self.optim_method.init_state(params)
+        shard_fn = self._build_elastic_shard_fn()
+        combine_fn = self._build_elastic_combine(R0)
+        driver_state = self.optim_method.state
+        num_hosts = getattr(self.dataset, "num_hosts", 1)
+        epoch_size = getattr(self.dataset, "global_size", None) or \
+            self.dataset.size() * num_hosts
+        _, src = self._open_data_pipeline()
+        data_iter = self._fast_forward_data(src, driver_state)
+        self._init_cursor_positions()
+        rng = jnp.asarray(self.rng) + 0  # host-driven chain, committable
+
+        sync_every = max(1, int(getattr(self, "sync_interval", 1)))
+        self._telemetry_run_start("distri_elastic")
+        win = self._SyncWindow()
+        loss_val = float("nan")
+        loss = None
+        lr = None
+        preempted = False
+        recoveries = 0  # consecutive recoveries with no committed progress
+        replay_q = collections.deque()  # batches awaiting re-training
+        window_batches: List = []       # batches consumed since commit
+
+        def fetch():
+            if replay_q:
+                b = replay_q.popleft()
+                # mid-replay the live stream position is AHEAD of the
+                # trained position — checkpoints taken before the queue
+                # drains must not carry a cursor (the next real pull
+                # re-validates: everything buffered is retrained by then)
+                self._cursor_valid = False
+            else:
+                with Timer(self.metrics, "data fetch time"), \
+                        self._span("data fetch"):
+                    b = next(data_iter, None)
+                if b is None:
+                    logger.warning(
+                        "training data stream exhausted before the end "
+                        "trigger fired; stopping early")
+                else:
+                    self._note_pull()
+            if b is not None:
+                window_batches.append(b)
+            return b
+
+        def commit():
+            return {"params": jax.device_get(params),
+                    "opt": jax.device_get(opt_state),
+                    "ms": jax.device_get(model_state),
+                    "rng": jax.device_get(rng),
+                    "state": dict(driver_state),
+                    "loss_val": loss_val}
+
+        committed = commit()
+        while not self.end_trigger(driver_state):
+            batch = fetch()
+            if batch is None:
+                break
+            step_no = driver_state["neval"] + 1
+            try:
+                faults.fire("train.step", step=step_no)
+                faults.fire("mesh.device_loss", step=step_no,
+                            n_active=plan.n_active)
+                lr = self.optim_method.current_lr()
+                rng, step_rng = jax.random.split(rng)
+                # shard rng streams key off the LOGICAL index — a shard's
+                # dropout/noise draw survives remapping to another device
+                shard_rngs = jax.random.split(step_rng, R0)
+                xs = controller.split_batch(batch.get_input())
+                ys = controller.split_batch(batch.get_target())
+                with self._span("step dispatch", step=step_no):
+                    per_dev = {}
+                    for d in plan.devices:
+                        per_dev[d] = (params, model_state) if d is lead \
+                            else (place(params, d), place(model_state, d))
+                    losses_d, grads_d, ms_d = [], [], []
+                    for i in range(R0):
+                        d = controller.shard_device(plan, i)
+                        p_d, ms_dv = per_dev[d]
+                        l_i, g_i, m_i = shard_fn(
+                            p_d, ms_dv, jax.device_put(xs[i], d),
+                            jax.device_put(ys[i], d),
+                            jax.device_put(shard_rngs[i], d))
+                        if d is not lead:
+                            l_i = jax.device_put(l_i, lead)
+                            g_i = place(g_i, lead)
+                            m_i = place(m_i, lead)
+                        losses_d.append(l_i)
+                        grads_d.append(g_i)
+                        ms_d.append(m_i)
+                    faults.fire("mesh.collective", step=step_no,
+                                n_active=plan.n_active)
+                    params, opt_state, new_ms, loss = combine_fn(
+                        params, opt_state, lr, tuple(losses_d),
+                        tuple(grads_d), tuple(ms_d))
+                do_sync = step_no % sync_every == 0
+                if do_sync:
+                    with self._span("loss sync"):
+                        loss_val = float(loss)
+            except (KeyboardInterrupt, SystemExit):
+                raise
+            except Exception as e:
+                if not self._elastic_recoverable(e):
+                    raise
+                recoveries += 1
+                if recoveries > max_recoveries:
+                    # no committed progress across max_recoveries replay
+                    # cycles: the "recoverable" failure is deterministic —
+                    # surface it to the bounded job-level retry instead
+                    # of livelocking the replay loop
+                    logger.error(
+                        "elastic recovery made no progress after %d "
+                        "consecutive attempts; giving up on in-process "
+                        "recovery", max_recoveries)
+                    raise
+                lost = tuple(getattr(e, "lost", ()) or ())
+                if lost:
+                    for w in lost:
+                        if isinstance(w, str):
+                            registry.mark_lost(w, reason=repr(e))
+                        else:
+                            registry.mark_device_lost(w, reason=repr(e))
+                else:
+                    for d in self._probe_dead_devices(plan.devices):
+                        registry.mark_device_lost(d, reason=repr(e))
+                registry.sweep()
+                try:
+                    new_plan = controller.plan(registry.alive_devices(),
+                                               total_dev)
+                except InsufficientCapacityError:
+                    raise e  # below the floor: job-level retry takes over
+                kind = "elastic_shrink" if \
+                    new_plan.n_active < plan.n_active else "elastic_rebuild"
+                logger.warning(
+                    "%s at step %d (%r): %d -> %d active device(s); "
+                    "rolling back to step %d and replaying %d batch(es)",
+                    kind, step_no, e, plan.n_active, new_plan.n_active,
+                    controller.replay_boundary(
+                        committed["state"].get("neval", 0)),
+                    len(window_batches) + len(replay_q))
+                if self.telemetry is not None:
+                    self.telemetry.event(
+                        kind, step=step_no,
+                        n_active_before=plan.n_active,
+                        n_active=new_plan.n_active,
+                        alive_workers=len(registry.alive()),
+                        degraded_capacity=new_plan.degraded_capacity,
+                        error=repr(e))
+                plan, lead = new_plan, new_plan.lead
+                params = place(committed["params"], lead)
+                opt_state = place(committed["opt"], lead)
+                model_state = place(committed["ms"], lead)
+                rng = jnp.asarray(committed["rng"])
+                driver_state.clear()
+                driver_state.update(committed["state"])
+                loss, loss_val = None, committed["loss_val"]
+                # a failure mid-replay keeps the still-queued tail
+                replay = window_batches + list(replay_q)
+                replay_q.clear()
+                replay_q.extend(replay)
+                window_batches.clear()
+                if self.telemetry is not None:
+                    self.telemetry.event(
+                        "elastic_replay", batches=len(replay_q),
+                        from_step=controller.replay_boundary(
+                            driver_state.get("neval", 0)))
+                win.restart()
+                continue
+
+            model_state = merge_state(model_state, new_ms)
+            n = batch.size() * num_hosts
+            driver_state["neval"] += 1
+            driver_state["recordsProcessedThisEpoch"] += n
+            driver_state["loss"] = loss_val
+            win.add(n)
+            if do_sync:
+                throughput = win.throughput(self.metrics)
+                self._observe_sync(driver_state, loss_val, lr, throughput,
+                                   win.step_time_s, n, [])
+                logger.info(
+                    f"[Epoch {driver_state['epoch'] + 1} "
+                    f"{driver_state['recordsProcessedThisEpoch']}/"
+                    f"{epoch_size}]"
+                    f"[Iteration {driver_state['neval']}] Training cost "
+                    f"{loss_val}. Throughput is {throughput} "
+                    f"records/second. ({plan.n_active} devices, elastic)")
+                if self.train_summary is not None:
+                    it = driver_state["neval"]
+                    self.train_summary.add_scalar("Loss", loss_val, it)
+                    self.train_summary.add_scalar(
+                        "LearningRate", self._lr_scalar(lr), it)
+                    self.train_summary.add_scalar("Throughput",
+                                                  throughput, it)
+
+            boundary = driver_state["recordsProcessedThisEpoch"] >= \
+                epoch_size
+            if boundary:
+                driver_state["epoch"] += 1
+                driver_state["recordsProcessedThisEpoch"] = 0
+                self._shuffle_dataset()
+
+            with self._span("validation"):
+                self._validate(params, model_state, driver_state)
+            if self.checkpoint_trigger and \
+                    self.checkpoint_trigger(driver_state):
+                with Timer(self.metrics, "checkpoint time"), \
+                        self._span("checkpoint"):
+                    self._save_checkpoint(
+                        params, model_state,
+                        tag=f"iter{driver_state['neval']}",
+                        opt_slots=opt_state)
+            if self.iteration_hook is not None:
+                self.iteration_hook(driver_state)
+            if self._check_preemption(params, model_state, opt_state,
+                                      driver_state, loss):
+                preempted = True
+                break
+
+            if do_sync or boundary:
+                # commit: this state is now the rollback target. Epoch
+                # boundaries ALWAYS commit so a rollback never replays a
+                # dataset reshuffle (the shuffle above already consumed
+                # the dataset rng).
+                committed = commit()
+                window_batches.clear()
+                recoveries = 0  # committed progress past the failures
+                # boundary replan: lease expiries shrink proactively,
+                # revived workers grow the fleet back — both at a
+                # committed point, so no rollback is needed
+                registry.sweep()
+                new_plan = controller.plan(registry.alive_devices(),
+                                           total_dev)
+                if new_plan.devices != plan.devices:
+                    grow = new_plan.n_active > plan.n_active
+                    if self.telemetry is not None:
+                        self.telemetry.event(
+                            "elastic_grow" if grow else "elastic_shrink",
+                            step=driver_state["neval"],
+                            n_active_before=plan.n_active,
+                            n_active=new_plan.n_active,
+                            alive_workers=len(registry.alive()),
+                            degraded_capacity=new_plan.degraded_capacity)
+                    logger.info(
+                        "elastic %s at step %d: %d -> %d active devices",
+                        "grow" if grow else "shrink",
+                        driver_state["neval"], plan.n_active,
+                        new_plan.n_active)
+                    plan = new_plan
+                    if plan.lead is not lead:
+                        params = place(params, plan.lead)
+                        opt_state = place(opt_state, plan.lead)
+                        model_state = place(model_state, plan.lead)
+                        lead = plan.lead
+            if do_sync:
+                win.restart()
+
+        if sync_every > 1 and loss is not None and \
+                driver_state["neval"] % sync_every != 0:
+            driver_state["loss"] = loss_val = float(loss)
+        if not preempted:
+            self._telemetry_run_end(driver_state)
+        self.rng = jax.device_get(rng)
         self.model.set_params(jax.device_get(params))
         self.model._state = jax.device_get(model_state)
         return self.model

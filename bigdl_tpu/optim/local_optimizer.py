@@ -75,6 +75,8 @@ class BaseOptimizer:
         self.grad_accum_steps: int = 1
         self._prefetch: Optional[Dict] = None
         self._active_pipeline = None
+        self._preemption = None
+        self._resume_cursor = None
 
     # fluent setters (Optimizer.scala:93-452)
     def set_gradient_accumulation(self, steps: int):
@@ -161,6 +163,11 @@ class BaseOptimizer:
         restore_optim_method(self.optim_method, oblob)
         if oblob.get("slots") is not None:
             self._resume_slots = oblob["slots"]
+        # data-iterator cursor (v2 checkpoints since the elastic PR):
+        # pass-start rng state + item order + boundary-shuffle positions,
+        # restored by _fast_forward_data so the resumed stream continues
+        # mid-epoch exactly without replaying completed passes
+        self._resume_cursor = oblob.get("cursor")
         # tells the next optimize()'s _fast_forward_data that completed
         # epochs must be replayed (fresh process, dataset rng at origin) —
         # a warm re-optimize() on a live instance must NOT replay
@@ -198,6 +205,27 @@ class BaseOptimizer:
         # boundary).
         cold_resume = getattr(self, "_resumed", False)
         self._resumed = False
+        cursor = getattr(self, "_resume_cursor", None)
+        self._resume_cursor = None
+        if cold_resume and cursor is not None \
+                and self._active_pipeline is None \
+                and hasattr(self.dataset, "restore_cursor"):
+            # checkpoint carried a data cursor: rewind the dataset itself
+            # (rng state + item order + boundary shuffles + the trained
+            # item offset, all as of the checkpoint's pass) instead of
+            # replaying completed passes — the resumed stream continues
+            # at the exact next untrained item. Skipped under prefetch
+            # (workers are already pulling — the cursor cannot be
+            # installed under them) and on datasets without cursor
+            # support, where the full-pass replay below remains the
+            # resume path.
+            try:
+                self.dataset.restore_cursor(cursor)
+            except Exception as e:
+                logger.warning("data cursor restore failed (%r); falling "
+                               "back to full-pass replay", e)
+            else:
+                return data_iter
         epochs_done = max(0, driver_state.get("epoch", 0)) if cold_resume \
             else 0
         pass_items = self.dataset.size()
@@ -385,6 +413,64 @@ class BaseOptimizer:
         perf drivers and external monitors)."""
         self.iteration_hook = fn
         return self
+
+    def set_preemption_handler(self, handler=None, grace_s: float = 30.0):
+        """Arm preemption handling (resilience/preemption.py): while
+        `optimize()` runs, SIGTERM opens a grace window — the loop drains
+        the in-flight step at the next iteration boundary, writes an
+        immediate durable v2 checkpoint (with the data cursor), emits a
+        `preempted` event plus a clean `run_abort`, and returns early.
+        The previous signal disposition is restored when `optimize()`
+        exits. Pass a configured `PreemptionHandler` to control the
+        signal set / grace window, or rely on the default (SIGTERM,
+        `grace_s`). `set_preemption_handler(handler=False)` disarms."""
+        if handler is False:
+            self._preemption = None
+            return self
+        if handler is None:
+            from bigdl_tpu.resilience.preemption import PreemptionHandler
+            handler = PreemptionHandler(grace_s=grace_s)
+        self._preemption = handler
+        return self
+
+    def _check_preemption(self, params, model_state, opt_slots,
+                          driver_state, loss) -> bool:
+        """Iteration-boundary poll of the preemption latch. On a
+        triggered handler: drain the in-flight step (the snapshot must be
+        a completed step's state), write the immediate checkpoint, emit
+        `preempted` + `run_abort`, and tell the loop to stop (True)."""
+        h = self._preemption
+        if h is None or not h.triggered:
+            return False
+        logger.warning(
+            "preemption (signal %s): draining and checkpointing at "
+            "iteration %d (%.1fs of grace remaining)", h.signum,
+            driver_state.get("neval", 0), h.deadline_remaining() or 0.0)
+        if loss is not None:
+            try:  # drain: the loss fetch is the step-completion barrier
+                float(loss)
+            except Exception:
+                pass
+        checkpointed = False
+        if self.checkpoint_path is not None:
+            try:
+                self._save_checkpoint(
+                    params, model_state,
+                    tag=f"iter{driver_state.get('neval', 0)}",
+                    opt_slots=opt_slots)
+                checkpointed = True
+            except Exception:
+                logger.exception("preemption checkpoint failed; aborting "
+                                 "without one")
+        if self.telemetry is not None:
+            self.telemetry.event(
+                "preempted", step=driver_state.get("neval", 0),
+                signal=h.signum, checkpointed=checkpointed,
+                grace_remaining_s=round(h.deadline_remaining() or 0.0, 3))
+        from bigdl_tpu.resilience.preemption import PreemptedError
+        self._telemetry_run_abort(
+            PreemptedError(f"preempted by signal {h.signum}"))
+        return True
 
     def set_telemetry(self, telemetry):
         """Attach a structured run-metrics collector
@@ -695,7 +781,59 @@ class BaseOptimizer:
                         self.optim_method, opt_slots=opt_slots, tag=tag,
                         overwrite=self.overwrite_checkpoint,
                         keep_last_n=getattr(self, "checkpoint_keep_last_n",
-                                            None))
+                                            None),
+                        cursor=self._data_cursor())
+
+    def _data_cursor(self):
+        """The dataset's iteration cursor for checkpointing, pointed at
+        the last TRAINED batch (`_cursor_prev_pos` — one pull behind the
+        loop's lookahead), or None when the dataset does not support one
+        (custom AbstractDataSet), the stream position is currently not
+        trustworthy (mid elastic replay, prefetch pipeline), or the
+        capture fails — a checkpoint must never fail over its cursor."""
+        cur = getattr(self.dataset, "cursor", None)
+        if cur is None or not getattr(self, "_cursor_valid", True) \
+                or self._active_pipeline is not None:
+            return None
+        try:
+            return cur(position=getattr(self, "_cursor_prev_pos", None))
+        except Exception as e:
+            logger.warning("data cursor capture failed (%r); checkpoint "
+                           "saved without one", e)
+            return None
+
+    def _init_cursor_positions(self):
+        """Anchor the pull-position trackers at the stream's current
+        (post-resume-skip) position; called right before the driver's
+        first pull of a run."""
+        self._cursor_valid = True
+        pos = getattr(self.dataset, "position", None)
+        if pos is None:
+            self._cursor_prev_pos = self._cursor_last_pos = None
+            return
+        try:
+            p = pos()
+        except Exception:
+            p = None
+        self._cursor_prev_pos = self._cursor_last_pos = p
+
+    def _note_pull(self):
+        """Record the stream position after a successful live pull: the
+        PREVIOUS sample then points at the last trained batch — exactly
+        what a checkpoint's data cursor must reference (the newest pull
+        is the loop's untrained lookahead). Re-validates the cursor after
+        an elastic replay window drains (a real pull means everything
+        buffered has been retrained)."""
+        pos = getattr(self.dataset, "position", None)
+        if pos is None:
+            return
+        try:
+            p = pos()
+        except Exception:
+            return
+        self._cursor_prev_pos = getattr(self, "_cursor_last_pos", None)
+        self._cursor_last_pos = p
+        self._cursor_valid = True
 
     def _validation_batches(self):
         """Yield MiniBatches whether the dataset holds Samples or batches."""
@@ -752,6 +890,12 @@ class LocalOptimizer(BaseOptimizer):
         self.batch_size = batch_size
 
     def optimize(self) -> Module:
+        if self._preemption is not None:
+            # a latch left set by a previous preempted run is stale: the
+            # next optimize() (train-more / drill reuse) must train, not
+            # instantly re-abort
+            self._preemption.reset()
+            self._preemption.install()
         try:
             return self._optimize_impl()
         except (KeyboardInterrupt, SystemExit):
@@ -763,6 +907,8 @@ class LocalOptimizer(BaseOptimizer):
             # join prefetch workers whether the run finished or died —
             # repeated optimize() calls must never accumulate threads
             self._close_data_pipeline(self._active_pipeline)
+            if self._preemption is not None:
+                self._preemption.uninstall()
 
     def _build_step(self):
         model, criterion = self.model, self.criterion
@@ -829,6 +975,7 @@ class LocalOptimizer(BaseOptimizer):
         epoch_size = self.dataset.size()
         _, src = self._open_data_pipeline()
         data_iter = self._fast_forward_data(src, driver_state)
+        self._init_cursor_positions()
 
         def fetch_and_place():
             """Next host batch + async device transfer; overlaps the
@@ -843,6 +990,7 @@ class LocalOptimizer(BaseOptimizer):
                         "training data stream exhausted before the end "
                         "trigger fired; stopping early")
                     return None
+                self._note_pull()
                 x = _to_device(batch.get_input())
                 y = _to_device(batch.get_target())
             return batch, x, y
@@ -853,6 +1001,7 @@ class LocalOptimizer(BaseOptimizer):
         loss_val = float("nan")
         loss = None
         lr = None
+        preempted = False
         aux_pending: List = []
         pending = fetch_and_place()
         while pending is not None and not self.end_trigger(driver_state):
@@ -925,6 +1074,10 @@ class LocalOptimizer(BaseOptimizer):
                                           opt_slots=opt_state)
             if self.iteration_hook is not None:
                 self.iteration_hook(driver_state)
+            if self._check_preemption(params, model_state, opt_state,
+                                      driver_state, loss):
+                preempted = True
+                break
             if do_sync:
                 win.restart()  # exclude the tail work from the next window
 
@@ -936,7 +1089,8 @@ class LocalOptimizer(BaseOptimizer):
             # guards/monitors must still see those steps' aux
             self._observe_sync(driver_state, loss_val, lr, float("nan"),
                                float("nan"), 0, aux_pending)
-        self._telemetry_run_end(driver_state)
+        if not preempted:  # a preempted run already closed with run_abort
+            self._telemetry_run_end(driver_state)
         self.model.set_params(params)
         self.model._state = model_state
         return self.model

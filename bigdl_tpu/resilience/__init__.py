@@ -16,19 +16,40 @@ alone:
 - `breaker` — `CircuitBreaker`: consecutive-failure trip, fast-fail
   shedding while open, half-open probe recovery. The serving engine keys
   one per shape bucket.
+- `membership` — `WorkerRegistry`: lease/heartbeat worker liveness with
+  an injectable clock, plus `SimulatedCluster` (the CPU stand-in for a
+  multi-host fleet) and the `DeviceLossError`/`CollectiveError` failure
+  vocabulary.
+- `elastic` — `ElasticController`: maps surviving capacity to a valid
+  mesh shape and fixes the replay boundary; `DistriOptimizer.set_elastic`
+  turns both into shrink -> replay -> grow recovery.
+- `preemption` — `PreemptionHandler`: SIGTERM grace window -> immediate
+  durable checkpoint -> drain -> clean `run_abort`, with the original
+  signal disposition restored.
 
 Recovery events (`fault_injected`, `retry`, `circuit_open`,
-`circuit_close`, `checkpoint_verified`, `checkpoint_quarantined`) flow
-through `observability.Telemetry`. See docs/resilience.md.
+`circuit_close`, `checkpoint_verified`, `checkpoint_quarantined`,
+`worker_lost`, `worker_joined`, `elastic_shrink`, `elastic_grow`,
+`elastic_replay`, `preempted`) flow through `observability.Telemetry`.
+See docs/resilience.md.
 """
 
 from bigdl_tpu.resilience.breaker import (CLOSED, HALF_OPEN, OPEN,
                                           CircuitBreaker)
+from bigdl_tpu.resilience.elastic import (ElasticController, ElasticPlan,
+                                          InsufficientCapacityError)
 from bigdl_tpu.resilience.faults import (KNOWN_SITES, FaultInjector,
                                          FaultSpec, InjectedFault,
                                          PermanentInjectedFault,
                                          TransientInjectedFault,
-                                         active_injector, fire)
+                                         active_injector, fire,
+                                         known_sites, register_site)
+from bigdl_tpu.resilience.membership import (CollectiveError,
+                                             DeviceLossError,
+                                             SimulatedCluster,
+                                             WorkerRegistry)
+from bigdl_tpu.resilience.preemption import (PreemptedError,
+                                             PreemptionHandler)
 from bigdl_tpu.resilience.retry import (DEFAULT_PERMANENT,
                                         DEFAULT_TRANSIENT,
                                         RetryBudgetExhausted, RetryPolicy)
@@ -38,6 +59,10 @@ from bigdl_tpu.resilience.retry import (DEFAULT_PERMANENT,
 # docs/LAYERS.md surface indexes classes and functions
 __all__ = [
     "FaultInjector", "FaultSpec", "fire", "active_injector",
+    "register_site", "known_sites",
     "InjectedFault", "TransientInjectedFault", "PermanentInjectedFault",
     "RetryPolicy", "RetryBudgetExhausted", "CircuitBreaker",
+    "WorkerRegistry", "SimulatedCluster", "DeviceLossError",
+    "CollectiveError", "ElasticController", "ElasticPlan",
+    "InsufficientCapacityError", "PreemptionHandler", "PreemptedError",
 ]

@@ -18,6 +18,7 @@ Instrumented sites (see docs/resilience.md for the full contract):
     ckpt.write.params / ckpt.write.state / ckpt.write.optim /
     ckpt.write.manifest / ckpt.commit      serialization/checkpoint.py
     train.step                             both optimizers' driver loops
+    mesh.device_loss / mesh.collective     DistriOptimizer elastic loop
     prefetch.worker                        dataset/prefetch.py workers
     serve.forward                          serving/engine.py dispatch
     fs.remote_io                           utils/filesystem.py remote ops
@@ -40,13 +41,38 @@ from typing import Callable, Dict, List, Optional, Tuple
 
 logger = logging.getLogger("bigdl_tpu.resilience")
 
-#: Every site the framework instruments, for docs and plan sanity checks.
+#: Every site the framework instruments. Site names follow the
+#: `<subsystem>.<event>` convention (docs/resilience.md): the prefix is
+#: the owning subsystem (`ckpt`, `train`, `mesh`, `prefetch`, `serve`,
+#: `fs`, `telemetry`), the suffix the instrumented moment. `FaultSpec`
+#: VALIDATES against this registry — a typo'd site raises at plan-build
+#: time instead of silently never firing. Out-of-tree code extends the
+#: registry with `register_site()` before building its specs.
 KNOWN_SITES = (
     "ckpt.write.params", "ckpt.write.state", "ckpt.write.optim",
     "ckpt.write.manifest", "ckpt.commit",
-    "train.step", "prefetch.worker", "serve.forward",
+    "train.step", "mesh.device_loss", "mesh.collective",
+    "prefetch.worker", "serve.forward",
     "fs.remote_io", "telemetry.sink",
 )
+
+_EXTRA_SITES: set = set()
+
+
+def register_site(site: str) -> str:
+    """Register an out-of-tree fault site so `FaultSpec(site)` accepts it.
+    Returns the name. Use for application-level `fire()` points; the
+    in-tree sites live in `KNOWN_SITES`."""
+    if not site or "." not in site:
+        raise ValueError(
+            f"fault site {site!r} must follow '<subsystem>.<event>'")
+    _EXTRA_SITES.add(site)
+    return site
+
+
+def known_sites() -> tuple:
+    """Every currently-registered site (in-tree + `register_site` extras)."""
+    return KNOWN_SITES + tuple(sorted(_EXTRA_SITES))
 
 
 class InjectedFault(Exception):
@@ -68,8 +94,11 @@ class FaultSpec:
 
     Parameters
     ----------
-    site : the instrumented site name (see `KNOWN_SITES`; unknown names
-        are allowed — they just never fire — but warn once).
+    site : the instrumented site name — must be in `known_sites()`
+        (`KNOWN_SITES` plus `register_site` extras). An unknown name
+        raises `ValueError` at spec-build time: a typo'd site would
+        otherwise silently never fire and the chaos test would pass
+        vacuously.
     at_hit : 1-based hit count at which the fault starts firing (hit =
         one `fire()` call at this site while the plan is installed).
     times : how many consecutive hits fire from `at_hit` on; `None`
@@ -96,10 +125,12 @@ class FaultSpec:
             raise ValueError(f"at_hit must be >= 1, got {at_hit}")
         if times is not None and times < 1:
             raise ValueError(f"times must be >= 1 or None, got {times}")
-        if site not in KNOWN_SITES:
-            logger.warning("FaultSpec site %r is not an instrumented site "
-                           "(%s); it will never fire", site,
-                           ", ".join(KNOWN_SITES))
+        if site not in KNOWN_SITES and site not in _EXTRA_SITES:
+            raise ValueError(
+                f"FaultSpec site {site!r} is not an instrumented site — it "
+                f"would never fire. Known sites: {', '.join(known_sites())}. "
+                f"Out-of-tree fire() points must call register_site() "
+                f"first.")
         self.site = site
         self.at_hit = at_hit
         self.times = times

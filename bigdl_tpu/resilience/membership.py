@@ -1,0 +1,299 @@
+"""Worker membership: lease/heartbeat registry for elastic training.
+
+The reference gets membership for free from Spark — the cluster manager
+tracks executor liveness and the driver sees a lost executor as a failed
+task (BigDL's whole fault story rides on that substrate, SURVEY.md §5.3).
+A TPU-native runtime has no such substrate: on a v5e slice a preempted
+host simply stops answering, and the training driver must decide for
+itself who is still in the job. This module is that decision, made
+testable:
+
+- `WorkerRegistry` — lease-based membership. Each worker (a host, or a
+  device group standing in for one) registers with a TTL lease and
+  renews it by heartbeat; `sweep()` expires stale leases. Losses and
+  (re)joins emit `worker_lost` / `worker_joined` telemetry carrying the
+  fleet's `degraded_capacity`, so /metrics shows a shrunken fleet the
+  moment it shrinks. The clock is injectable — lease-expiry tests run in
+  virtual time.
+- `DeviceLossError` / `CollectiveError` — the failure vocabulary the
+  elastic training loop recovers from. Real backend failures are mapped
+  onto them by probing; injected ones (fault sites `mesh.device_loss` /
+  `mesh.collective`) carry the lost worker ids directly.
+- `SimulatedCluster` — the CPU-container stand-in for a multi-host
+  fleet: partitions the local (virtual) devices into N logical workers
+  behind one registry, with `fail()` / `restore()` to script preemption
+  and rejoin. The re-expressed multi-host tests (tests/test_multihost.py)
+  and `bench_cli --chaos --device-loss` drive training through it.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Sequence
+
+logger = logging.getLogger("bigdl_tpu.resilience")
+
+
+class DeviceLossError(RuntimeError):
+    """A device (or the worker owning it) disappeared mid-step — the TPU
+    reality of a preempted v5e host. `lost` names the lost workers (ids
+    or device objects) when known; empty means "probe to find out"."""
+
+    def __init__(self, msg: str = "device lost", lost: Sequence = ()):
+        super().__init__(msg)
+        self.lost = tuple(lost)
+
+
+class CollectiveError(RuntimeError):
+    """A cross-device collective failed without a proven device loss
+    (ICI glitch, interconnect timeout). Recoverable by rebuilding over
+    the same devices and replaying the interrupted window."""
+
+
+class _Worker:
+    __slots__ = ("worker_id", "devices", "lease_until", "alive", "meta")
+
+    def __init__(self, worker_id, devices, lease_until, meta):
+        self.worker_id = worker_id
+        self.devices = list(devices)
+        self.lease_until = lease_until
+        self.alive = True
+        self.meta = meta or {}
+
+
+class WorkerRegistry:
+    """Lease/heartbeat membership over a set of workers.
+
+    Thread-safe; the clock is injectable (`clock=` any zero-arg float
+    callable, default `time.monotonic`) so expiry is testable in virtual
+    time. Telemetry events:
+
+    - `worker_joined` — on `register` and on a heartbeat that revives a
+      lost worker (`rejoined: true`). Fields: `worker`, `devices`,
+      `alive`, `total`, `degraded_capacity`.
+    - `worker_lost` — on `mark_lost` (observed failure) or `sweep()`
+      lease expiry (`reason: "lease_expired"`). Same fleet fields.
+
+    `alive_devices()` flattens alive workers' devices in REGISTRATION
+    order — a stable order, so an elastic replan maps logical replicas
+    onto survivors deterministically.
+    """
+
+    def __init__(self, lease_s: float = 10.0,
+                 clock: Optional[Callable[[], float]] = None,
+                 telemetry=None):
+        if lease_s <= 0:
+            raise ValueError(f"lease_s must be > 0, got {lease_s}")
+        self.lease_s = float(lease_s)
+        self.clock = clock or time.monotonic
+        self.telemetry = telemetry
+        self._lock = threading.Lock()
+        self._workers: Dict[str, _Worker] = {}  # insertion = registration
+
+    # ------------------------------------------------------------ events
+    def _event(self, kind: str, worker: _Worker, **extra):
+        """Emit one membership event. Callers must NOT hold the lock (a
+        slow sink must not serialize registry access); the fleet counts
+        are snapshotted under it so they are never torn."""
+        if self.telemetry is None:
+            return
+        with self._lock:
+            alive = len(self._alive_unlocked())
+            total = len(self._workers)
+            degraded = self._degraded_unlocked()
+        try:
+            self.telemetry.event(
+                kind, worker=worker.worker_id,
+                devices=len(worker.devices), alive=alive, total=total,
+                degraded_capacity=degraded, **extra)
+        except Exception:
+            logger.exception("membership telemetry emit of %s failed", kind)
+
+    # ------------------------------------------------------------ writes
+    def register(self, worker_id: str, devices: Sequence = (),
+                 meta: Optional[Dict] = None) -> "WorkerRegistry":
+        """Add a worker with a fresh lease (re-registering renews it)."""
+        with self._lock:
+            w = self._workers.get(worker_id)
+            if w is None:
+                w = _Worker(worker_id, devices, 0.0, meta)
+                self._workers[worker_id] = w
+            elif devices:
+                w.devices = list(devices)
+            w.alive = True
+            w.lease_until = self.clock() + self.lease_s
+        self._event("worker_joined", w, rejoined=False)
+        return self
+
+    def heartbeat(self, worker_id: str) -> bool:
+        """Renew a worker's lease. A heartbeat from a LOST worker revives
+        it (`worker_joined` with `rejoined: true`) — preempted capacity
+        coming back. Returns True when the call revived the worker."""
+        with self._lock:
+            w = self._workers.get(worker_id)
+            if w is None:
+                raise KeyError(f"unknown worker {worker_id!r}")
+            revived = not w.alive
+            w.alive = True
+            w.lease_until = self.clock() + self.lease_s
+        if revived:
+            self._event("worker_joined", w, rejoined=True)
+        return revived
+
+    def mark_lost(self, worker_id: str, reason: str = "observed failure"):
+        """Declare a worker lost NOW (an exception proved it — don't wait
+        for the lease to expire)."""
+        with self._lock:
+            w = self._workers.get(worker_id)
+            if w is None:
+                raise KeyError(f"unknown worker {worker_id!r}")
+            was_alive = w.alive
+            w.alive = False
+        if was_alive:
+            self._event("worker_lost", w, reason=reason)
+
+    def mark_device_lost(self, device, reason: str = "observed failure"):
+        """Declare the worker OWNING `device` lost. Unknown devices are
+        ignored (a probe may report devices outside the registry)."""
+        wid = self.worker_for_device(device)
+        if wid is not None:
+            self.mark_lost(wid, reason=reason)
+
+    def sweep(self) -> List[str]:
+        """Expire stale leases; returns the newly-lost worker ids."""
+        now = self.clock()
+        newly_lost = []
+        with self._lock:
+            for w in self._workers.values():
+                if w.alive and w.lease_until < now:
+                    w.alive = False
+                    newly_lost.append(w)
+        for w in newly_lost:
+            self._event("worker_lost", w, reason="lease_expired")
+        return [w.worker_id for w in newly_lost]
+
+    # ------------------------------------------------------------- reads
+    # (all under the lock: a heartbeat listener thread may register or
+    # revive a worker while the driver thread replans)
+    def _alive_unlocked(self) -> List[str]:
+        return [w.worker_id for w in self._workers.values() if w.alive]
+
+    def _alive_devices_unlocked(self) -> List:
+        return [d for w in self._workers.values() if w.alive
+                for d in w.devices]
+
+    def _total_devices_unlocked(self) -> int:
+        return sum(len(w.devices) for w in self._workers.values())
+
+    def _degraded_unlocked(self) -> float:
+        total = self._total_devices_unlocked()
+        if total == 0:
+            return 0.0
+        return round(1.0 - len(self._alive_devices_unlocked()) / total, 6)
+
+    def alive(self) -> List[str]:
+        """Alive worker ids, registration order."""
+        with self._lock:
+            return self._alive_unlocked()
+
+    def lost(self) -> List[str]:
+        with self._lock:
+            return [w.worker_id for w in self._workers.values()
+                    if not w.alive]
+
+    def alive_devices(self) -> List:
+        """Devices of alive workers, flattened in registration order."""
+        with self._lock:
+            return self._alive_devices_unlocked()
+
+    def total_devices(self) -> int:
+        with self._lock:
+            return self._total_devices_unlocked()
+
+    def worker_for_device(self, device) -> Optional[str]:
+        with self._lock:
+            for w in self._workers.values():
+                if any(d is device or d == device for d in w.devices):
+                    return w.worker_id
+        return None
+
+    def degraded_capacity(self) -> float:
+        """Fraction of registered device capacity currently lost:
+        0.0 = full fleet, 0.5 = half the devices gone. The value behind
+        the /metrics `degraded_capacity` gauge."""
+        with self._lock:
+            return self._degraded_unlocked()
+
+    def snapshot(self) -> Dict:
+        """Health-endpoint view: per-worker liveness + fleet capacity."""
+        now = self.clock()
+        with self._lock:
+            return {
+                "workers": {
+                    w.worker_id: {
+                        "alive": w.alive,
+                        "devices": len(w.devices),
+                        "lease_remaining_s": round(w.lease_until - now, 3),
+                    } for w in self._workers.values()},
+                "alive": len(self._alive_unlocked()),
+                "total": len(self._workers),
+                "degraded_capacity": self._degraded_unlocked(),
+            }
+
+
+class SimulatedCluster:
+    """N logical workers over the local (virtual) devices — the CPU
+    container's stand-in for a multi-host fleet, mirroring how the
+    reference emulates a 4-node cluster on local-mode Spark (SURVEY.md
+    §4.4) and how the suite emulates an 8-chip pod via
+    `--xla_force_host_platform_device_count`.
+
+    Devices are split CONTIGUOUSLY in worker order (worker0 gets the
+    first chunk), matching jax's process-major device ordering on real
+    multi-host pods. `fail(w)` / `restore(w)` script a preemption and the
+    capacity coming back; `shard(items, i)` is the `DistributedDataSet`
+    interleaving (item k -> worker k % n), so a simulated worker feeds
+    exactly the shard its real counterpart would.
+    """
+
+    def __init__(self, n_workers: int, devices: Optional[Sequence] = None,
+                 lease_s: float = 1e9, clock=None, telemetry=None):
+        import jax
+        devices = list(jax.devices() if devices is None else devices)
+        if not 1 <= n_workers <= len(devices):
+            raise ValueError(
+                f"n_workers must be in [1, {len(devices)}], got {n_workers}")
+        self.n_workers = n_workers
+        self.registry = WorkerRegistry(lease_s=lease_s, clock=clock,
+                                       telemetry=telemetry)
+        per = len(devices) // n_workers
+        extra = len(devices) % n_workers
+        pos = 0
+        self.assignment: Dict[str, List] = {}
+        for i in range(n_workers):
+            k = per + (1 if i < extra else 0)
+            wid = f"worker{i}"
+            self.assignment[wid] = devices[pos:pos + k]
+            self.registry.register(wid, devices[pos:pos + k])
+            pos += k
+
+    def workers(self) -> List[str]:
+        return list(self.assignment)
+
+    def devices(self) -> List:
+        """All devices of the cluster, worker order."""
+        return [d for ds in self.assignment.values() for d in ds]
+
+    def fail(self, worker_id: str, reason: str = "simulated preemption"):
+        self.registry.mark_lost(worker_id, reason=reason)
+
+    def restore(self, worker_id: str) -> bool:
+        return self.registry.heartbeat(worker_id)
+
+    @staticmethod
+    def shard(items: Sequence, worker_index: int, n_workers: int) -> List:
+        """The `DistributedDataSet` interleaving for one worker."""
+        return [x for i, x in enumerate(items)
+                if i % n_workers == worker_index]

@@ -129,13 +129,19 @@ def _dump_pickle(path: str, payload) -> Dict:
 
 def save_checkpoint(path: str, model, params, model_state, optim_method,
                     opt_slots=None, tag: str = "", overwrite: bool = True,
-                    keep_last_n: Optional[int] = None) -> str:
+                    keep_last_n: Optional[int] = None,
+                    cursor: Optional[Dict] = None) -> str:
     """Write <path>/<tag or timestamp>/ with params.pkl, state.pkl,
     optim.pkl, manifest.json — staged in a hidden tmp dir and renamed into
     place so a crash mid-save never publishes a partial snapshot.
     `opt_slots` = the device-side optimizer slot pytree (Adam m/v/t, SGD
     velocity) — the reference serializes the full OptimMethod state Table,
-    so resume must not reset moments. `keep_last_n` prunes the oldest
+    so resume must not reset moments. `cursor` = the data-iterator cursor
+    (`dataset.cursor()`: pass-start rng state, item order, boundary
+    shuffle positions) — rides in optim.pkl so a resumed run continues
+    the data stream mid-epoch exactly, neither replaying nor skipping
+    consumed samples; older checkpoints without it still load (resume
+    falls back to full-pass replay). `keep_last_n` prunes the oldest
     valid checkpoints after the save commits. Returns the checkpoint dir.
     """
     if keep_last_n is not None and keep_last_n < 1:
@@ -162,6 +168,7 @@ def save_checkpoint(path: str, model, params, model_state, optim_method,
             "slots": (jax.tree_util.tree_map(
                 np.asarray, jax.device_get(opt_slots))
                 if opt_slots is not None else None),
+            "cursor": cursor,
         }
         files: Dict[str, Dict] = {}
         for fname, site, payload in (

@@ -711,6 +711,119 @@ def bench_chaos(crash_at: int = 8, iters: int = 16, ckpt_every: int = 4,
     return out
 
 
+def bench_chaos_device_loss(lose_at: int = 5, rejoin_at: int = 12,
+                            iters: int = 18, batch_size: int = 64,
+                            n_samples: int = 512, sync: int = 2):
+    """Elastic chaos drill: lose a worker mid-run, measure MTTR and the
+    degraded-capacity throughput off the telemetry stream.
+
+    Trains an MNIST-shaped MLP through the REAL `DistriOptimizer` with
+    `set_elastic` over a 2-worker `SimulatedCluster` (first two local
+    devices). A `FaultInjector` raises `mesh.device_loss` (losing
+    worker1) at iteration `lose_at`; the elastic loop shrinks to the
+    survivor, rolls back to the committed boundary, replays the
+    interrupted batches, and keeps training degraded; at `rejoin_at` the
+    lost worker heartbeats back and the loop grows at the next committed
+    boundary. Recovery proof is the loss trajectory staying bit-identical
+    to an uninterrupted run at matched sample counts (asserted in
+    tests/test_elastic.py; here the run must simply finish). MTTR = the
+    wall-clock gap between the `worker_lost` event and the first
+    post-recovery `step` record; degraded throughput compares step
+    records inside the shrink..grow window against the healthy ones.
+    Prints ONE json line. Needs >= 2 local devices (CI forces 8 via
+    XLA_FLAGS); otherwise reports `skipped`."""
+    import jax
+
+    import bigdl_tpu.nn as nn_
+    import bigdl_tpu.optim as optim
+    from bigdl_tpu.dataset.dataset import LocalDataSet
+    from bigdl_tpu.dataset.transformer import SampleToMiniBatch
+    from bigdl_tpu.dataset.sample import Sample
+    from bigdl_tpu.observability import InMemorySink, Telemetry
+    from bigdl_tpu.optim.distri_optimizer import DistriOptimizer
+    from bigdl_tpu.optim.trigger import max_iteration
+    from bigdl_tpu.parallel.mesh import build_mesh
+    from bigdl_tpu.resilience import (DeviceLossError, FaultInjector,
+                                      FaultSpec, SimulatedCluster)
+
+    if jax.device_count() < 2:
+        out = {"metric": "chaos_device_loss", "skipped": True,
+               "reason": f"{jax.device_count()} device(s); need >= 2 "
+                         "(set --xla_force_host_platform_device_count)"}
+        print(json.dumps(out), flush=True)
+        return out
+
+    rs = np.random.RandomState(0)
+    samples = [Sample(rs.rand(28, 28).astype(np.float32),
+                      np.int32(rs.randint(0, 10) + 1))
+               for _ in range(n_samples)]
+    model = (nn_.Sequential().add(nn_.Reshape([784]))
+             .add(nn_.Linear(784, 128)).add(nn_.Tanh())
+             .add(nn_.Linear(128, 10)).add(nn_.LogSoftMax()))
+    sink = InMemorySink()
+    telemetry = Telemetry(sink, resources=False)
+    cluster = SimulatedCluster(2, devices=jax.devices()[:2],
+                               telemetry=telemetry)
+    ds = LocalDataSet(samples).transform(
+        SampleToMiniBatch(batch_size, drop_remainder=True))
+    opt = DistriOptimizer(model, ds, nn_.ClassNLLCriterion(),
+                          mesh=build_mesh(data=2, model=1,
+                                          devices=jax.devices()[:2]),
+                          retry_times=0)
+    opt.set_optim_method(optim.SGD(learning_rate=0.05, momentum=0.9))
+    opt.set_end_when(max_iteration(iters))
+    opt.set_sync_interval(sync)
+    opt.set_elastic(registry=cluster.registry)
+    opt.set_telemetry(telemetry)
+    opt.set_iteration_hook(
+        lambda s: cluster.restore("worker1")
+        if s["neval"] == rejoin_at else None)
+    plan = FaultInjector(
+        FaultSpec("mesh.device_loss", at_hit=lose_at,
+                  exc=lambda ctx: DeviceLossError(
+                      "injected preemption", lost=("worker1",))),
+        telemetry=telemetry)
+    with plan:
+        opt.optimize()
+
+    t_lost = next((r["time"] for r in sink.records
+                   if r.get("event") == "worker_lost"), None)
+    t_grow = next((r["time"] for r in sink.records
+                   if r.get("event") == "elastic_grow"), None)
+    steps = [r for r in sink.records if r.get("type") == "step"]
+    post = [r for r in steps if t_lost is not None and r["time"] > t_lost]
+    degraded = [r for r in post
+                if t_grow is None or r["time"] <= t_grow]
+    healthy = [r for r in steps if r not in degraded]
+    replays = [r for r in sink.records
+               if r.get("event") == "elastic_replay"]
+
+    def mean_tp(rs_):
+        vals = [r["throughput"] for r in rs_
+                if isinstance(r.get("throughput"), (int, float))]
+        return float(np.mean(vals)) if vals else None
+
+    tp_d, tp_h = mean_tp(degraded), mean_tp(healthy)
+    final_step = int(opt.optim_method.state.get("neval", 0))
+    out = {
+        "metric": "chaos_device_loss",
+        "fault_site": "mesh.device_loss",
+        "lost_at_iteration": lose_at,
+        "rejoin_at_iteration": rejoin_at,
+        "recovered": bool(post) and final_step >= iters,
+        "mttr_s": round(post[0]["time"] - t_lost, 4) if post else None,
+        "replayed_batches": int(sum(r.get("batches", 0)
+                                    for r in replays)),
+        "grew_back": t_grow is not None,
+        "degraded_throughput": round(tp_d, 1) if tp_d else None,
+        "degraded_throughput_frac":
+            round(tp_d / tp_h, 3) if tp_d and tp_h else None,
+        "final_step": final_step,
+    }
+    print(json.dumps(out), flush=True)
+    return out
+
+
 def bench_baseline_configs():
     """One stderr line per remaining BASELINE.md config (the headline
     already covers ResNet-50): LeNet-5, Inception-v1, PTB LSTM, and
@@ -1064,6 +1177,7 @@ def main():
     serve_clients = 8
     chaos = False
     chaos_crash_at = 8
+    device_loss = False
     it = iter(sys.argv[1:])
     for a in it:
         if a == "--telemetry":
@@ -1095,8 +1209,22 @@ def main():
         elif a.startswith("--chaos-crash-at="):
             chaos = True
             chaos_crash_at = int(a.split("=", 1)[1])
+        elif a == "--device-loss":
+            chaos = True  # the flag alone must run the drill, never be
+            device_loss = True  # silently swallowed by the headline path
         else:
             argv.append(a)
+    if chaos and device_loss:
+        # elastic chaos drill: injected device loss -> shrink -> replay
+        # -> grow; MTTR + degraded throughput off the telemetry stream
+        # (CI smoke gate: nonzero exit when recovery fails)
+        logging.getLogger("bigdl_tpu.optim").setLevel(logging.ERROR)
+        logging.getLogger("bigdl_tpu.resilience").setLevel(logging.ERROR)
+        _configure_compile_cache()
+        out = bench_chaos_device_loss()
+        if not (out.get("recovered") or out.get("skipped")):
+            raise SystemExit(1)
+        return
     if chaos:
         # chaos drill: deterministic injected fault -> retry/reload ->
         # MTTR from the telemetry stream; measurable off-TPU; one json

@@ -26,6 +26,11 @@ export XLA_FLAGS="--xla_force_host_platform_device_count=8 ${XLA_FLAGS:-}"
 
 python -m pytest tests/ -q -m "not slow"
 
+# elastic chaos smoke: injected mesh.device_loss -> shrink -> replay ->
+# grow on the virtual 8-device mesh (tiny MLP, few steps); exits nonzero
+# unless the run recovers, and emits the MTTR JSON line for the CI log
+python -m bigdl_tpu.tools.bench_cli --chaos --device-loss
+
 python -c "
 import jax; jax.config.update('jax_platforms', 'cpu')
 import __graft_entry__ as g

@@ -1,0 +1,773 @@
+"""Elastic preemption-tolerant training: membership, shrink/grow, replay.
+
+The contract under test: training DEGRADES instead of aborting. A replica
+lost mid-step (injected at `mesh.device_loss` / `mesh.collective`) rolls
+the run back to the last committed sync boundary, rebuilds over the
+survivors, replays the interrupted batches, and produces a loss
+trajectory and final parameters BIT-IDENTICAL to an uninterrupted run at
+matched sample counts; returning capacity grows the fleet back at a
+committed boundary. SIGTERM converts the preemption grace window into an
+immediate durable checkpoint (with the data-iterator cursor) and a clean
+`run_abort`. Membership is lease/heartbeat (`WorkerRegistry`, virtual
+clock), and the whole story is observable: `worker_lost` /
+`worker_joined` / `elastic_*` events plus the `degraded_capacity` gauge
+on /metrics.
+"""
+
+import os
+import signal
+
+import jax
+import numpy as np
+import pytest
+
+import bigdl_tpu.nn as nn
+import bigdl_tpu.optim as optim
+from bigdl_tpu.dataset.dataset import LocalDataSet
+from bigdl_tpu.dataset.sample import MiniBatch
+from bigdl_tpu.observability import InMemorySink, Telemetry
+from bigdl_tpu.optim.distri_optimizer import DistriOptimizer
+from bigdl_tpu.optim.local_optimizer import LocalOptimizer
+from bigdl_tpu.optim.trigger import max_iteration, several_iteration
+from bigdl_tpu.resilience import (CollectiveError, DeviceLossError,
+                                  ElasticController, FaultInjector,
+                                  FaultSpec, InsufficientCapacityError,
+                                  PermanentInjectedFault, PreemptionHandler,
+                                  SimulatedCluster, WorkerRegistry,
+                                  active_injector)
+from bigdl_tpu.resilience.faults import known_sites, register_site
+from bigdl_tpu.serialization.checkpoint import (latest_checkpoint,
+                                                load_checkpoint,
+                                                load_latest_valid,
+                                                save_checkpoint)
+
+pytestmark = pytest.mark.chaos
+
+
+@pytest.fixture(autouse=True)
+def _no_injector_leak():
+    yield
+    leaked = active_injector()
+    if leaked is not None:
+        leaked.uninstall()
+        raise AssertionError(f"test leaked an installed FaultInjector: "
+                             f"{leaked.specs}")
+
+
+def _events(sink, kind=None):
+    evs = [r for r in sink.records if r.get("type") == "event"]
+    if kind is None:
+        return evs
+    return [r for r in evs if r.get("event") == kind]
+
+
+# --------------------------------------------------------------------- #
+# WorkerRegistry: leases, heartbeats, telemetry
+# --------------------------------------------------------------------- #
+class TestWorkerRegistry:
+    def test_lease_expiry_and_rejoin_in_virtual_time(self):
+        now = [0.0]
+        sink = InMemorySink()
+        reg = WorkerRegistry(lease_s=5.0, clock=lambda: now[0],
+                             telemetry=Telemetry(sink, resources=False,
+                                                 flight=False))
+        reg.register("w0", ["d0"]).register("w1", ["d1", "d2"])
+        assert reg.alive() == ["w0", "w1"]
+        assert reg.total_devices() == 3
+        now[0] = 3.0
+        reg.heartbeat("w0")  # w0 renews, w1 does not
+        assert reg.sweep() == []
+        now[0] = 6.0  # w1's lease (until 5.0) is stale, w0's (8.0) is not
+        assert reg.sweep() == ["w1"]
+        assert reg.alive() == ["w0"]
+        assert reg.alive_devices() == ["d0"]
+        assert reg.degraded_capacity() == pytest.approx(2 / 3)
+        lost = _events(sink, "worker_lost")
+        assert lost and lost[-1]["worker"] == "w1"
+        assert lost[-1]["reason"] == "lease_expired"
+        assert lost[-1]["degraded_capacity"] == pytest.approx(2 / 3)
+        # the preempted capacity comes back: heartbeat revives
+        assert reg.heartbeat("w1") is True
+        assert reg.alive() == ["w0", "w1"]
+        rejoin = _events(sink, "worker_joined")[-1]
+        assert rejoin["worker"] == "w1" and rejoin["rejoined"] is True
+        assert reg.degraded_capacity() == 0.0
+
+    def test_mark_lost_and_device_lookup(self):
+        reg = WorkerRegistry(lease_s=100.0)
+        reg.register("a", ["dx"]).register("b", ["dy"])
+        assert reg.worker_for_device("dy") == "b"
+        reg.mark_device_lost("dy")
+        assert reg.lost() == ["b"]
+        reg.mark_device_lost("unknown-device")  # ignored, no raise
+        with pytest.raises(KeyError):
+            reg.mark_lost("nope")
+        snap = reg.snapshot()
+        assert snap["alive"] == 1 and snap["total"] == 2
+        assert snap["workers"]["b"]["alive"] is False
+
+    def test_simulated_cluster_partitions_and_scripting(self):
+        devs = jax.devices()[:4]
+        cl = SimulatedCluster(2, devices=devs)
+        assert cl.workers() == ["worker0", "worker1"]
+        # contiguous split, process-major like a real pod
+        assert cl.assignment["worker0"] == devs[:2]
+        assert cl.assignment["worker1"] == devs[2:]
+        cl.fail("worker1")
+        assert cl.registry.alive_devices() == devs[:2]
+        assert cl.restore("worker1") is True
+        assert SimulatedCluster.shard([0, 1, 2, 3, 4], 1, 2) == [1, 3]
+
+
+# --------------------------------------------------------------------- #
+# Fault-site registry (satellite: typo'd sites fail fast)
+# --------------------------------------------------------------------- #
+class TestSiteRegistry:
+    def test_unknown_site_raises_at_spec_build(self):
+        with pytest.raises(ValueError, match="not an instrumented site"):
+            FaultSpec("train.stpe")  # the typo that used to fire never
+
+    def test_mesh_sites_are_registered(self):
+        assert "mesh.device_loss" in known_sites()
+        assert "mesh.collective" in known_sites()
+
+    def test_register_site_extends_the_registry(self):
+        name = register_site("testonly.custom_site")
+        assert name in known_sites()
+        FaultSpec(name)  # now accepted
+        with pytest.raises(ValueError, match="subsystem"):
+            register_site("nodotname")
+
+
+# --------------------------------------------------------------------- #
+# ElasticController: shapes and batch splitting
+# --------------------------------------------------------------------- #
+class TestElasticController:
+    def test_plan_maps_survivors_to_valid_shapes(self):
+        devs = jax.devices()[:4]
+        c = ElasticController(logical_replicas=4, min_devices=2)
+        p4 = c.plan(devs)
+        assert p4.n_active == 4 and p4.lead is devs[0]
+        assert p4.mesh.devices.shape == (4, 1)
+        p3 = c.plan(devs[:3], total_devices=4)
+        assert p3.n_active == 3
+        assert p3.degraded_capacity == pytest.approx(0.25)
+        # more devices than logical replicas: capped (extra stays idle)
+        c1 = ElasticController(logical_replicas=2)
+        assert c1.plan(devs).n_active == 2
+        with pytest.raises(InsufficientCapacityError):
+            c.plan(devs[:1])
+        # round-robin shard mapping is deterministic
+        assert c.shard_device(p3, 0) is devs[0]
+        assert c.shard_device(p3, 3) is devs[0]
+
+    def test_split_batch_equal_shards_and_tables(self):
+        from bigdl_tpu.utils.table import Table
+        c = ElasticController(logical_replicas=4)
+        parts = c.split_batch(np.arange(8).reshape(8, 1))
+        assert len(parts) == 4 and parts[1][0, 0] == 2
+        tabs = c.split_batch([np.arange(8), np.arange(8) * 10])
+        assert len(tabs) == 4  # Table per shard
+        # a real Table input (the Activity union) splits per element too
+        tabs2 = c.split_batch(Table(np.arange(8), np.arange(8) * 10))
+        assert len(tabs2) == 4 and isinstance(tabs2[0], Table)
+        with pytest.raises(ValueError, match="does not divide"):
+            c.split_batch(np.arange(6))
+        assert c.split_batch(None) == [None] * 4
+
+    def test_ctor_validation(self):
+        with pytest.raises(ValueError):
+            ElasticController(0)
+        with pytest.raises(ValueError):
+            ElasticController(2, min_devices=3)
+
+
+# --------------------------------------------------------------------- #
+# Data-iterator cursor (satellite: checkpoint v2 round-trip)
+# --------------------------------------------------------------------- #
+class TestDataCursor:
+    def test_mid_pass_restore_reproduces_the_stream(self):
+        items = list(range(10))
+        ds = LocalDataSet(list(items), seed=3)
+        it = ds.data(train=True)
+        for _ in range(7):
+            next(it)
+        cur = ds.cursor()  # default position: here and now (skip=7)
+        assert cur["skip"] == 7
+        expect = [next(it) for _ in range(8)]  # crosses into pass 2
+
+        ds2 = LocalDataSet(list(items), seed=999)  # seed irrelevant:
+        ds2.restore_cursor(cur)                    # rng state is restored
+        it2 = ds2.data(train=True)
+        assert [next(it2) for _ in range(8)] == expect
+
+    def test_boundary_shuffle_interleaving_is_replayed(self):
+        # reproduce the driver's one-batch lookahead: the next pass's
+        # permutation is drawn (and one item pulled) BEFORE the
+        # epoch-boundary shuffle() runs; the cursor references the last
+        # TRAINED position (pre-lookahead), as the optimizer's does
+        items = list(range(8))
+        ds = LocalDataSet(list(items), seed=5)
+        it = ds.data(train=True)
+        for _ in range(8):
+            next(it)           # pass 1
+        trained = ds.position()
+        assert trained == {"pass": 1, "served": 8}
+        lookahead = next(it)   # pass 2 begins pre-shuffle
+        ds.shuffle()           # boundary shuffle lands 1 item into pass 2
+        cur = ds.cursor(position=trained)
+        assert cur["shuffles_at"] == [1] and cur["skip"] == 0
+        expect = [lookahead] + [next(it) for _ in range(10)]
+
+        ds2 = LocalDataSet(list(items), seed=0)
+        ds2.restore_cursor(cur)
+        it2 = ds2.data(train=True)
+        got = [next(it2) for _ in range(11)]
+        assert got == expect
+
+    def test_stale_position_is_rejected(self):
+        ds = LocalDataSet(list(range(4)))
+        it = ds.data(train=True)
+        for _ in range(9):  # two passes behind
+            next(it)
+        with pytest.raises(ValueError, match="does not fall"):
+            ds.cursor(position={"pass": 1, "served": 2})
+
+    def test_restore_rejects_mismatched_dataset(self):
+        cur = LocalDataSet(list(range(4))).cursor()
+        with pytest.raises(ValueError, match="does not match"):
+            LocalDataSet(list(range(5))).restore_cursor(cur)
+
+    def test_shuffle_by_index_matches_legacy_draws(self):
+        # the cursor's order tracking must not change the rng draw
+        # sequence the golden/determinism tests pin
+        ds = LocalDataSet(list(range(16)), seed=42)
+        ds.shuffle()
+        legacy = list(range(16))
+        np.random.RandomState(42).shuffle(legacy)
+        assert ds.items == legacy
+        assert sorted(ds._order) == list(range(16))
+
+    def test_cursor_rides_checkpoint_v2(self, tmp_path):
+        model = nn.Linear(2, 1)
+        params = model.ensure_params()
+        save_checkpoint(str(tmp_path), model, params, {}, optim.SGD(),
+                        tag="t1", cursor={"marker": 7})
+        _, _, oblob = load_checkpoint(latest_checkpoint(str(tmp_path)))
+        assert oblob["cursor"] == {"marker": 7}
+
+    def test_resume_crosses_epoch_boundary_bit_identically(self, tmp_path):
+        """Acceptance for the cursor satellite: kill a multi-epoch run
+        after an epoch boundary, resume from the checkpoint in FRESH
+        objects, and the remaining trajectory + final params equal the
+        uninterrupted oracle's exactly — with no full-pass replay (the
+        resumed run pulls only the partial-epoch skip plus its own
+        batches)."""
+        rs = np.random.RandomState(0)
+        batches = [MiniBatch(rs.rand(8, 6).astype(np.float32),
+                             (rs.randint(0, 3, 8) + 1).astype(np.int32))
+                   for _ in range(4)]
+        pulls = {"n": 0}
+
+        def run(ckpt=None, end=10, count=False):
+            from bigdl_tpu.dataset.transformer import FuncTransformer
+
+            def tick(b):
+                if count:
+                    pulls["n"] += 1
+                return b
+            model = (nn.Sequential().add(nn.Linear(6, 8)).add(nn.Tanh())
+                     .add(nn.Linear(8, 3)).add(nn.LogSoftMax()))
+            model.set_params(model.init(jax.random.PRNGKey(11)))
+            ds = LocalDataSet(
+                [MiniBatch(b.get_input().copy(), b.get_target().copy())
+                 for b in batches]).transform(FuncTransformer(tick))
+            opt = LocalOptimizer(model, ds, nn.ClassNLLCriterion(), 8)
+            opt.set_optim_method(optim.SGD(learning_rate=0.1,
+                                           momentum=0.9))
+            opt.set_end_when(max_iteration(end))
+            if ckpt is not None:
+                opt.set_checkpoint(str(ckpt), several_iteration(3))
+            losses = []
+            opt.set_iteration_hook(lambda s: losses.append(s["loss"]))
+            return model, opt, losses
+
+        model_o, opt_o, losses_o = run()
+        opt_o.optimize()
+
+        ckpt = tmp_path / "ck"
+        _, opt_k, losses_k = run(ckpt=ckpt)
+        with FaultInjector(FaultSpec("train.step", at_hit=8,
+                                     exc=PermanentInjectedFault)):
+            with pytest.raises(PermanentInjectedFault):
+                opt_k.optimize()
+        assert losses_k == losses_o[:7]
+        # newest checkpoint: iter6 — mid-epoch-2 (epoch boundary at 4)
+        assert latest_checkpoint(str(ckpt)).endswith("iter6")
+
+        model_r, opt_r, losses_r = run(ckpt=ckpt, count=True)
+        assert opt_r.resume_from_latest_checkpoint()
+        assert opt_r._resume_cursor is not None
+        opt_r.optimize()
+        assert losses_r == losses_o[6:10]  # bit-identical tail
+        jax.tree_util.tree_map(
+            lambda a, b: np.testing.assert_array_equal(np.asarray(a),
+                                                       np.asarray(b)),
+            model_r.ensure_params(), model_o.ensure_params())
+        # no full-pass replay: 2 skip batches + 4 trained + lookahead
+        assert pulls["n"] <= 8
+
+
+# --------------------------------------------------------------------- #
+# Elastic training: shrink -> replay -> grow
+# --------------------------------------------------------------------- #
+def _elastic_linear(registry=None, telemetry=None, end=10, sync=1,
+                    n_batches=6):
+    rs = np.random.RandomState(0)
+    W = np.array([[1.0], [-2.0], [0.5], [3.0]], np.float32)
+    batches = [MiniBatch(rs.randn(32, 4).astype(np.float32), None)
+               for _ in range(n_batches)]
+    batches = [MiniBatch(b.get_input(),
+                         (b.get_input() @ W).astype(np.float32))
+               for b in batches]
+    model = nn.Linear(4, 1, with_bias=False)
+    model.set_params(model.init(jax.random.PRNGKey(3)))
+    from bigdl_tpu.parallel.mesh import build_mesh
+    mesh = build_mesh(data=2, model=1, devices=jax.devices()[:2])
+    opt = DistriOptimizer(model, LocalDataSet(batches), nn.MSECriterion(),
+                          mesh=mesh, retry_times=0)
+    opt.set_optim_method(optim.SGD(learning_rate=0.05, momentum=0.9))
+    opt.set_end_when(max_iteration(end))
+    opt.set_sync_interval(sync)
+    opt.set_elastic(registry=registry)
+    if telemetry is not None:
+        opt.set_telemetry(telemetry)
+    losses = {}
+    opt.set_iteration_hook(lambda s: losses.__setitem__(s["neval"],
+                                                        s["loss"]))
+    return model, opt, losses
+
+
+class TestElasticTraining:
+    def test_device_loss_shrinks_replays_and_matches_oracle(self):
+        """THE acceptance criterion: injected mesh.device_loss on a
+        2-replica mesh shrinks to the survivor, replays the interrupted
+        global batch, and finishes with params bit-identical to an
+        uninterrupted run at matched sample counts."""
+        model_o, opt_o, losses_o = _elastic_linear()
+        opt_o.optimize()
+
+        sink = InMemorySink()
+        tel = Telemetry(sink, resources=False, flight=False)
+        cluster = SimulatedCluster(2, devices=jax.devices()[:2],
+                                   telemetry=tel)
+        model_c, opt_c, losses_c = _elastic_linear(
+            registry=cluster.registry, telemetry=tel)
+        with FaultInjector(
+                FaultSpec("mesh.device_loss", at_hit=4,
+                          exc=lambda ctx: DeviceLossError(
+                              "preempted", lost=("worker1",))),
+                telemetry=tel):
+            opt_c.optimize()
+
+        assert opt_c.optim_method.state["neval"] == 10
+        # recovery is visible in the stream, in causal order
+        kinds = [r["event"] for r in _events(sink)]
+        for k in ("fault_injected", "worker_lost", "elastic_shrink",
+                  "elastic_replay"):
+            assert k in kinds, kinds
+        shrink = _events(sink, "elastic_shrink")[-1]
+        assert shrink["n_active_before"] == 2 and shrink["n_active"] == 1
+        assert shrink["degraded_capacity"] == pytest.approx(0.5)
+        # bit-identity at matched sample counts: the post-recovery record
+        # for each step equals the oracle's
+        assert losses_c == losses_o
+        jax.tree_util.tree_map(
+            lambda a, b: np.testing.assert_array_equal(np.asarray(a),
+                                                       np.asarray(b)),
+            model_c.ensure_params(), model_o.ensure_params())
+
+    def test_capacity_returns_grows_at_boundary_bit_identically(self):
+        model_o, opt_o, losses_o = _elastic_linear(end=12)
+        opt_o.optimize()
+
+        sink = InMemorySink()
+        tel = Telemetry(sink, resources=False, flight=False)
+        cluster = SimulatedCluster(2, devices=jax.devices()[:2],
+                                   telemetry=tel)
+        model_c, opt_c, losses_c = _elastic_linear(
+            registry=cluster.registry, telemetry=tel, end=12)
+        hook = opt_c.iteration_hook
+
+        def hook2(s):
+            hook(s)
+            if s["neval"] == 7:
+                cluster.restore("worker1")
+        opt_c.set_iteration_hook(hook2)
+        with FaultInjector(
+                FaultSpec("mesh.device_loss", at_hit=3,
+                          exc=lambda ctx: DeviceLossError(
+                              "preempted", lost=("worker1",)))):
+            opt_c.optimize()
+        grows = _events(sink, "elastic_grow")
+        assert grows and grows[-1]["n_active"] == 2
+        assert grows[-1]["degraded_capacity"] == 0.0
+        assert losses_c == losses_o
+        jax.tree_util.tree_map(
+            lambda a, b: np.testing.assert_array_equal(np.asarray(a),
+                                                       np.asarray(b)),
+            model_c.ensure_params(), model_o.ensure_params())
+
+    def test_collective_failure_rebuilds_same_size_and_replays(self):
+        model_o, opt_o, losses_o = _elastic_linear()
+        opt_o.optimize()
+        sink = InMemorySink()
+        tel = Telemetry(sink, resources=False, flight=False)
+        model_c, opt_c, losses_c = _elastic_linear(telemetry=tel)
+        with FaultInjector(FaultSpec("mesh.collective", at_hit=5,
+                                     exc=CollectiveError)):
+            opt_c.optimize()
+        # no device proved dead: same-size rebuild + replay, not a shrink
+        assert _events(sink, "elastic_rebuild")
+        assert not _events(sink, "elastic_shrink")
+        assert losses_c == losses_o
+        jax.tree_util.tree_map(
+            lambda a, b: np.testing.assert_array_equal(np.asarray(a),
+                                                       np.asarray(b)),
+            model_c.ensure_params(), model_o.ensure_params())
+
+    def test_below_floor_falls_through_to_job_retry(self):
+        cluster = SimulatedCluster(2, devices=jax.devices()[:2])
+        _, opt_c, _ = _elastic_linear(registry=cluster.registry)
+        # lose EVERY worker: elastic cannot replan, and with no
+        # checkpoint dir the job-level retry surfaces the error
+        with FaultInjector(
+                FaultSpec("mesh.device_loss", at_hit=2,
+                          exc=lambda ctx: DeviceLossError(
+                              "slice gone",
+                              lost=("worker0", "worker1")))):
+            with pytest.raises(DeviceLossError):
+                opt_c.optimize()
+
+    def test_elastic_requires_data_parallel_mesh(self):
+        if jax.device_count() < 4:
+            pytest.skip("needs 4 devices")
+        from bigdl_tpu.parallel.mesh import build_mesh
+        model = nn.Linear(4, 1)
+        opt = DistriOptimizer(model, LocalDataSet([]), nn.MSECriterion(),
+                              mesh=build_mesh(data=2, model=2,
+                                              devices=jax.devices()[:4]))
+        with pytest.raises(ValueError, match="data-parallel only"):
+            opt.set_elastic()
+
+    def test_persistent_failure_surfaces_after_bounded_recoveries(self):
+        """A deterministic 'recoverable' error must not livelock the
+        replay loop: after max_recoveries_per_window consecutive
+        no-progress recoveries it surfaces to the job-level retry."""
+        _, opt_c, _ = _elastic_linear()
+        opt_c.set_elastic(max_recoveries_per_window=3)
+        with FaultInjector(FaultSpec("mesh.collective", times=None,
+                                     exc=CollectiveError)) as plan:
+            with pytest.raises(CollectiveError):
+                opt_c.optimize()
+        # bounded: 3 recoveries + the surfacing attempt, not an infinite
+        # replay loop
+        assert plan.hits("mesh.collective") == 4
+
+    def test_gradient_accumulation_is_rejected(self):
+        _, opt_c, _ = _elastic_linear()
+        opt_c.set_gradient_accumulation(2)
+        with pytest.raises(ValueError, match="gradient accumulation"):
+            opt_c.optimize()
+
+    def test_indivisible_batch_fails_fast(self):
+        rs = np.random.RandomState(0)
+        batches = [MiniBatch(rs.rand(9, 4).astype(np.float32),
+                             rs.rand(9, 1).astype(np.float32))]
+        from bigdl_tpu.parallel.mesh import build_mesh
+        model = nn.Linear(4, 1)
+        opt = DistriOptimizer(model, LocalDataSet(batches),
+                              nn.MSECriterion(),
+                              mesh=build_mesh(data=2, model=1,
+                                              devices=jax.devices()[:2]),
+                              retry_times=0)
+        opt.set_end_when(max_iteration(2))
+        opt.set_elastic()
+        with pytest.raises(ValueError, match="does not divide"):
+            opt.optimize()
+
+
+# --------------------------------------------------------------------- #
+# Preemption: SIGTERM -> checkpoint -> drain -> clean abort
+# --------------------------------------------------------------------- #
+def _local_mlp(ckpt=None, end=10, preempt=True):
+    rs = np.random.RandomState(0)
+    batches = [MiniBatch(rs.rand(16, 6).astype(np.float32),
+                         (rs.randint(0, 3, 16) + 1).astype(np.int32))
+               for _ in range(4)]
+    model = (nn.Sequential().add(nn.Linear(6, 8)).add(nn.Tanh())
+             .add(nn.Linear(8, 3)).add(nn.LogSoftMax()))
+    model.set_params(model.init(jax.random.PRNGKey(9)))
+    opt = LocalOptimizer(model, LocalDataSet(batches),
+                         nn.ClassNLLCriterion(), 16)
+    opt.set_optim_method(optim.SGD(learning_rate=0.1, momentum=0.9))
+    opt.set_end_when(max_iteration(end))
+    if ckpt is not None:
+        opt.set_checkpoint(str(ckpt), several_iteration(1000))
+    if preempt:
+        opt.set_preemption_handler(grace_s=30.0)
+    return model, opt
+
+
+class TestPreemption:
+    def test_sigterm_checkpoints_drains_and_aborts_cleanly(self, tmp_path):
+        prior = signal.getsignal(signal.SIGTERM)
+        sink = InMemorySink()
+        model, opt = _local_mlp(ckpt=tmp_path)
+        opt.set_telemetry(Telemetry(sink, resources=False, flight=False))
+        opt.set_iteration_hook(
+            lambda s: signal.raise_signal(signal.SIGTERM)
+            if s["neval"] == 6 else None)
+        opt.optimize()  # returns cleanly — no exception
+        assert opt.optim_method.state["neval"] == 6
+        pre = _events(sink, "preempted")
+        assert pre and pre[-1]["checkpointed"] is True
+        assert pre[-1]["signal"] == signal.SIGTERM
+        aborts = _events(sink, "run_abort")
+        assert aborts and "preempted" in aborts[-1]["error"]
+        assert not [r for r in sink.records if r.get("type") == "run_end"]
+        # handler restoration: optimize() gave SIGTERM back
+        assert signal.getsignal(signal.SIGTERM) == prior
+
+        # the checkpoint is durable, valid, and carries the data cursor
+        got = load_latest_valid(str(tmp_path))
+        assert got is not None
+        ckpt_dir, _, _, oblob = got
+        assert ckpt_dir.endswith("iter6")
+        assert oblob["cursor"] is not None
+        assert oblob["state"]["neval"] == 6
+
+    def test_preempted_run_resumes_bit_identically(self, tmp_path):
+        model_o, opt_o = _local_mlp(end=10, preempt=False)
+        losses_o = []
+        opt_o.set_iteration_hook(lambda s: losses_o.append(s["loss"]))
+        opt_o.optimize()
+
+        _, opt_p = _local_mlp(ckpt=tmp_path, end=10)
+        opt_p.set_iteration_hook(
+            lambda s: signal.raise_signal(signal.SIGTERM)
+            if s["neval"] == 6 else None)
+        opt_p.optimize()
+
+        model_r, opt_r = _local_mlp(ckpt=tmp_path, end=10, preempt=False)
+        losses_r = []
+        opt_r.set_iteration_hook(lambda s: losses_r.append(s["loss"]))
+        assert opt_r.resume_from_latest_checkpoint()
+        opt_r.optimize()
+        assert losses_r == losses_o[6:10]
+        jax.tree_util.tree_map(
+            lambda a, b: np.testing.assert_array_equal(np.asarray(a),
+                                                       np.asarray(b)),
+            model_r.ensure_params(), model_o.ensure_params())
+
+    def test_latch_clears_so_train_more_trains(self, tmp_path):
+        """A preempted optimizer reused for another optimize() call must
+        actually train — the latch resets at entry instead of instantly
+        re-aborting every subsequent run."""
+        _, opt = _local_mlp(ckpt=tmp_path, end=10)
+        opt.set_iteration_hook(
+            lambda s: signal.raise_signal(signal.SIGTERM)
+            if s["neval"] == 3 else None)
+        opt.optimize()
+        assert opt.optim_method.state["neval"] == 3
+        opt.set_iteration_hook(None)
+        opt.optimize()  # train-more on the same instance
+        assert opt.optim_method.state["neval"] == 10
+
+    def test_preemption_without_checkpoint_is_still_clean(self):
+        sink = InMemorySink()
+        _, opt = _local_mlp(ckpt=None)
+        opt.set_telemetry(Telemetry(sink, resources=False, flight=False))
+        opt.set_iteration_hook(
+            lambda s: signal.raise_signal(signal.SIGTERM)
+            if s["neval"] == 3 else None)
+        opt.optimize()
+        pre = _events(sink, "preempted")
+        assert pre and pre[-1]["checkpointed"] is False
+        assert _events(sink, "run_abort")
+
+    def test_handler_grace_window_in_virtual_time(self):
+        now = [0.0]
+        h = PreemptionHandler(grace_s=10.0, clock=lambda: now[0])
+        assert h.deadline_remaining() is None
+        h._on_signal(signal.SIGTERM, None)
+        now[0] = 4.0
+        assert h.deadline_remaining() == pytest.approx(6.0)
+        assert h.triggered and h.signum == signal.SIGTERM
+        h.reset()
+        assert not h.triggered
+
+    def test_elastic_loop_honors_sigterm_too(self, tmp_path):
+        sink = InMemorySink()
+        _, opt, _ = _elastic_linear(
+            telemetry=Telemetry(sink, resources=False, flight=False))
+        opt.set_checkpoint(str(tmp_path), several_iteration(1000))
+        opt.set_preemption_handler(grace_s=30.0)
+        hook = opt.iteration_hook
+
+        def hook2(s):
+            hook(s)
+            if s["neval"] == 4:
+                signal.raise_signal(signal.SIGTERM)
+        opt.set_iteration_hook(hook2)
+        opt.optimize()
+        assert opt.optim_method.state["neval"] == 4
+        assert _events(sink, "preempted")
+        got = load_latest_valid(str(tmp_path))
+        assert got is not None and got[3]["cursor"] is not None
+
+
+# --------------------------------------------------------------------- #
+# /metrics: the degraded_capacity gauge
+# --------------------------------------------------------------------- #
+class TestDegradedCapacityGauge:
+    def test_fleet_events_render_as_gauges(self):
+        from bigdl_tpu.observability.export import PrometheusTextSink
+        prom = PrometheusTextSink()
+        tel = Telemetry(prom, resources=False, flight=False)
+        reg = WorkerRegistry(lease_s=100.0, telemetry=tel)
+        reg.register("w0", ["d0"]).register("w1", ["d1"])
+        reg.mark_lost("w1", reason="preempted")
+        text = prom.render()
+        assert "bigdl_tpu_degraded_capacity 0.5" in text
+        assert "bigdl_tpu_workers_alive 1" in text
+        assert "bigdl_tpu_workers_total 2" in text
+        reg.heartbeat("w1")
+        text = prom.render()
+        assert "bigdl_tpu_degraded_capacity 0.0" in text
+
+    def test_elastic_events_feed_the_gauge_spelling(self):
+        from bigdl_tpu.observability.export import PrometheusTextSink
+        prom = PrometheusTextSink()
+        tel = Telemetry(prom, resources=False, flight=False)
+        tel.event("elastic_shrink", step=7, n_active_before=2, n_active=1,
+                  alive_workers=1, degraded_capacity=0.5)
+        text = prom.render()
+        assert "bigdl_tpu_degraded_capacity 0.5" in text
+        assert "bigdl_tpu_elastic_active_devices 1" in text
+        assert "bigdl_tpu_workers_alive 1" in text
+
+    def test_fleet_events_merge_so_no_gauge_flaps_out(self):
+        # a worker event then an elastic event: BOTH families must stay
+        # in the exposition (wholesale replacement would drop
+        # workers_total after the elastic event)
+        from bigdl_tpu.observability.export import PrometheusTextSink
+        prom = PrometheusTextSink()
+        tel = Telemetry(prom, resources=False, flight=False)
+        tel.event("worker_lost", worker="w1", devices=1, alive=1, total=2,
+                  degraded_capacity=0.5, reason="preempted")
+        tel.event("elastic_shrink", step=7, n_active_before=2, n_active=1,
+                  alive_workers=1, degraded_capacity=0.5)
+        text = prom.render()
+        assert "bigdl_tpu_workers_total 2" in text
+        assert "bigdl_tpu_elastic_active_devices 1" in text
+        assert "bigdl_tpu_degraded_capacity 0.5" in text
+
+
+# --------------------------------------------------------------------- #
+# bench_cli --chaos --device-loss contract
+# --------------------------------------------------------------------- #
+def test_bench_chaos_device_loss_reports_mttr(capsys):
+    import json as _json
+
+    from bigdl_tpu.tools.bench_cli import bench_chaos_device_loss
+    out = bench_chaos_device_loss(lose_at=3, rejoin_at=6, iters=10,
+                                  batch_size=32, n_samples=256)
+    assert out["metric"] == "chaos_device_loss"
+    assert out["recovered"] is True
+    assert out["mttr_s"] is not None and out["mttr_s"] > 0
+    assert out["replayed_batches"] >= 1
+    assert out["grew_back"] is True
+    assert out["final_step"] == 10
+    line = capsys.readouterr().out.strip().splitlines()[-1]
+    assert _json.loads(line)["metric"] == "chaos_device_loss"
+
+
+# --------------------------------------------------------------------- #
+# slow tier: chaos soak (satellite)
+# --------------------------------------------------------------------- #
+@pytest.mark.slow
+def test_elastic_soak_repeated_shrink_replay_grow_on_lenet():
+    """Soak: LeNet through repeated lose -> replay -> rejoin -> grow
+    cycles plus a collective failure, asserting the full loss trajectory
+    and final params stay bit-identical to an uninterrupted elastic run
+    at matched sample counts."""
+    from bigdl_tpu.models.lenet import LeNet5
+
+    rs = np.random.RandomState(0)
+    batches = [MiniBatch(rs.rand(16, 28, 28).astype(np.float32),
+                         (rs.randint(0, 10, 16) + 1).astype(np.int32))
+               for _ in range(8)]
+
+    def run(registry=None, telemetry=None, hooks=()):
+        model = LeNet5(10)
+        model.set_params(model.init(jax.random.PRNGKey(1)))
+        from bigdl_tpu.parallel.mesh import build_mesh
+        opt = DistriOptimizer(
+            model,
+            LocalDataSet([MiniBatch(b.get_input().copy(),
+                                    b.get_target().copy())
+                          for b in batches]),
+            nn.ClassNLLCriterion(),
+            mesh=build_mesh(data=2, model=1, devices=jax.devices()[:2]),
+            retry_times=0)
+        opt.set_optim_method(optim.SGD(learning_rate=0.05, momentum=0.9))
+        opt.set_end_when(max_iteration(36))
+        opt.set_sync_interval(2)
+        opt.set_elastic(registry=registry)
+        if telemetry is not None:
+            opt.set_telemetry(telemetry)
+        losses = {}
+
+        def hook(s):
+            losses[s["neval"]] = s["loss"]
+            for fn in hooks:
+                fn(s)
+        opt.set_iteration_hook(hook)
+        opt.optimize()
+        return model, opt, losses
+
+    model_o, _, losses_o = run()
+
+    sink = InMemorySink()
+    tel = Telemetry(sink, resources=False, flight=False)
+    cluster = SimulatedCluster(2, devices=jax.devices()[:2],
+                               telemetry=tel)
+
+    def rejoin(s):
+        if s["neval"] in (8, 20):
+            cluster.restore("worker1")
+
+    lose = lambda ctx: DeviceLossError("preempted", lost=("worker1",))
+    plan = FaultInjector(
+        FaultSpec("mesh.device_loss", at_hit=4, exc=lose),
+        FaultSpec("mesh.device_loss", at_hit=15, exc=lose),
+        FaultSpec("mesh.collective", at_hit=28, exc=CollectiveError),
+        telemetry=tel)
+    with plan:
+        model_c, opt_c, losses_c = run(registry=cluster.registry,
+                                       telemetry=tel, hooks=(rejoin,))
+
+    assert opt_c.optim_method.state["neval"] == 36
+    assert len(_events(sink, "elastic_shrink")) == 2
+    assert len(_events(sink, "elastic_grow")) == 2
+    assert _events(sink, "elastic_rebuild")  # the collective failure
+    assert set(losses_c) == set(losses_o)
+    for k in sorted(losses_o):
+        # sync_interval=2: odd steps carry the stale (possibly nan)
+        # last-synced loss on both sides — nan==nan must count as equal
+        np.testing.assert_equal(losses_c[k], losses_o[k])
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_array_equal(np.asarray(a),
+                                                   np.asarray(b)),
+        model_c.ensure_params(), model_o.ensure_params())
