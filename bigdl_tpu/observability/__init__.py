@@ -7,8 +7,10 @@ needs first-class host-side instrumentation to leave a machine-readable
 record. Six layers, each usable alone:
 
 - `spans` — nested host-side trace spans with `jax.profiler.TraceAnnotation`
-  integration, exportable as Chrome/Perfetto trace JSON so host phases line
-  up with the XLA device trace.
+  integration and distributed `TraceContext` identity (trace/span/parent
+  ids, thread-local propagation, Chrome flow links), exportable as
+  Chrome/Perfetto trace JSON — several tracers (per-worker lanes) merge
+  into one file via `merge_traces`.
 - `telemetry` — structured per-step run metrics (loss, lr, throughput,
   step time, optional grad/param norms, host RSS, device memory) fanned out
   to pluggable sinks (JSONL file, in-memory, TrainSummary bridge), with a
@@ -24,14 +26,19 @@ record. Six layers, each usable alone:
   records + spans, auto-dumped to disk on `run_abort` / `fault_injected` /
   NaN-guard raise.
 - `export` — `PrometheusTextSink` + stdlib `MetricsServer`: the scrapeable
-  `/metrics` surface for step gauges, serving counters/quantiles, and
-  per-bucket circuit-breaker state.
+  `/metrics` surface for step gauges, serving counters/quantiles,
+  per-bucket circuit-breaker state, and per-objective SLO burn gauges.
+- `slo` — declarative service-level objectives (latency ceilings,
+  error-rate bounds, MFU floors, recovery MTTR) evaluated over the live
+  record stream with multi-window burn-rate alerting; alerts trigger
+  flight-recorder dumps.
 
 Both `LocalOptimizer` and `DistriOptimizer` accept these via
 `set_tracer` / `set_telemetry` / `set_health_monitors`.
 """
 
-from bigdl_tpu.observability.spans import SpanTracer
+from bigdl_tpu.observability.spans import (SpanTracer, TraceContext,
+                                           export_merged, merge_traces)
 from bigdl_tpu.observability.telemetry import (CompositeSink, InMemorySink,
                                                JsonlSink, RECORD_SCHEMAS,
                                                SummarySink, Telemetry,
@@ -50,9 +57,11 @@ from bigdl_tpu.observability.costs import (PEAK_BF16_FLOPS, jaxpr_flops,
 from bigdl_tpu.observability.compilation import CompiledFunction
 from bigdl_tpu.observability.flight import FlightRecorder
 from bigdl_tpu.observability.export import MetricsServer, PrometheusTextSink
+from bigdl_tpu.observability.slo import (DEFAULT_WINDOWS, SLO, SloEngine,
+                                         default_slos)
 
 __all__ = [
-    "SpanTracer",
+    "SpanTracer", "TraceContext", "merge_traces", "export_merged",
     "Telemetry", "TelemetrySink", "JsonlSink", "InMemorySink",
     "SummarySink", "CompositeSink", "host_rss_mb", "device_memory_stats",
     "RECORD_SCHEMAS", "validate_record", "sanitize_nonfinite",
@@ -61,4 +70,5 @@ __all__ = [
     "PEAK_BF16_FLOPS", "peak_flops", "executable_costs", "jaxpr_flops",
     "mfu", "CompiledFunction", "FlightRecorder",
     "PrometheusTextSink", "MetricsServer",
+    "SLO", "SloEngine", "default_slos", "DEFAULT_WINDOWS",
 ]

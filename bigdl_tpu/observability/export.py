@@ -85,6 +85,8 @@ class PrometheusTextSink(TelemetrySink):
         self._step: Dict = {}
         self._serving: Dict = {}
         self._fleet: Dict = {}  # newest membership/elastic event
+        self._slo: Dict[str, Dict] = {}  # newest slo_status per objective
+        self._alerts: Dict[str, int] = {}  # alert records seen per slo
         self._counts: Dict[str, int] = {}  # records seen by type
         self._engines: List = []  # (label, weakref) pairs
 
@@ -97,6 +99,11 @@ class PrometheusTextSink(TelemetrySink):
                 self._step = dict(record)
             elif rtype in ("serving_stats", "serving_summary"):
                 self._serving = dict(record)
+            elif rtype == "slo_status" and record.get("slo"):
+                self._slo[record["slo"]] = dict(record)
+            elif rtype == "alert" and record.get("slo"):
+                self._alerts[record["slo"]] = \
+                    self._alerts.get(record["slo"], 0) + 1
             elif rtype == "event" and \
                     record.get("event") in self._FLEET_EVENTS:
                 # MERGE, don't replace: worker_* events carry alive/total
@@ -148,6 +155,8 @@ class PrometheusTextSink(TelemetrySink):
             step = dict(self._step)
             serving = dict(self._serving)
             fleet = dict(self._fleet)
+            slo = {k: dict(v) for k, v in self._slo.items()}
+            alerts = dict(self._alerts)
             counts = dict(self._counts)
             engines = list(self._engines)
         lines: List[str] = []
@@ -227,6 +236,33 @@ class PrometheusTextSink(TelemetrySink):
                 if isinstance(count, int):
                     lines.append(
                         f"{self.namespace}_serving_{pre}_count {count}")
+        # --- SLO surface: newest slo_status per objective + alert counts
+        for field, name, mtype, help_ in (
+                ("burn_rate", "slo_burn_rate", "gauge",
+                 "Error-budget burn rate over the objective's shortest "
+                 "window (1 = spending exactly the budget)."),
+                ("error_budget_remaining", "slo_error_budget_remaining",
+                 "gauge",
+                 "Fraction of the objective's error budget left over its "
+                 "longest window (negative = overspent)."),
+                ("compliance", "slo_compliance", "gauge",
+                 "Good-sample fraction over the objective's longest "
+                 "window."),
+                ("alerting", "slo_alerting", "gauge",
+                 "1 while the objective's multi-window burn-rate alert "
+                 "is firing."),
+        ):
+            samples = []
+            for sname, rec in sorted(slo.items()):
+                val = rec.get(field)
+                if isinstance(val, bool):
+                    val = int(val)
+                if isinstance(val, (int, float)):
+                    samples.append(({"slo": sname}, val))
+            self._sample(lines, name, mtype, help_, samples)
+        self._sample(lines, "slo_alerts_total", "counter",
+                     "SLO burn-rate alerts fired.",
+                     [({"slo": s}, n) for s, n in sorted(alerts.items())])
         # --- live breaker state per tracked engine
         breaker_samples = []
         health_samples = []

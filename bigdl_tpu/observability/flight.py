@@ -11,8 +11,10 @@ reads first.
 
 Trigger records (see `DEFAULT_TRIGGERS`): `run_abort` (a loop died),
 `fault_injected` (a chaos plan fired — cause and the preceding steps land
-in one file), and a `nan_guard` event with `action="raise"` (the guard is
-about to abort the run). `dump(path)` also works on demand.
+in one file), a `nan_guard` event with `action="raise"` (the guard is
+about to abort the run), and an `alert` record (an SLO burn-rate breach,
+observability/slo.py — the stream around the breach is the incident's
+first artifact). `dump(path)` also works on demand.
 
 Attach a `SpanTracer` (`attach_tracer`) and each dump carries the most
 recent span tail next to the records — both optimizers wire this up
@@ -36,7 +38,8 @@ from typing import Dict, List, Optional
 logger = logging.getLogger("bigdl_tpu.observability")
 
 #: (record type, event kind or None) pairs that auto-dump the ring.
-DEFAULT_TRIGGERS = ("run_abort", "fault_injected", "nan_guard_raise")
+DEFAULT_TRIGGERS = ("run_abort", "fault_injected", "nan_guard_raise",
+                    "alert")
 
 
 def _default_dump_dir() -> str:
@@ -83,6 +86,10 @@ class FlightRecorder:
         return self
 
     def _trigger_of(self, record: Dict) -> Optional[str]:
+        if record.get("type") == "alert" and "alert" in self.triggers:
+            # an SLO burn-rate breach: the stream tail around the breach
+            # is exactly the context the responder wants on disk
+            return "alert"
         if record.get("type") != "event":
             return None
         kind = record.get("event")

@@ -220,6 +220,34 @@ RECORD_SCHEMAS: Dict[str, Dict] = {
         "optional": {"step": int, "epoch": int, "loss": _OPT_NUM,
                      "metrics": dict},
     },
+    # one per completed serving request (serving/engine.py): the
+    # critical-path phase breakdown under the request's trace identity
+    "trace": {
+        "required": {"trace_id": str, "kind": str, "status": str},
+        "optional": {"latency_ms": _NUM, "queue_wait_ms": _NUM,
+                     "batch_form_ms": _NUM, "dispatch_ms": _NUM,
+                     "forward_ms": _NUM, "fetch_ms": _NUM,
+                     "batch": int, "bucket": int,
+                     "critical_path": list, "error": str,
+                     "sample_weight": int},
+    },
+    # periodic per-objective evaluation (observability/slo.py)
+    "slo_status": {
+        "required": {"slo": str, "kind": str, "alerting": bool},
+        "optional": {"objective": _NUM, "good": int, "bad": int,
+                     "compliance": _OPT_NUM, "burn_rate": _OPT_NUM,
+                     "error_budget_remaining": _OPT_NUM,
+                     "window_s": _NUM, "alerts_fired": int},
+    },
+    # a burn-rate breach transition (observability/slo.py); the flight
+    # recorder treats this as a dump trigger
+    "alert": {
+        "required": {"slo": str, "message": str},
+        "optional": {"kind": str, "severity": str,
+                     "burn_rate_short": _NUM, "burn_rate_long": _NUM,
+                     "short_window_s": _NUM, "long_window_s": _NUM,
+                     "factor": _NUM},
+    },
 }
 
 _SERVING_FIELDS = {

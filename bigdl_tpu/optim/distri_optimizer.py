@@ -252,6 +252,11 @@ class DistriOptimizer(BaseOptimizer):
             except (KeyboardInterrupt, SystemExit):
                 raise
             except Exception as e:  # retry from newest valid checkpoint
+                # close the failed attempt's root trace span (the next
+                # attempt's begin_trace would otherwise discard it —
+                # child spans without a recorded root); idempotent with
+                # the abort path below
+                self._end_run_trace()
                 attempt += 1
                 # space failures: reset count/budget if they are far apart
                 if time.time() - last_failure > 120:
@@ -788,10 +793,18 @@ class DistriOptimizer(BaseOptimizer):
                     for i in range(R0):
                         d = controller.shard_device(plan, i)
                         p_d, ms_dv = per_dev[d]
-                        l_i, g_i, m_i = shard_fn(
-                            p_d, ms_dv, jax.device_put(xs[i], d),
-                            jax.device_put(ys[i], d),
-                            jax.device_put(shard_rngs[i], d))
+                        # per-worker lane: the shard's dispatch lands in
+                        # the owning worker's tracer (distinct Perfetto
+                        # process per SimulatedCluster worker), joined to
+                        # the driver's trace by trace_id
+                        wid = registry.worker_for_device(d)
+                        with self._worker_span(
+                                wid, "shard dispatch", shard=i,
+                                step=step_no, device=str(d)):
+                            l_i, g_i, m_i = shard_fn(
+                                p_d, ms_dv, jax.device_put(xs[i], d),
+                                jax.device_put(ys[i], d),
+                                jax.device_put(shard_rngs[i], d))
                         if d is not lead:
                             l_i = jax.device_put(l_i, lead)
                             g_i = place(g_i, lead)

@@ -66,6 +66,7 @@ class BaseOptimizer:
         self.metrics = Metrics()
         self.telemetry = None
         self.tracer = None
+        self.worker_tracers: Dict = {}  # worker_id -> per-lane SpanTracer
         self.health_monitors: List = []
         self.rng = jax.random.PRNGKey(0)
         self.matmul_precision: Optional[str] = None
@@ -561,6 +562,36 @@ class BaseOptimizer:
             return contextlib.nullcontext()
         return self.tracer.span(name, **args)
 
+    def _worker_span(self, worker_id, name: str, **args):
+        """Span on a PER-WORKER tracer (elastic per-replica dispatch):
+        each fleet worker gets its own process lane — `export_trace`
+        merges them with the driver lane into one Perfetto file. The
+        span joins the driver's active trace (same trace_id) so one
+        step's shard dispatches filter together across lanes."""
+        if self.tracer is None or worker_id is None:
+            return contextlib.nullcontext()
+        wt = self.worker_tracers.get(worker_id)
+        if wt is None:
+            from bigdl_tpu.observability.spans import SpanTracer
+            wt = SpanTracer(process_name=f"worker:{worker_id}",
+                            annotate=False)
+            self.worker_tracers[worker_id] = wt
+        ctx = None
+        cur = getattr(self.tracer, "current_context", lambda: None)()
+        if cur is not None:
+            ctx = cur.child()
+        return wt.span(name, cat="elastic", ctx=ctx, **args)
+
+    def export_trace(self, path: str) -> str:
+        """Write ONE Perfetto/Chrome trace file: the driver tracer plus
+        every per-worker elastic lane (distinct process lanes per
+        worker). Requires `set_tracer`."""
+        if self.tracer is None:
+            raise ValueError("no tracer attached; call set_tracer first")
+        from bigdl_tpu.observability.spans import export_merged
+        return export_merged(
+            path, [self.tracer, *self.worker_tracers.values()])
+
     def _nan_guard(self):
         from bigdl_tpu.observability.health import NanGuard
         for m in self.health_monitors:
@@ -690,6 +721,12 @@ class BaseOptimizer:
             self.telemetry.step(**rec)
 
     def _telemetry_run_start(self, loop: str):
+        if self.tracer is not None and hasattr(self.tracer, "begin_trace"):
+            # root trace for the run: every loop span (data fetch, step
+            # dispatch, loss sync, ...) becomes a child with this
+            # trace_id, so one run filters cleanly out of a merged trace
+            self.tracer.begin_trace(f"optimize/{loop}", cat="train",
+                                    loop=loop)
         if self.telemetry is None:
             return
         self.telemetry.run_start(
@@ -698,7 +735,12 @@ class BaseOptimizer:
             backend=jax.default_backend(), n_devices=jax.device_count(),
             sync_interval=max(1, int(getattr(self, "sync_interval", 1))))
 
+    def _end_run_trace(self):
+        if self.tracer is not None and hasattr(self.tracer, "end_trace"):
+            self.tracer.end_trace()
+
     def _telemetry_run_end(self, driver_state):
+        self._end_run_trace()
         if self.telemetry is None:
             return
         self.telemetry.run_end(step=driver_state["neval"],
@@ -710,6 +752,7 @@ class BaseOptimizer:
         """Terminal marker for a run that dies mid-loop, so every
         run_start in the stream pairs with run_end, run_retry, or
         run_abort (a hard process kill can still truncate the stream)."""
+        self._end_run_trace()
         if self.telemetry is not None:
             self.telemetry.event("run_abort", error=repr(error))
 

@@ -53,6 +53,7 @@ FLOPs plus lifetime serving MFU (null off the chip registry).
 
 from __future__ import annotations
 
+import itertools
 import logging
 import threading
 import time
@@ -65,6 +66,7 @@ from typing import Dict, List, Optional, Sequence
 import numpy as np
 
 from bigdl_tpu.dataset.sample import Sample
+from bigdl_tpu.observability.spans import TraceContext
 from bigdl_tpu.resilience import faults
 from bigdl_tpu.resilience.breaker import (CLOSED, HALF_OPEN, OPEN,
                                           CircuitBreaker)
@@ -139,13 +141,18 @@ def default_buckets(max_batch_size: int) -> List[int]:
 
 
 class _Request:
-    __slots__ = ("features", "future", "t_submit", "deadline")
+    __slots__ = ("features", "future", "t_submit", "deadline", "ctx",
+                 "seq", "t_gather")
 
-    def __init__(self, features, deadline: Optional[float]):
+    def __init__(self, features, deadline: Optional[float],
+                 ctx: Optional[TraceContext] = None, seq: int = 0):
         self.features = features
         self.future: Future = Future()
         self.t_submit = time.perf_counter()
         self.deadline = deadline  # absolute perf_counter seconds, or None
+        self.ctx = ctx            # trace identity, carried across threads
+        self.seq = seq
+        self.t_gather: Optional[float] = None  # when its batch closed
 
     def signature(self):
         return tuple((f.shape, str(f.dtype)) for f in self.features)
@@ -212,6 +219,14 @@ class InferenceEngine:
         circuit. Transitions emit `circuit_open`/`circuit_half_open`/
         `circuit_close` telemetry events; `health()` reports per-bucket
         breaker state. None (default) disables the breaker.
+    trace_sample : trace every Nth COMPLETED request; requests that
+        fail/time out/shed always trace. 1 (default) traces everything —
+        raise it to sample under heavy traffic (sampled-out requests pay
+        NO tracing cost: neither the `trace` telemetry record nor the
+        span tree is built). A traced request emits the critical-path
+        `trace` record (telemetry attached) and lands as a span tree
+        (submit->queue->dispatch->forward->fetch) on a per-request lane,
+        flow-linked to its batch's dispatch span (tracer attached).
     start : spawn the dispatcher immediately; `False` lets tests stage a
         full queue deterministically, then `start()`.
     """
@@ -223,7 +238,8 @@ class InferenceEngine:
                  inflight: int = 2, convert: bool = True,
                  telemetry=None, tracer=None, emit_every: int = 50,
                  hist_window: int = 8192,
-                 breaker: Optional[Dict] = None, start: bool = True):
+                 breaker: Optional[Dict] = None, trace_sample: int = 1,
+                 start: bool = True):
         if queue_capacity < 1:
             raise ValueError(
                 f"queue_capacity must be >= 1, got {queue_capacity}")
@@ -293,6 +309,11 @@ class InferenceEngine:
             jw.telemetry = telemetry
         self._breaker_cfg = dict(breaker) if breaker is not None else None
         self._breakers: Dict[tuple, CircuitBreaker] = {}  # under _slock
+        if trace_sample < 1:
+            raise ValueError(
+                f"trace_sample must be >= 1, got {trace_sample}")
+        self.trace_sample = int(trace_sample)
+        self._req_seq = itertools.count()
 
         _LIVE_ENGINES.add(self)
         if start:
@@ -343,6 +364,12 @@ class InferenceEngine:
             self._n["cancelled"] += len(left)
         for r in left:
             _resolve(r.future, exc=exc)
+        if left:
+            # the SLO stream must see a shutdown that failed queued
+            # work — every non-ok outcome traces (contract in the
+            # trace_sample docs)
+            self._finish_trace(left, None, time.perf_counter(),
+                               status="cancelled", error=repr(exc))
 
     def _emit_safe(self, record: Dict):
         """Telemetry sinks must never take the dispatcher down (a full
@@ -381,7 +408,13 @@ class InferenceEngine:
         now = time.perf_counter()
         deadline = now + deadline_ms / 1e3 if deadline_ms is not None \
             else None
-        req = _Request(feats, deadline)
+        # trace identity is minted at ADMISSION: whatever happens to the
+        # request later (timeout, shed, error), its record carries one
+        # trace_id covering its whole queued life
+        ctx = TraceContext.new_trace() \
+            if (self.telemetry is not None or self.tracer is not None) \
+            else None
+        req = _Request(feats, deadline, ctx=ctx, seq=next(self._req_seq))
         with self._lock:
             if self._closing:
                 raise EngineClosedError("engine is closed")
@@ -519,7 +552,9 @@ class InferenceEngine:
                 _resolve(r.future, exc=ServingTimeoutError(
                     "deadline lapsed in the serving queue "
                     f"({(now - r.t_submit) * 1e3:.1f} ms queued)"))
+                self._finish_trace([r], None, now, status="timeout")
             else:
+                r.t_gather = now
                 self.queue_wait.record(now - r.t_submit)
                 alive.append(r)
         return alive
@@ -603,6 +638,8 @@ class InferenceEngine:
                 _resolve(r.future, exc=ServingUnavailableError(
                     f"circuit open for batch domain {br.name}; request "
                     "shed without a forward"))
+            self._finish_trace(reqs, {"bucket": bucket},
+                               time.perf_counter(), status="shed")
             return None
         # a batch admitted while HALF_OPEN is THE probe; batches admitted
         # while closed carry probe=False so an outcome arriving after a
@@ -610,6 +647,9 @@ class InferenceEngine:
         # evidence — only the dispatcher thread dispatches, so the state
         # read here is consistent with the allow() above
         probe = br is not None and br.state == HALF_OPEN
+        meta = {"bucket": bucket, "n": n,
+                "t_d0": time.perf_counter(),
+                "disp_tid": threading.get_ident() % 2 ** 31}
         try:
             with self._span("serve dispatch", n=n, bucket=bucket):
                 # chaos site: no-op unless a FaultInjector is installed —
@@ -633,7 +673,10 @@ class InferenceEngine:
             for r in reqs:
                 _resolve(r.future, exc=ServingError(
                     f"batch forward failed: {e!r}"))
+            self._finish_trace(reqs, meta, time.perf_counter(),
+                               status="error", error=repr(e))
             return None
+        meta["t_d1"] = time.perf_counter()
         self.batch_sizes.record(n)
         info = getattr(self._pred._jitted, "last_info", None)
         with self._slock:
@@ -646,7 +689,7 @@ class InferenceEngine:
             if info is not None:
                 self._flops_total += info.get("flops") or 0.0
                 self._bytes_total += info.get("bytes_accessed") or 0.0
-        return reqs, y, br, probe
+        return reqs, y, br, probe, meta
 
     def _complete(self, batch):
         """Blocking device->host fetch of the OLDEST in-flight batch; newer
@@ -654,7 +697,8 @@ class InferenceEngine:
         armed) learns the final outcome here — a batch only counts as a
         success once its results actually reached the host, and only a
         half-open-admitted probe batch may close/re-trip the circuit."""
-        reqs, y, br, probe = batch
+        reqs, y, br, probe, meta = batch
+        meta["t_f0"] = time.perf_counter()
         try:
             with self._span("serve fetch", n=len(reqs)):
                 arr = np.asarray(y)
@@ -666,6 +710,8 @@ class InferenceEngine:
             for r in reqs:
                 _resolve(r.future, exc=ServingError(
                     f"batch fetch failed: {e!r}"))
+            self._finish_trace(reqs, meta, time.perf_counter(),
+                               status="error", error=repr(e))
             return
         if br is not None:
             br.record_success(probe=probe)
@@ -676,8 +722,110 @@ class InferenceEngine:
         for i, r in enumerate(reqs):
             self.latency.record(now - r.t_submit)
             _resolve(r.future, value=arr[i])
+        self._finish_trace(reqs, meta, now, status="ok")
         if batches % self.emit_every == 0:
             self._emit_safe({"type": "serving_stats", **self.stats()})
+
+    # ------------------------------------------------------------ tracing
+    def _finish_trace(self, reqs: List[_Request], meta: Optional[Dict],
+                      t_done: float, status: str,
+                      error: Optional[str] = None):
+        """Close out each request's trace: reconstruct the critical-path
+        phase breakdown (queue -> batch form -> dispatch -> forward ->
+        fetch) from the lifecycle timestamps, emit one `trace` telemetry
+        record per request, and — with a tracer attached — lay the span
+        tree on a per-request lane, flow-linked to the batch's live
+        dispatch span. Never raises: tracing failures must not take the
+        dispatcher down."""
+        if self.telemetry is None and self.tracer is None:
+            return
+        try:
+            self._finish_trace_impl(reqs, meta or {}, t_done, status,
+                                    error)
+        except Exception:
+            logger.exception("request trace emission failed; dropped")
+
+    def _finish_trace_impl(self, reqs, meta, t_done, status, error):
+        t_d0 = meta.get("t_d0")
+        t_d1 = meta.get("t_d1")
+        t_f0 = meta.get("t_f0")
+        bucket = meta.get("bucket")
+        tracer = self.tracer
+        # one perf_counter->tracer-us offset per completion batch: the
+        # engine times phases on perf_counter (stats math), the tracer on
+        # its own epoch-anchored base
+        off = tracer.now_us() - time.perf_counter() * 1e6 \
+            if tracer is not None else 0.0
+
+        def us(t):
+            return t * 1e6 + off
+
+        for r in reqs:
+            if r.ctx is None:
+                continue
+            if status == "ok" and r.seq % self.trace_sample:
+                continue  # sampled out — spans AND record both shed;
+                # non-ok outcomes always emit
+            phases = [("queue", r.t_submit,
+                       r.t_gather if r.t_gather is not None else t_done)]
+            if r.t_gather is not None and t_d0 is not None:
+                phases.append(("batch form", r.t_gather, t_d0))
+            if t_d0 is not None and t_d1 is not None:
+                phases.append(("dispatch", t_d0, t_d1))
+                if t_f0 is not None:
+                    phases.append(("forward", t_d1, t_f0))
+                    phases.append(("fetch", t_f0, t_done))
+                else:
+                    phases.append(("forward", t_d1, t_done))
+            total_ms = (t_done - r.t_submit) * 1e3
+            if tracer is not None:
+                # bounded lane pool: a request's spans render on one of 16
+                # virtual tracks (overlap beyond that only stacks
+                # visually; identity stays exact via trace_id)
+                tid = tracer.lane(f"request-{r.seq % 16}")
+                tracer.add_span("request", us(r.t_submit),
+                                (t_done - r.t_submit) * 1e6,
+                                cat="serving", tid=tid, ctx=r.ctx,
+                                status=status, bucket=bucket)
+                for name, a, b in phases:
+                    tracer.add_span(name, us(a), (b - a) * 1e6,
+                                    cat="serving", tid=tid,
+                                    ctx=r.ctx.child())
+                if r.t_gather is not None and t_d0 is not None and \
+                        "disp_tid" in meta:
+                    # flow arrow: this request's lane -> the batch's live
+                    # "serve dispatch" span on the dispatcher lane
+                    tracer.add_flow(r.seq, "batched", us(r.t_gather),
+                                    tid, us(t_d0), meta["disp_tid"])
+            if self.telemetry is None:
+                continue
+            rec = {"type": "trace", "trace_id": r.ctx.trace_id,
+                   "kind": "serving_request", "status": status,
+                   "latency_ms": round(total_ms, 3)}
+            if status == "ok" and self.trace_sample > 1:
+                # this record stands in for trace_sample completed
+                # requests; SLO consumers weight it so sampling cannot
+                # inflate the bad fraction (errors always emit at w=1)
+                rec["sample_weight"] = self.trace_sample
+            path = []
+            for name, a, b in phases:
+                ms = (b - a) * 1e3
+                path.append({"name": name, "ms": round(ms, 3),
+                             "frac": round(ms / total_ms, 4)
+                             if total_ms > 0 else None})
+            field = {"queue": "queue_wait_ms", "batch form":
+                     "batch_form_ms", "dispatch": "dispatch_ms",
+                     "forward": "forward_ms", "fetch": "fetch_ms"}
+            for p in path:
+                rec[field[p["name"]]] = p["ms"]
+            rec["critical_path"] = path
+            if bucket is not None:
+                rec["bucket"] = int(bucket)
+            if meta.get("n") is not None:
+                rec["batch"] = int(meta["n"])
+            if error is not None:
+                rec["error"] = error
+            self._emit_safe(rec)
 
     # ------------------------------------------------------------ stats
     def stats(self) -> Dict:

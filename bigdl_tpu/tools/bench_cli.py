@@ -761,7 +761,17 @@ def bench_chaos_device_loss(lose_at: int = 5, rejoin_at: int = 12,
              .add(nn_.Linear(784, 128)).add(nn_.Tanh())
              .add(nn_.Linear(128, 10)).add(nn_.LogSoftMax()))
     sink = InMemorySink()
-    telemetry = Telemetry(sink, resources=False)
+    sinks = [sink]
+    tel_dir = os.environ.get("BIGDL_TPU_TELEMETRY")
+    if tel_dir:
+        # the recovery stream on disk: `metrics_cli slo --check` replays
+        # it as the CI gate (scripts/run_ci.sh) — the MTTR judgment and
+        # the live monitor share one engine instead of ad-hoc JSON pokes
+        from bigdl_tpu.observability import JsonlSink
+        os.makedirs(tel_dir, exist_ok=True)
+        sinks.append(JsonlSink(os.path.join(
+            tel_dir, f"chaos_device_loss_{os.getpid()}.jsonl")))
+    telemetry = Telemetry(*sinks, resources=False)
     cluster = SimulatedCluster(2, devices=jax.devices()[:2],
                                telemetry=telemetry)
     ds = LocalDataSet(samples).transform(
@@ -783,8 +793,11 @@ def bench_chaos_device_loss(lose_at: int = 5, rejoin_at: int = 12,
                   exc=lambda ctx: DeviceLossError(
                       "injected preemption", lost=("worker1",))),
         telemetry=telemetry)
-    with plan:
-        opt.optimize()
+    try:
+        with plan:
+            opt.optimize()
+    finally:
+        telemetry.close()
 
     t_lost = next((r["time"] for r in sink.records
                    if r.get("event") == "worker_lost"), None)

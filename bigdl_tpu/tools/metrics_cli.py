@@ -1,23 +1,25 @@
-"""Attribution report over a recorded telemetry JSONL stream.
+"""Operator CLI over a recorded telemetry JSONL stream.
 
-`python -m bigdl_tpu.tools.metrics_cli report run.jsonl` reads the strict
-JSONL a `JsonlSink` wrote (bench `--telemetry` / `--attribution` runs, or
-any `Telemetry(JsonlSink(...))` training run) and prints the
-performance-attribution tables the MFU push needs:
+Three subcommands, all reading the strict JSONL a `JsonlSink` wrote
+(bench `--telemetry` / `--attribution` runs, or any
+`Telemetry(JsonlSink(...))` run):
 
-- run header (loop, model, backend, devices, sync interval),
-- step summary: iterations, throughput, per-step wall time, MFU trend
-  (first half vs second half of the run — a falling trend means the run
-  never reached steady state or something is degrading),
-- host-vs-device phase breakdown from the run_end `Metrics` phase table
-  (data fetch / H2D / compute / checkpoint means per iteration),
-- top compile costs: the `compile` records sorted by compile seconds —
-  where warmup went, and whether traffic recompiled (cache_hit=false past
-  warmup is the recompile-storm smell),
-- event summary (nan_guard / straggler / retry / fault counts).
+- `report <run.jsonl>` — the performance-attribution tables the MFU push
+  needs: run header, step summary with MFU trend, host-vs-device phase
+  breakdown, top compile costs, event counts.
+- `trace <trace_id> <run.jsonl>` — one request's critical-path tree from
+  its `trace` record (phase timings + shares); prefixes match, so the
+  short id an operator copied off a log line works.
+- `slo [--check] [knobs] <run.jsonl>` — replay the stream through the
+  SAME `SloEngine` the live monitor runs (observability/slo.py) and
+  print the per-objective table; `--check` exits 1 when any objective is
+  out of budget (alert fired, budget overspent, or an unrecovered worker
+  loss) — the CI gate `scripts/run_ci.sh` uses on the chaos smoke.
 
-Exit code 0 on a readable stream with at least one record; 2 otherwise.
-Used by docs/PERF.md updates and smoke-tested in tests/test_bench.py.
+Exit codes: 0 = output printed and (with --check) every objective inside
+budget; 1 = --check found a violated objective; 2 = unreadable/empty
+stream or bad usage — always with a one-line diagnostic, never a
+traceback.
 """
 
 from __future__ import annotations
@@ -33,7 +35,9 @@ def _raise_constant(tok):  # json parse_constant hook
 
 def load_records(path: str) -> List[Dict]:
     """Parse one strict-JSON record per line; raises on NaN/Infinity
-    tokens (the JsonlSink contract says they cannot appear)."""
+    tokens (the JsonlSink contract says they cannot appear) and on lines
+    that are valid JSON but not objects (a record stream holds dicts —
+    anything else would crash every consumer downstream)."""
     records = []
     with open(path) as f:
         for i, line in enumerate(f, 1):
@@ -41,10 +45,14 @@ def load_records(path: str) -> List[Dict]:
             if not line:
                 continue
             try:
-                records.append(json.loads(
-                    line, parse_constant=_raise_constant))
+                rec = json.loads(line, parse_constant=_raise_constant)
             except ValueError as e:
                 raise ValueError(f"{path}:{i}: {e}") from None
+            if not isinstance(rec, dict):
+                raise ValueError(
+                    f"{path}:{i}: not a JSON object "
+                    f"({type(rec).__name__})")
+            records.append(rec)
     return records
 
 
@@ -74,6 +82,13 @@ def report(path: str, out: TextIO = None) -> int:
         return 2
     if not records:
         print(f"metrics_cli: {path} holds no records", file=sys.stderr)
+        return 2
+    if all(r.get("type") in ("run_start", None) for r in records):
+        # header-only stream: a run that died before its first step (or a
+        # stream from the wrong file) — nothing to tabulate
+        print(f"metrics_cli: {path} holds only run_start/untyped records "
+              "(no steps, compiles, serving snapshots, or events) — "
+              "nothing to report", file=sys.stderr)
         return 2
 
     w = out.write
@@ -160,18 +175,172 @@ def report(path: str, out: TextIO = None) -> int:
     return 0
 
 
+def trace(trace_id: str, paths: List[str], out: TextIO = None) -> int:
+    """Print the critical-path tree of the `trace` record(s) whose
+    trace_id starts with `trace_id` (operators copy short prefixes);
+    returns the process exit code."""
+    out = out or sys.stdout
+    w = out.write
+    found = 0
+    for path in paths:
+        try:
+            records = load_records(path)
+        except (OSError, ValueError) as e:
+            print(f"metrics_cli: cannot read {path}: {e}",
+                  file=sys.stderr)
+            return 2
+        for r in records:
+            if r.get("type") != "trace" or \
+                    not str(r.get("trace_id", "")).startswith(trace_id):
+                continue
+            found += 1
+            w(f"trace {r['trace_id']}  [{r.get('kind', '?')}]  "
+              f"status={r.get('status', '?')}")
+            if r.get("bucket") is not None:
+                w(f"  bucket={r['bucket']} batch={r.get('batch', '?')}")
+            w("\n")
+            total = r.get("latency_ms")
+            w(f"└─ request {'':<18}{_fmt(total, ' ms')}\n")
+            path_items = r.get("critical_path") or []
+            for i, p in enumerate(path_items):
+                last = i == len(path_items) - 1
+                branch = "└─" if last else "├─"
+                frac = p.get("frac")
+                bar = "#" * int(round((frac or 0) * 20))
+                w(f"   {branch} {p.get('name', '?'):<12} "
+                  f"{_fmt(p.get('ms'), ' ms'):>12}  "
+                  f"{_fmt(round(frac * 100, 1) if frac is not None else None, '%'):>7}  {bar}\n")
+            if r.get("error"):
+                w(f"   error: {r['error']}\n")
+    if not found:
+        print(f"metrics_cli: no trace record matching {trace_id!r} in "
+              f"{', '.join(paths)}", file=sys.stderr)
+        return 2
+    return 0
+
+
+def slo(paths: List[str], check: bool = False,
+        latency_p99_ms: float = 100.0, error_objective: float = 0.999,
+        mfu_floor: Optional[float] = None, mttr_s: float = 60.0,
+        out: TextIO = None) -> int:
+    """Replay recorded streams through the live `SloEngine` and print the
+    per-objective table; with `check`, exit 1 when any objective is out
+    of budget. Returns the process exit code."""
+    out = out or sys.stdout
+    from bigdl_tpu.observability.slo import SloEngine, default_slos
+    engine = SloEngine(default_slos(
+        latency_p99_ms=latency_p99_ms, error_objective=error_objective,
+        mfu_floor=mfu_floor, mttr_s=mttr_s))
+    total = 0
+    for path in paths:
+        try:
+            records = load_records(path)
+        except (OSError, ValueError) as e:
+            print(f"metrics_cli: cannot read {path}: {e}",
+                  file=sys.stderr)
+            return 2
+        total += len(records)
+        for r in records:
+            engine.emit(r)
+    if total == 0:
+        print(f"metrics_cli: {', '.join(paths)} hold(s) no records",
+              file=sys.stderr)
+        return 2
+    engine.finalize()
+    if all(s["good"] + s["bad"] == 0 for s in engine.status()):
+        # header-only / wrong-file stream: every objective evaluated to
+        # "no data" — a gate that silently passes on that would approve
+        # a run that died before its first step
+        print(f"metrics_cli: {', '.join(paths)} produced no SLO samples "
+              "(no trace/step/worker_lost records) — nothing to "
+              "evaluate", file=sys.stderr)
+        return 2
+    w = out.write
+    w(f"== slo: {', '.join(paths)} ==\n")
+    w(f"  {'objective':<22} {'kind':<10} {'good':>7} {'bad':>6} "
+      f"{'compliance':>11} {'budget left':>12} {'burn':>8}  state\n")
+    for s in engine.status():
+        state = "ALERT" if (s["alerting"] or s["alerts_fired"]) else \
+            ("no data" if s["good"] + s["bad"] == 0 else "ok")
+        w(f"  {s['slo']:<22} {s['kind']:<10} {s['good']:>7} {s['bad']:>6} "
+          f"{_fmt(s['compliance']):>11} "
+          f"{_fmt(s['error_budget_remaining']):>12} "
+          f"{_fmt(s['burn_rate']):>8}  {state}\n")
+    violated = engine.violated()
+    if violated:
+        w(f"  VIOLATED: {', '.join(violated)}\n")
+    if check:
+        return 1 if violated else 0
+    return 0
+
+
+_USAGE = """\
+usage: python -m bigdl_tpu.tools.metrics_cli <command> ...
+  report <run.jsonl> [more.jsonl ...]      attribution tables
+  trace  <trace_id> <run.jsonl> [...]      one request's critical path
+  slo    [--check] [--latency-p99-ms N] [--error-objective F]
+         [--mfu-floor F] [--mttr-s N] <run.jsonl> [...]
+                                           SLO replay / CI gate\
+"""
+
+
 def main(argv=None) -> int:
-    """CLI entry: `metrics_cli report <run.jsonl> [more.jsonl ...]`."""
+    """CLI entry; see `_USAGE`."""
     argv = list(sys.argv[1:] if argv is None else argv)
-    if not argv or argv[0] in ("-h", "--help") or argv[0] != "report" \
-            or len(argv) < 2:
-        print("usage: python -m bigdl_tpu.tools.metrics_cli report "
-              "<run.jsonl> [more.jsonl ...]", file=sys.stderr)
-        return 0 if argv and argv[0] in ("-h", "--help") else 2
-    rc = 0
-    for path in argv[1:]:
-        rc = max(rc, report(path))
-    return rc
+    if argv and argv[0] in ("-h", "--help"):
+        print(_USAGE, file=sys.stderr)
+        return 0
+    if not argv or argv[0] not in ("report", "trace", "slo"):
+        print(_USAGE, file=sys.stderr)
+        return 2
+    cmd, rest = argv[0], argv[1:]
+    if cmd == "report":
+        if not rest:
+            print(_USAGE, file=sys.stderr)
+            return 2
+        rc = 0
+        for path in rest:
+            rc = max(rc, report(path))
+        return rc
+    if cmd == "trace":
+        if len(rest) < 2:
+            print(_USAGE, file=sys.stderr)
+            return 2
+        return trace(rest[0], rest[1:])
+    # slo
+    kw: Dict = {}
+    paths: List[str] = []
+    flags = {"--latency-p99-ms": ("latency_p99_ms", float),
+             "--error-objective": ("error_objective", float),
+             "--mfu-floor": ("mfu_floor", float),
+             "--mttr-s": ("mttr_s", float)}
+    i = 0
+    while i < len(rest):
+        a = rest[i]
+        if a == "--check":
+            kw["check"] = True
+        elif a in flags:
+            name, conv = flags[a]
+            if i + 1 >= len(rest):
+                print(f"metrics_cli: {a} needs a value", file=sys.stderr)
+                return 2
+            try:
+                kw[name] = conv(rest[i + 1])
+            except ValueError:
+                print(f"metrics_cli: bad value for {a}: {rest[i + 1]!r}",
+                      file=sys.stderr)
+                return 2
+            i += 1
+        elif a.startswith("-"):
+            print(f"metrics_cli: unknown flag {a}", file=sys.stderr)
+            return 2
+        else:
+            paths.append(a)
+        i += 1
+    if not paths:
+        print(_USAGE, file=sys.stderr)
+        return 2
+    return slo(paths, **kw)
 
 
 if __name__ == "__main__":

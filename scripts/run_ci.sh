@@ -28,8 +28,16 @@ python -m pytest tests/ -q -m "not slow"
 
 # elastic chaos smoke: injected mesh.device_loss -> shrink -> replay ->
 # grow on the virtual 8-device mesh (tiny MLP, few steps); exits nonzero
-# unless the run recovers, and emits the MTTR JSON line for the CI log
-python -m bigdl_tpu.tools.bench_cli --chaos --device-loss
+# unless the run recovers, and emits the MTTR JSON line for the CI log.
+# The recovery judgment is an SLO gate, not ad-hoc JSON inspection: the
+# run's telemetry stream replays through the same SloEngine the live
+# monitor runs, and an MTTR past 60s (or an unrecovered loss) fails CI
+chaos_dir="$(mktemp -d)"
+trap 'rm -rf "$chaos_dir"' EXIT  # a failing gate must not leak the dir
+BIGDL_TPU_TELEMETRY="$chaos_dir" \
+  python -m bigdl_tpu.tools.bench_cli --chaos --device-loss
+python -m bigdl_tpu.tools.metrics_cli slo --check --mttr-s 60 \
+  "$chaos_dir"/chaos_device_loss_*.jsonl
 
 python -c "
 import jax; jax.config.update('jax_platforms', 'cpu')

@@ -1,0 +1,753 @@
+"""End-to-end request tracing + SLO burn-rate monitoring (PR 12).
+
+The acceptance contract:
+- ONE `predict()` yields a single trace_id whose spans cover
+  submit -> queue -> dispatch -> forward -> fetch, plus a `trace`
+  telemetry record carrying the critical-path breakdown,
+- a 2-worker SimulatedCluster elastic run exports ONE Perfetto file with
+  a distinct process lane per worker (pid from process_name
+  registration — the old hardcoded `pid: 1` collided),
+- an injected latency breach raises the multi-window burn-rate alert:
+  `alert` record emitted, flight recorder dumped, `/metrics` SLO gauges
+  move, and `metrics_cli slo --check` exits nonzero,
+- `metrics_cli report` on missing/empty/header-only streams exits with a
+  one-line diagnostic (never a traceback),
+- the Prometheus exposition stays grammar-clean under hostile label
+  values (quotes/backslashes/newlines round-trip `_escape_label`).
+"""
+
+import json
+import re
+import threading
+import time
+
+import jax
+import numpy as np
+import pytest
+
+import bigdl_tpu.nn as nn
+import bigdl_tpu.optim as optim
+from bigdl_tpu.dataset.dataset import LocalDataSet
+from bigdl_tpu.dataset.sample import MiniBatch, Sample
+from bigdl_tpu.observability import (InMemorySink, JsonlSink,
+                                     PrometheusTextSink, RECORD_SCHEMAS,
+                                     SLO, SloEngine, SpanTracer, Telemetry,
+                                     TraceContext, default_slos,
+                                     merge_traces, validate_record)
+from bigdl_tpu.observability.export import _escape_label
+from bigdl_tpu.observability.flight import FlightRecorder
+from bigdl_tpu.optim.distri_optimizer import DistriOptimizer
+from bigdl_tpu.optim.trigger import max_iteration
+from bigdl_tpu.resilience import SimulatedCluster
+from bigdl_tpu.serving import InferenceEngine
+from bigdl_tpu.tools import metrics_cli
+
+
+def _model():
+    return nn.Sequential().add(nn.Linear(4, 2)).add(nn.LogSoftMax())
+
+
+def _spans(tracer, name=None):
+    evs = [e for e in tracer.events if e["ph"] == "X"]
+    if name is not None:
+        evs = [e for e in evs if e["name"] == name]
+    return evs
+
+
+# ------------------------------------------------------------------ #
+# TraceContext + span identity
+# ------------------------------------------------------------------ #
+class TestTraceContext:
+    def test_new_trace_and_child_ids(self):
+        root = TraceContext.new_trace()
+        assert root.parent_id is None
+        kid = root.child()
+        assert kid.trace_id == root.trace_id
+        assert kid.parent_id == root.span_id
+        assert kid.span_id != root.span_id
+
+    def test_spans_without_context_stay_identity_free(self):
+        tr = SpanTracer(process_name="ctx-free", annotate=False)
+        with tr.span("plain", kind="phase"):
+            pass
+        (ev,) = _spans(tr)
+        assert ev["args"] == {"kind": "phase"}  # no trace ids injected
+
+    def test_trace_propagates_to_nested_spans(self):
+        tr = SpanTracer(process_name="ctx-prop", annotate=False)
+        with tr.trace("root") as ctx:
+            with tr.span("child"):
+                with tr.span("grandchild"):
+                    pass
+        by_name = {e["name"]: e for e in _spans(tr)}
+        assert by_name["root"]["args"]["trace_id"] == ctx.trace_id
+        assert by_name["child"]["args"]["trace_id"] == ctx.trace_id
+        assert by_name["child"]["args"]["parent_id"] == ctx.span_id
+        assert by_name["grandchild"]["args"]["parent_id"] == \
+            by_name["child"]["args"]["span_id"]
+        # context closed with the trace
+        assert tr.current_context() is None
+
+    def test_begin_end_trace_is_non_lexical_and_idempotent(self):
+        tr = SpanTracer(process_name="ctx-begin", annotate=False)
+        ctx = tr.begin_trace("run", loop="local")
+        with tr.span("inside"):
+            pass
+        tr.end_trace()
+        tr.end_trace()  # idempotent
+        by_name = {e["name"]: e for e in _spans(tr)}
+        assert by_name["inside"]["args"]["trace_id"] == ctx.trace_id
+        assert by_name["run"]["args"]["span_id"] == ctx.span_id
+        assert tr.current_context() is None
+
+    def test_begin_trace_preserves_enclosing_user_trace(self):
+        """A run inside `with tracer.trace(...)` joins the user's trace
+        as a child and RESTORES the user context on end_trace — spans
+        after the run keep their identity (review fix)."""
+        tr = SpanTracer(process_name="ctx-nested", annotate=False)
+        with tr.trace("experiment") as outer:
+            run_ctx = tr.begin_trace("optimize/local")
+            assert run_ctx.trace_id == outer.trace_id  # joined, not new
+            assert run_ctx.parent_id == outer.span_id
+            tr.end_trace()
+            assert tr.current_context() is outer  # restored
+            with tr.span("eval"):
+                pass
+        by_name = {e["name"]: e for e in _spans(tr)}
+        assert by_name["eval"]["args"]["trace_id"] == outer.trace_id
+        assert by_name["eval"]["args"]["parent_id"] == outer.span_id
+        assert tr.current_context() is None
+
+    def test_stale_root_from_crashed_run_is_superseded(self):
+        tr = SpanTracer(process_name="ctx-stale", annotate=False)
+        stale = tr.begin_trace("optimize/attempt1")  # crashed: no end
+        fresh = tr.begin_trace("optimize/attempt2")
+        assert fresh.trace_id != stale.trace_id
+        with tr.span("step"):
+            pass
+        tr.end_trace()
+        assert tr.current_context() is None
+        step = [e for e in _spans(tr, "step")][0]
+        assert step["args"]["trace_id"] == fresh.trace_id
+
+
+# ------------------------------------------------------------------ #
+# process lanes (satellite: the pid-1 collision fix)
+# ------------------------------------------------------------------ #
+class TestProcessLanes:
+    def test_distinct_names_get_distinct_pids(self):
+        a = SpanTracer(process_name="lane-test-a", annotate=False)
+        b = SpanTracer(process_name="lane-test-b", annotate=False)
+        assert a.pid != b.pid
+        # re-registration of a name reuses its lane
+        a2 = SpanTracer(process_name="lane-test-a", annotate=False)
+        assert a2.pid == a.pid
+
+    def test_merge_keeps_lanes_apart(self):
+        a = SpanTracer(process_name="merge-w0", annotate=False)
+        b = SpanTracer(process_name="merge-w1", annotate=False)
+        with a.span("work"):
+            pass
+        with b.span("work"):
+            pass
+        doc = merge_traces([a, b])
+        assert doc["displayTimeUnit"] == "ms"
+        spans = [e for e in doc["traceEvents"]
+                 if e["ph"] == "X" and e["name"] == "work"]
+        assert len(spans) == 2
+        assert spans[0]["pid"] != spans[1]["pid"]
+        procs = {e["pid"]: e["args"]["name"]
+                 for e in doc["traceEvents"]
+                 if e.get("name") == "process_name"}
+        assert procs[a.pid] == "merge-w0"
+        assert procs[b.pid] == "merge-w1"
+
+    def test_thread_lanes_carry_thread_names(self):
+        tr = SpanTracer(process_name="lane-threads", annotate=False)
+
+        def work():
+            with tr.span("threaded"):
+                pass
+
+        t = threading.Thread(target=work, name="my-worker")
+        t.start()
+        t.join()
+        doc = tr.to_chrome_trace()
+        names = {e["args"]["name"] for e in doc["traceEvents"]
+                 if e.get("name") == "thread_name"}
+        assert "my-worker" in names
+
+
+# ------------------------------------------------------------------ #
+# serving request traces (acceptance: one predict -> one trace_id)
+# ------------------------------------------------------------------ #
+class TestServingRequestTrace:
+    def test_predict_yields_one_trace_covering_the_lifecycle(self):
+        sink = InMemorySink()
+        tr = SpanTracer(process_name="serve-acc", annotate=False)
+        eng = InferenceEngine(_model(), max_batch_size=8, max_wait_ms=0.5,
+                              telemetry=Telemetry(sink, resources=False),
+                              tracer=tr)
+        try:
+            eng.warmup(Sample(np.ones(4, np.float32)))
+            eng.predict(Sample(np.ones(4, np.float32)))
+        finally:
+            eng.close()
+        traces = [r for r in sink.records if r["type"] == "trace"]
+        assert len(traces) == 1
+        rec = traces[0]
+        validate_record(rec)
+        assert rec["kind"] == "serving_request"
+        assert rec["status"] == "ok"
+        for field in ("latency_ms", "queue_wait_ms", "batch_form_ms",
+                      "dispatch_ms", "forward_ms", "fetch_ms"):
+            assert isinstance(rec[field], (int, float)), field
+        # the phase breakdown accounts for the whole request
+        path = {p["name"]: p for p in rec["critical_path"]}
+        assert set(path) == {"queue", "batch form", "dispatch", "forward",
+                             "fetch"}
+        assert sum(p["ms"] for p in path.values()) == \
+            pytest.approx(rec["latency_ms"], abs=0.01)
+        # ONE trace_id covers the span tree submit->...->fetch
+        tid = rec["trace_id"]
+        names = {e["name"] for e in _spans(tr)
+                 if e.get("args", {}).get("trace_id") == tid}
+        assert {"request", "queue", "batch form", "dispatch", "forward",
+                "fetch"} <= names
+        root = [e for e in _spans(tr, "request")
+                if e["args"]["trace_id"] == tid][0]
+        # children lie inside the root request span
+        for e in _spans(tr):
+            if e.get("args", {}).get("trace_id") == tid and \
+                    e["name"] != "request":
+                assert e["ts"] >= root["ts"] - 1
+                assert e["ts"] + e["dur"] <= \
+                    root["ts"] + root["dur"] + 1
+        # the batch dispatch span is flow-linked to the request lane
+        flows = [e for e in tr.events if e["ph"] in ("s", "f")]
+        assert {e["ph"] for e in flows} == {"s", "f"}
+        ids = {e["id"] for e in flows}
+        assert all(
+            len([e for e in flows if e["id"] == i]) == 2 for i in ids)
+
+    def test_queue_timeout_emits_timeout_trace(self):
+        sink = InMemorySink()
+        eng = InferenceEngine(_model(), max_batch_size=4, max_wait_ms=0.0,
+                              telemetry=Telemetry(sink, resources=False),
+                              start=False)
+        try:
+            fut = eng.submit(Sample(np.ones(4, np.float32)),
+                             deadline_ms=0.001)
+            time.sleep(0.01)
+            eng.start()
+            with pytest.raises(Exception):
+                fut.result(timeout=5)
+        finally:
+            eng.close()
+        traces = [r for r in sink.records if r["type"] == "trace"]
+        assert any(r["status"] == "timeout" for r in traces)
+        rec = [r for r in traces if r["status"] == "timeout"][0]
+        validate_record(rec)
+        assert rec["queue_wait_ms"] >= 0
+
+    def test_trace_sample_sheds_records_and_spans(self):
+        sink = InMemorySink()
+        tr = SpanTracer(process_name="serve-sampled", annotate=False)
+        eng = InferenceEngine(_model(), max_batch_size=2, max_wait_ms=0.0,
+                              telemetry=Telemetry(sink, resources=False),
+                              tracer=tr, trace_sample=4)
+        try:
+            eng.warmup(Sample(np.ones(4, np.float32)))
+            for _ in range(8):
+                eng.predict(Sample(np.ones(4, np.float32)))
+        finally:
+            eng.close()
+        traces = [r for r in sink.records if r["type"] == "trace"]
+        assert 1 <= len(traces) <= 2  # seqs 0..7, every 4th
+        # sampled-out requests pay NO span-tree cost either (review fix)
+        assert len(_spans(tr, "request")) == len(traces)
+        # each emitted ok record stands in for trace_sample requests, so
+        # SLO consumers see an unbiased good/bad ratio (review fix)
+        assert all(r["sample_weight"] == 4 for r in traces)
+        for r in traces:
+            validate_record(r)
+
+    def test_sampled_stream_does_not_inflate_slo_bad_fraction(self):
+        slo = SLO("err", "error_rate", objective=0.999,
+                  windows=((300.0, 3600.0, 14.4),))
+        eng = SloEngine([slo])
+        # 1-in-100 sampling of a healthy stream with one real error:
+        # 10 ok records at weight 100 + 1 error at weight 1
+        for i in range(10):
+            eng.emit({"type": "trace", "trace_id": f"t{i}",
+                      "kind": "serving_request", "status": "ok",
+                      "latency_ms": 1.0, "sample_weight": 100,
+                      "time": 100.0 + i})
+        eng.emit({"type": "trace", "trace_id": "bad",
+                  "kind": "serving_request", "status": "error",
+                  "latency_ms": 1.0, "time": 111.0})
+        (s,) = eng.status()
+        assert s["good"] == 1000 and s["bad"] == 1
+        assert not s["alerting"]
+        assert s["error_budget_remaining"] > 0  # ~0.1% error rate
+
+    def test_drainless_close_traces_cancelled_requests(self):
+        sink = InMemorySink()
+        eng = InferenceEngine(_model(), max_batch_size=4, max_wait_ms=0.0,
+                              telemetry=Telemetry(sink, resources=False),
+                              start=False)
+        futs = [eng.submit(Sample(np.ones(4, np.float32)))
+                for _ in range(3)]
+        eng.close(drain=False)
+        for f in futs:
+            with pytest.raises(Exception):
+                f.result(timeout=1)
+        traces = [r for r in sink.records if r["type"] == "trace"]
+        assert len(traces) == 3
+        assert all(r["status"] == "cancelled" for r in traces)
+        for r in traces:
+            validate_record(r)
+
+    def test_untelemetered_engine_pays_nothing(self):
+        eng = InferenceEngine(_model(), max_batch_size=4, max_wait_ms=0.0)
+        try:
+            out = eng.predict(Sample(np.ones(4, np.float32)))
+            assert out.shape == (2,)
+        finally:
+            eng.close()
+
+
+# ------------------------------------------------------------------ #
+# elastic fleet: per-worker process lanes (acceptance criterion 2)
+# ------------------------------------------------------------------ #
+@pytest.mark.skipif(jax.device_count() < 2, reason="needs >= 2 devices")
+class TestElasticWorkerLanes:
+    def test_two_worker_run_exports_one_trace_with_worker_lanes(
+            self, tmp_path):
+        rs = np.random.RandomState(0)
+        W = np.array([[1.0], [-2.0], [0.5], [3.0]], np.float32)
+        batches = [MiniBatch(x, (x @ W).astype(np.float32)) for x in
+                   (rs.randn(32, 4).astype(np.float32) for _ in range(6))]
+        model = nn.Linear(4, 1, with_bias=False)
+        model.set_params(model.init(jax.random.PRNGKey(3)))
+        from bigdl_tpu.parallel.mesh import build_mesh
+        cluster = SimulatedCluster(2, devices=jax.devices()[:2])
+        opt = DistriOptimizer(model, LocalDataSet(batches),
+                              nn.MSECriterion(),
+                              mesh=build_mesh(data=2, model=1,
+                                              devices=jax.devices()[:2]),
+                              retry_times=0)
+        opt.set_optim_method(optim.SGD(learning_rate=0.05))
+        opt.set_end_when(max_iteration(4))
+        opt.set_elastic(registry=cluster.registry)
+        tracer = SpanTracer(process_name="elastic-driver", annotate=False)
+        opt.set_tracer(tracer)
+        opt.optimize()
+
+        assert set(opt.worker_tracers) == {"worker0", "worker1"}
+        # every shard dispatch landed in its owning worker's lane, under
+        # the driver's run trace
+        run_root = _spans(tracer, "optimize/distri_elastic")
+        assert len(run_root) == 1
+        run_tid = run_root[0]["args"]["trace_id"]
+        for wid, wt in opt.worker_tracers.items():
+            shard_spans = _spans(wt, "shard dispatch")
+            assert shard_spans, wid
+            assert all(e["args"]["trace_id"] == run_tid
+                       for e in shard_spans)
+
+        path = str(tmp_path / "fleet.trace.json")
+        opt.export_trace(path)
+        with open(path) as f:
+            doc = json.load(f)
+        procs = {e["args"]["name"]: e["pid"]
+                 for e in doc["traceEvents"]
+                 if e.get("name") == "process_name"}
+        assert {"elastic-driver", "worker:worker0",
+                "worker:worker1"} <= set(procs)
+        assert len(set(procs.values())) == len(procs)  # distinct lanes
+
+
+class TestRetryTraceClosure:
+    def test_failed_attempt_root_span_is_recorded(self, tmp_path):
+        """Review fix: a checkpoint-retried attempt must record its root
+        `optimize/distri` span before the next attempt begins — child
+        spans with no recorded root are unnavigable in Perfetto."""
+        from bigdl_tpu.optim.optimizer import Optimizer
+        from bigdl_tpu.optim.trigger import several_iteration
+        from bigdl_tpu.resilience import FaultInjector, FaultSpec, \
+            RetryPolicy
+        rs = np.random.RandomState(0)
+        X = rs.rand(128, 8).astype(np.float32)
+        Y = (rs.randint(0, 2, 128) + 1).astype(np.int32)
+        model = (nn.Sequential().add(nn.Linear(8, 4)).add(nn.Tanh())
+                 .add(nn.Linear(4, 2)).add(nn.LogSoftMax()))
+        tracer = SpanTracer(process_name="retry-trace", annotate=False)
+        opt = Optimizer(model, (X, Y), nn.ClassNLLCriterion(),
+                        batch_size=32, local=False,
+                        retry_policy=RetryPolicy(max_retries=2,
+                                                 base_delay_s=0.01,
+                                                 seed=0))
+        opt.set_optim_method(optim.SGD(learning_rate=0.1))
+        opt.set_end_when(max_iteration(6))
+        opt.set_checkpoint(str(tmp_path), several_iteration(2))
+        opt.set_tracer(tracer)
+        with FaultInjector(FaultSpec("train.step", at_hit=4)):
+            opt.optimize()
+        roots = _spans(tracer, "optimize/distri")
+        assert len(roots) == 2  # failed attempt AND the successful one
+        assert roots[0]["args"]["trace_id"] != \
+            roots[1]["args"]["trace_id"]
+
+
+# ------------------------------------------------------------------ #
+# SLO engine
+# ------------------------------------------------------------------ #
+def _trace_rec(i, t, ok=True, latency=5.0):
+    return {"type": "trace", "trace_id": f"t{i:06d}",
+            "kind": "serving_request",
+            "status": "ok" if ok else "error",
+            "latency_ms": latency, "time": t}
+
+
+class TestSloEngine:
+    def test_slo_declaration_validation(self):
+        with pytest.raises(ValueError):
+            SLO("x", "nope")
+        with pytest.raises(ValueError):
+            SLO("x", "latency")  # needs threshold_ms
+        with pytest.raises(ValueError):
+            SLO("x", "latency", objective=1.5, threshold_ms=1)
+        with pytest.raises(ValueError):
+            SloEngine([SLO("dup", "error_rate"),
+                       SLO("dup", "error_rate")])
+
+    def test_burn_rate_math(self):
+        slo = SLO("lat", "latency", objective=0.99, threshold_ms=50.0,
+                  windows=((10.0, 100.0, 14.4),))
+        eng = SloEngine([slo])
+        t = 0.0
+        for i in range(50):
+            eng.emit(_trace_rec(i, t + i * 0.1, latency=5.0))
+        for i in range(50):
+            eng.emit(_trace_rec(100 + i, t + 5 + i * 0.1, latency=500.0))
+        (s,) = eng.status()
+        assert s["compliance"] == pytest.approx(0.5)
+        # bad_frac 0.5 over budget 0.01 -> burn 50x
+        assert s["burn_rate"] == pytest.approx(50.0, rel=0.15)
+        assert s["error_budget_remaining"] < 0
+
+    def test_short_window_spike_alone_does_not_alert(self):
+        slo = SLO("lat", "latency", objective=0.9, threshold_ms=50.0,
+                  windows=((1.0, 100.0, 5.0),))
+        eng = SloEngine([slo])
+        # 200 good spread over 100s, then 3 bad inside the last second:
+        # short burn = 10x >= 5, long burn = 3/203/0.1 ~ 0.15x < 5
+        for i in range(200):
+            eng.emit(_trace_rec(i, i * 0.5, latency=1.0))
+        for i in range(3):
+            eng.emit(_trace_rec(500 + i, 99.5 + i * 0.1, latency=999.0))
+        (s,) = eng.status()
+        assert not s["alerting"] and s["alerts_fired"] == 0
+
+    def test_mttr_recovery_and_unrecovered_loss(self):
+        slo = SLO("mttr", "mttr", objective=0.99, max_s=10.0,
+                  windows=((60.0, 600.0, 2.0),))
+        eng = SloEngine([slo])
+        eng.emit({"type": "event", "event": "worker_lost", "time": 100.0})
+        eng.emit({"type": "step", "step": 1, "time": 103.0})
+        (s,) = eng.status()
+        assert (s["good"], s["bad"]) == (1, 0)
+        # a second loss that NEVER recovers counts bad at finalize
+        eng.emit({"type": "event", "event": "worker_lost", "time": 200.0})
+        eng.finalize()
+        (s,) = eng.status()
+        assert s["bad"] == 1
+        assert "mttr" in eng.violated()
+
+    def test_single_bad_sample_fails_budget_without_paging(self):
+        """Review fix: on a stream shorter than the short window, one
+        bad request must not fire the page alert (min_samples guard) —
+        but the CI gate still fails through the overspent budget."""
+        slo = SLO("err", "error_rate", objective=0.999,
+                  windows=((300.0, 3600.0, 14.4),))
+        eng = SloEngine([slo])
+        eng.emit(_trace_rec(0, 100.0, ok=True))
+        eng.emit(_trace_rec(1, 100.1, ok=False))
+        (s,) = eng.status()
+        assert not s["alerting"] and s["alerts_fired"] == 0
+        assert s["error_budget_remaining"] < 0
+        assert eng.violated() == ["err"]
+        # with enough evidence the same burn DOES page
+        for i in range(2, 22):
+            eng.emit(_trace_rec(i, 100.0 + i * 0.1, ok=False))
+        (s,) = eng.status()
+        assert s["alerts_fired"] >= 1
+
+    def test_lazy_prune_never_skews_window_queries(self):
+        slo = SLO("err", "error_rate", objective=0.9,
+                  windows=((5.0, 10.0, 100.0),))
+        eng = SloEngine([slo])
+        # 3000 samples over 300s: horizon (10s) stale front accumulates
+        # lazily, window queries must stay exact regardless
+        for i in range(3000):
+            eng.emit(_trace_rec(i, i * 0.1, ok=(i % 2 == 0)))
+        (s,) = eng.status()
+        # exactly the last 10s (cut boundary inclusive: 101 samples)
+        assert s["good"] + s["bad"] == 101
+        assert s["compliance"] == pytest.approx(0.5, abs=0.01)
+
+    def test_mfu_floor_skips_null_mfu(self):
+        slo = SLO("mfu", "mfu", objective=0.9, floor=0.25,
+                  windows=((60.0, 600.0, 2.0),))
+        eng = SloEngine([slo])
+        eng.emit({"type": "step", "step": 1, "time": 1.0})  # CPU: no mfu
+        (s,) = eng.status()
+        assert s["good"] + s["bad"] == 0
+        eng.emit({"type": "step", "step": 2, "mfu": 0.31, "time": 2.0})
+        eng.emit({"type": "step", "step": 3, "mfu": 0.10, "time": 3.0})
+        (s,) = eng.status()
+        assert (s["good"], s["bad"]) == (1, 1)
+
+    def test_slo_records_validate_against_schema(self):
+        sink = InMemorySink()
+        tel = Telemetry(sink, resources=False, flight=False)
+        eng = SloEngine(default_slos(windows=((1.0, 5.0, 1.5),)),
+                        emit_every_s=0.5).attach(tel)
+        for i in range(30):
+            tel.emit(_trace_rec(i, 1000.0 + i * 0.1, latency=500.0))
+        types = {r["type"] for r in sink.records}
+        assert {"slo_status", "alert"} <= types
+        for r in sink.records:
+            validate_record(r)
+        assert eng.violated()
+
+
+# ------------------------------------------------------------------ #
+# THE breach acceptance: alert -> flight dump -> gauges -> CI gate
+# ------------------------------------------------------------------ #
+class TestLatencyBreachEndToEnd:
+    def test_injected_breach_alerts_dumps_and_fails_the_gate(
+            self, tmp_path):
+        jsonl = str(tmp_path / "run.jsonl")
+        flight = FlightRecorder(dump_dir=str(tmp_path / "flight"))
+        prom = PrometheusTextSink()
+        sink = InMemorySink()
+        tel = Telemetry(JsonlSink(jsonl), prom, sink, resources=False,
+                        flight=flight)
+        # every real request breaches a sub-microsecond ceiling; tiny
+        # windows so the burn-rate rule sees both windows hot at once
+        slo_engine = SloEngine(
+            [SLO("serving_latency_p99", "latency", objective=0.99,
+                 threshold_ms=1e-4, windows=((0.5, 2.0, 1.5),))],
+            emit_every_s=0.1).attach(tel)
+        eng = InferenceEngine(_model(), max_batch_size=4, max_wait_ms=0.0,
+                              telemetry=tel)
+        try:
+            eng.warmup(Sample(np.ones(4, np.float32)))
+            for _ in range(10):
+                eng.predict(Sample(np.ones(4, np.float32)))
+        finally:
+            eng.close()
+        tel.close()
+        # the alert record is in the stream
+        alerts = [r for r in sink.records if r["type"] == "alert"]
+        assert alerts and alerts[0]["slo"] == "serving_latency_p99"
+        # ... the flight recorder dumped on it
+        assert flight.dumps >= 1
+        with open(flight.last_dump_path) as f:
+            dump = json.load(f)
+        assert dump["trigger"] == "alert"
+        assert any(r.get("type") == "alert" for r in dump["records"])
+        # ... the /metrics gauges moved
+        render = prom.render()
+        assert re.search(
+            r'slo_burn_rate\{slo="serving_latency_p99"\} \d', render)
+        assert 'slo_alerting{slo="serving_latency_p99"} 1' in render
+        assert 'slo_alerts_total{slo="serving_latency_p99"}' in render
+        # ... and the CI gate fails the recorded stream
+        assert metrics_cli.main(
+            ["slo", "--check", "--latency-p99-ms", "0.0001", jsonl]) == 1
+        # a sane ceiling passes the same stream
+        assert metrics_cli.main(
+            ["slo", "--check", "--latency-p99-ms", "60000", jsonl]) == 0
+
+
+# ------------------------------------------------------------------ #
+# metrics_cli report hardening (satellite)
+# ------------------------------------------------------------------ #
+class TestMetricsCliReportDiagnostics:
+    def _assert_one_line_diag(self, capsys, rc):
+        assert rc == 2
+        err = capsys.readouterr().err
+        assert err.startswith("metrics_cli:")
+        assert len(err.strip().splitlines()) == 1
+
+    def test_missing_file(self, tmp_path, capsys):
+        rc = metrics_cli.main(["report", str(tmp_path / "nope.jsonl")])
+        self._assert_one_line_diag(capsys, rc)
+
+    def test_empty_file(self, tmp_path, capsys):
+        p = tmp_path / "empty.jsonl"
+        p.write_text("")
+        rc = metrics_cli.main(["report", str(p)])
+        self._assert_one_line_diag(capsys, rc)
+
+    def test_header_only_stream(self, tmp_path, capsys):
+        p = tmp_path / "hdr.jsonl"
+        p.write_text(json.dumps(
+            {"type": "run_start", "time": 1.0, "loop": "local"}) + "\n")
+        rc = metrics_cli.main(["report", str(p)])
+        self._assert_one_line_diag(capsys, rc)
+
+    def test_non_object_line(self, tmp_path, capsys):
+        p = tmp_path / "bad.jsonl"
+        p.write_text("[1, 2]\n")
+        rc = metrics_cli.main(["report", str(p)])
+        self._assert_one_line_diag(capsys, rc)
+
+    def test_trace_subcommand_prints_tree(self, tmp_path, capsys):
+        p = tmp_path / "t.jsonl"
+        p.write_text(json.dumps(
+            {"type": "trace", "trace_id": "abcd1234", "time": 1.0,
+             "kind": "serving_request", "status": "ok",
+             "latency_ms": 8.0,
+             "critical_path": [
+                 {"name": "queue", "ms": 2.0, "frac": 0.25},
+                 {"name": "forward", "ms": 6.0, "frac": 0.75}]}) + "\n")
+        assert metrics_cli.main(["trace", "abcd", str(p)]) == 0
+        out = capsys.readouterr().out
+        assert "abcd1234" in out and "forward" in out and "75" in out
+        assert metrics_cli.main(["trace", "zzzz", str(p)]) == 2
+
+    def test_usage_and_unknown_flags(self, capsys):
+        assert metrics_cli.main([]) == 2
+        assert metrics_cli.main(["-h"]) == 0
+        assert metrics_cli.main(["slo", "--bogus", "x.jsonl"]) == 2
+        assert metrics_cli.main(["slo", "--mttr-s", "abc", "x.jsonl"]) == 2
+
+    def test_slo_check_rejects_sampleless_stream(self, tmp_path, capsys):
+        """Review fix: a header-only stream must not pass the gate — no
+        SLO ever sampled means there is nothing to approve."""
+        p = tmp_path / "hdr.jsonl"
+        p.write_text(json.dumps(
+            {"type": "run_start", "time": 1.0, "loop": "local"}) + "\n")
+        rc = metrics_cli.main(["slo", "--check", str(p)])
+        assert rc == 2
+        err = capsys.readouterr().err
+        assert "no SLO samples" in err
+
+
+# ------------------------------------------------------------------ #
+# Prometheus exposition conformance (satellite)
+# ------------------------------------------------------------------ #
+_LABEL_VALUE = r'"(?:\\[\\n"]|[^"\\\n])*"'
+_SAMPLE_RE = re.compile(
+    r"^[a-zA-Z_:][a-zA-Z0-9_:]*"
+    r"(?:\{[a-zA-Z_][a-zA-Z0-9_]*=" + _LABEL_VALUE +
+    r"(?:,[a-zA-Z_][a-zA-Z0-9_]*=" + _LABEL_VALUE + r")*\})?"
+    r" (?:[+-]?(?:\d+\.?\d*(?:[eE][+-]?\d+)?|Inf)|NaN)$")
+_COMMENT_RE = re.compile(
+    r"^# (?:HELP [a-zA-Z_:][a-zA-Z0-9_:]* .*"
+    r"|TYPE [a-zA-Z_:][a-zA-Z0-9_:]* "
+    r"(?:counter|gauge|histogram|summary|untyped))$")
+
+
+def _unescape_label(s):
+    out, i = [], 0
+    while i < len(s):
+        if s[i] == "\\" and i + 1 < len(s):
+            out.append({"\\": "\\", "n": "\n", '"': '"'}
+                       .get(s[i + 1], "\\" + s[i + 1]))
+            i += 2
+        else:
+            out.append(s[i])
+            i += 1
+    return "".join(out)
+
+
+class TestPrometheusConformance:
+    NASTY = 'we"ird\\bucket\nname{x="1"}'
+
+    def test_escape_label_round_trips(self):
+        for s in (self.NASTY, "\\", '"', "\n", "a\\nb", 'plain',
+                  '\\"', "trailing\\"):
+            assert _unescape_label(_escape_label(s)) == s
+
+    def test_rendered_exposition_reparses(self):
+        sink = PrometheusTextSink()
+        sink.emit({"type": "step", "step": 3, "loss": 0.5, "lr": 0.01,
+                   "mfu": float("nan"), "time": 1.0})
+        sink.emit({"type": "serving_stats", "queue_depth": 1,
+                   "submitted": 10, "completed": 9, "failed": 1,
+                   "timed_out": 0, "rejected": 0, "cancelled": 0,
+                   "shed": 0, "batches": 5, "bucket_hits": 4, "rows": 10,
+                   "padded_rows": 1, "bucket_hit_rate": 0.8,
+                   "pad_fraction": 0.1, "latency_ms_p50": 1.5,
+                   "latency_ms_count": 9, "queue_wait_ms_count": 9,
+                   "batch_size_count": 5, "time": 2.0})
+        # hostile label values via the slo name and a tracked engine name
+        sink.emit({"type": "slo_status", "slo": self.NASTY,
+                   "kind": "latency", "alerting": True,
+                   "burn_rate": 2.5, "error_budget_remaining": -0.5,
+                   "compliance": 0.9, "time": 3.0})
+        sink.emit({"type": "alert", "slo": self.NASTY, "message": "m",
+                   "time": 4.0})
+        eng = InferenceEngine(_model(), max_batch_size=4, max_wait_ms=0.0,
+                              breaker={"failure_threshold": 2})
+        try:
+            eng.predict(Sample(np.ones(4, np.float32)))
+            sink.track_engine(eng, name=self.NASTY)
+            render = sink.render()
+        finally:
+            eng.close()
+        assert render.endswith("\n")
+        for line in render.splitlines():
+            assert _SAMPLE_RE.match(line) or _COMMENT_RE.match(line), \
+                f"exposition line fails the text-format grammar: {line!r}"
+        # the hostile values round-trip through a conforming parser
+        m = re.search(r'serving_engine_up\{engine=(' + _LABEL_VALUE +
+                      r'),', render)
+        assert m and _unescape_label(m.group(1)[1:-1]) == self.NASTY
+        m = re.search(r'slo_burn_rate\{slo=(' + _LABEL_VALUE + r')\}',
+                      render)
+        assert m and _unescape_label(m.group(1)[1:-1]) == self.NASTY
+
+
+# ------------------------------------------------------------------ #
+# schema contract extension (satellite)
+# ------------------------------------------------------------------ #
+class TestNewRecordSchemas:
+    def test_new_types_declared(self):
+        assert {"trace", "slo_status", "alert"} <= set(RECORD_SCHEMAS)
+
+    def test_real_serving_stream_with_traces_validates(self):
+        sink = InMemorySink()
+        tel = Telemetry(sink, resources=False, flight=False)
+        SloEngine(default_slos(windows=((0.5, 2.0, 1.5),)),
+                  emit_every_s=0.1).attach(tel)
+        eng = InferenceEngine(_model(), max_batch_size=4, max_wait_ms=0.0,
+                              telemetry=tel, emit_every=1)
+        try:
+            eng.warmup(Sample(np.ones(4, np.float32)))
+            for _ in range(4):
+                eng.predict(Sample(np.ones(4, np.float32)))
+        finally:
+            eng.close()
+        types = {r["type"] for r in sink.records}
+        assert {"trace", "slo_status", "serving_stats"} <= types
+        for r in sink.records:
+            validate_record(r)
+
+    def test_violations_rejected(self):
+        with pytest.raises(ValueError):  # missing required trace_id
+            validate_record({"type": "trace", "time": 1.0, "kind": "x",
+                             "status": "ok"})
+        with pytest.raises(ValueError):  # undeclared field, closed type
+            validate_record({"type": "slo_status", "time": 1.0,
+                             "slo": "s", "kind": "latency",
+                             "alerting": False, "surprise": 1})
+        with pytest.raises(ValueError):  # mistyped alerting
+            validate_record({"type": "slo_status", "time": 1.0,
+                             "slo": "s", "kind": "latency",
+                             "alerting": "yes"})
