@@ -76,8 +76,8 @@ class PrometheusTextSink(TelemetrySink):
 
     #: membership/elastic events whose newest occurrence drives the
     #: fleet-capacity gauges (`degraded_capacity`, `workers_alive`, ...).
-    _FLEET_EVENTS = ("worker_lost", "worker_joined", "elastic_shrink",
-                     "elastic_grow", "elastic_rebuild")
+    _FLEET_EVENTS = ("worker_lost", "worker_joined", "worker_left",
+                     "elastic_shrink", "elastic_grow", "elastic_rebuild")
 
     def __init__(self, namespace: str = "bigdl_tpu"):
         self.namespace = namespace
@@ -85,6 +85,7 @@ class PrometheusTextSink(TelemetrySink):
         self._step: Dict = {}
         self._serving: Dict = {}
         self._fleet: Dict = {}  # newest membership/elastic event
+        self._serving_fleet: Dict = {}  # newest serving_fleet record
         self._slo: Dict[str, Dict] = {}  # newest slo_status per objective
         self._alerts: Dict[str, int] = {}  # alert records seen per slo
         self._counts: Dict[str, int] = {}  # records seen by type
@@ -99,6 +100,8 @@ class PrometheusTextSink(TelemetrySink):
                 self._step = dict(record)
             elif rtype in ("serving_stats", "serving_summary"):
                 self._serving = dict(record)
+            elif rtype == "serving_fleet":
+                self._serving_fleet = dict(record)
             elif rtype == "slo_status" and record.get("slo"):
                 self._slo[record["slo"]] = dict(record)
             elif rtype == "alert" and record.get("slo"):
@@ -154,6 +157,7 @@ class PrometheusTextSink(TelemetrySink):
         with self._lock:
             step = dict(self._step)
             serving = dict(self._serving)
+            serving_fleet = dict(self._serving_fleet)
             fleet = dict(self._fleet)
             slo = {k: dict(v) for k, v in self._slo.items()}
             alerts = dict(self._alerts)
@@ -236,6 +240,43 @@ class PrometheusTextSink(TelemetrySink):
                 if isinstance(count, int):
                     lines.append(
                         f"{self.namespace}_serving_{pre}_count {count}")
+        # --- serving fleet: the newest serving_fleet record
+        # (serving/fleet.py emits one per membership change / maintain
+        # tick), so a scrape sees replica loss, drains, and re-routes
+        # the moment the fleet does
+        for field, mtype, help_ in (
+                ("replicas_alive", "gauge",
+                 "Serving replicas currently in rotation."),
+                ("replicas_draining", "gauge",
+                 "Serving replicas draining (lease missed / scaling "
+                 "down)."),
+                ("replicas_total", "gauge",
+                 "Serving replicas tracked by the fleet (any state)."),
+                ("reroutes_total", "counter",
+                 "Requests re-routed off a lost/drained replica."),
+                ("reroute_failed_total", "counter",
+                 "Re-route attempts that found no healthy replica."),
+                ("routed_total", "counter",
+                 "Requests dispatched by the fleet router."),
+                ("drains_total", "counter",
+                 "Replica drains (crash, lease expiry, or injected)."),
+                ("scale_ups_total", "counter",
+                 "Autoscale scale-up events."),
+                ("scale_downs_total", "counter",
+                 "Autoscale scale-down events."),
+        ):
+            val = serving_fleet.get(field)
+            if isinstance(val, (int, float)) and not isinstance(val, bool):
+                self._sample(lines, f"serving_fleet_{field}", mtype,
+                             help_, [(None, val)])
+        depths = serving_fleet.get("replica_queue_depth")
+        if isinstance(depths, dict):
+            self._sample(
+                lines, "serving_fleet_replica_queue_depth", "gauge",
+                "Queued requests per serving replica.",
+                [({"replica": rid}, d) for rid, d in sorted(depths.items())
+                 if isinstance(d, (int, float))
+                 and not isinstance(d, bool)])
         # --- SLO surface: newest slo_status per objective + alert counts
         for field, name, mtype, help_ in (
                 ("burn_rate", "slo_burn_rate", "gauge",

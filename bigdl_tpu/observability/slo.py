@@ -17,10 +17,15 @@ Sample sources:
   `error_rate` ("request finished ok at all") objectives,
 - `step` records feed `mfu` ("per-step MFU at or above the floor"; steps
   with no MFU figure — CPU runs — are skipped, not failed),
-- `worker_lost` events paired with the first subsequent `step` record
-  feed `mttr` ("training recovered within max_s"); a loss that NEVER
-  recovers counts bad at `finalize()` — a CI gate must fail a chaos run
-  that simply died.
+- `worker_lost` events paired with the first subsequent proof of
+  recovery feed `mttr`, matched to the lost worker's domain: a `step`
+  record recovers a TRAINING loss, a status-ok `trace` record recovers
+  a SERVING loss (events carrying `role: serving`, stamped from the
+  fleet registry's worker metadata — fleet streams have no step
+  records, and in a co-located stream an unrelated serving request
+  must not "recover" a dead training worker). A loss that NEVER
+  recovers counts bad at `finalize()` — a CI gate must fail a chaos
+  run that simply died.
 
 On an alert transition the engine emits an `alert` record (which the
 crash flight recorder treats as a dump trigger — the stream tail around
@@ -220,6 +225,7 @@ class SloEngine(TelemetrySink):
         self._last_status_t: Optional[float] = None
         self._now: Optional[float] = None  # newest record time seen
         self._pending_loss_t: Optional[float] = None  # open worker_lost
+        self._pending_loss_role: Optional[str] = None  # its worker role
 
     # ------------------------------------------------------------ wiring
     def attach(self, telemetry) -> "SloEngine":
@@ -253,6 +259,7 @@ class SloEngine(TelemetrySink):
             elif rtype == "event" and record.get("event") == "worker_lost":
                 if self._pending_loss_t is None:
                     self._pending_loss_t = t
+                    self._pending_loss_role = record.get("role")
             transitions = self._evaluate(self._now)
             emit_status = False
             if self._last_status_t is None or \
@@ -270,6 +277,23 @@ class SloEngine(TelemetrySink):
 
     def _ingest_trace(self, record: Dict, t: float):
         status = record.get("status", "ok")
+        if record.get("kind") == "serving_request" \
+                and record.get("replica_id") \
+                and status in ("cancelled", "shed", "timeout"):
+            # a FLEET-managed engine's transient-shaped failure: the
+            # router may transparently re-route it (drain casualty,
+            # open-breaker shed, queue lapse), so the caller-visible
+            # outcome of that request is a SEPARATE record — an ok
+            # trace on the survivor, or a `fleet_request` record when
+            # the router surfaced the failure. Counting the replica-
+            # internal record too would burn budget for requests whose
+            # callers saw success (measured live: a drained-and-
+            # re-routed batch double-burned the error budget).
+            # Standalone engines (no replica_id) have no router hiding
+            # failures, so their records all still count; permanent
+            # engine errors (status="error") always surface unchanged
+            # and count exactly once from the engine record.
+            return
         latency = record.get("latency_ms")
         # a sampled serving stream (engine trace_sample=N) emits 1-in-N
         # ok records carrying sample_weight=N but EVERY failure at
@@ -289,18 +313,36 @@ class SloEngine(TelemetrySink):
             elif s.kind == "error_rate":
                 for _ in range(w):
                     self._series[s.name].add(t, status == "ok")
+        # a completed request is recovery proof for an open SERVING
+        # worker loss (role=serving on the worker_lost event, stamped by
+        # the fleet's registry metadata): fleet streams carry trace
+        # records, not steps, and "requests flow again" is exactly what
+        # a serving MTTR measures. The role gate keeps a co-located
+        # stream honest both ways — an unrelated serving request must
+        # not "recover" a dead TRAINING worker (and vice versa below)
+        if self._pending_loss_t is not None and status == "ok" \
+                and self._pending_loss_role == "serving":
+            dt = t - self._pending_loss_t
+            for s in self.slos:
+                if s.kind == "mttr":
+                    self._series[s.name].add(t, dt <= s.max_s)
+            self._pending_loss_t = None
+            self._pending_loss_role = None
 
     def _ingest_step(self, record: Dict, t: float):
         mfu = record.get("mfu")
         for s in self.slos:
             if s.kind == "mfu" and isinstance(mfu, (int, float)):
                 self._series[s.name].add(t, mfu >= s.floor)
-        if self._pending_loss_t is not None:
+        if self._pending_loss_t is not None \
+                and self._pending_loss_role != "serving":
+            # a training step cannot prove a SERVING worker recovered
             dt = t - self._pending_loss_t
             for s in self.slos:
                 if s.kind == "mttr":
                     self._series[s.name].add(t, dt <= s.max_s)
             self._pending_loss_t = None
+            self._pending_loss_role = None
 
     def finalize(self):
         """End-of-stream accounting (replay mode): a worker loss with NO
@@ -315,6 +357,7 @@ class SloEngine(TelemetrySink):
                 if s.kind == "mttr":
                     self._series[s.name].add(t, False)
             self._pending_loss_t = None
+            self._pending_loss_role = None
             transitions = self._evaluate(t)
         for rec in transitions:
             self._emit_own(rec)

@@ -14,7 +14,7 @@ sink. Record types:
 - `run_end`    — final step count plus the `Metrics.as_dict()` phase table.
 
 The serving engine adds `serving_stats`/`serving_summary` through the same
-sinks. Every record type's field contract is declared in `RECORD_SCHEMAS`
+sinks (and the serving fleet adds `serving_fleet`). Every record type's field contract is declared in `RECORD_SCHEMAS`
 (checked by `validate_record`, pinned by tests) and documented
 field-by-field in docs/observability.md.
 
@@ -229,7 +229,18 @@ RECORD_SCHEMAS: Dict[str, Dict] = {
                      "forward_ms": _NUM, "fetch_ms": _NUM,
                      "batch": int, "bucket": int,
                      "critical_path": list, "error": str,
-                     "sample_weight": int},
+                     "sample_weight": int, "replica_id": str},
+    },
+    # fleet-level counters/gauges (serving/fleet.py), one per
+    # membership change or maintain() tick; PrometheusTextSink renders
+    # the newest as the serving_fleet_* gauge family
+    "serving_fleet": {
+        "required": {"replicas_alive": int, "replicas_total": int,
+                     "replicas_draining": int, "reroutes_total": int},
+        "optional": {"routed_total": int, "affinity_routes_total": int,
+                     "reroute_failed_total": int, "drains_total": int,
+                     "scale_ups_total": int, "scale_downs_total": int,
+                     "replica_queue_depth": dict},
     },
     # periodic per-objective evaluation (observability/slo.py)
     "slo_status": {

@@ -21,6 +21,9 @@ Instrumented sites (see docs/resilience.md for the full contract):
     mesh.device_loss / mesh.collective     DistriOptimizer elastic loop
     prefetch.worker                        dataset/prefetch.py workers
     serve.forward                          serving/engine.py dispatch
+    serve.replica_crash / serve.route /
+    serve.drain                            serving/fleet.py (registered
+                                           via register_site on import)
     fs.remote_io                           utils/filesystem.py remote ops
     telemetry.sink                         observability Telemetry.emit
 

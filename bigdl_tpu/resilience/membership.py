@@ -75,6 +75,8 @@ class WorkerRegistry:
       `alive`, `total`, `degraded_capacity`.
     - `worker_lost` — on `mark_lost` (observed failure) or `sweep()`
       lease expiry (`reason: "lease_expired"`). Same fleet fields.
+    - `worker_left` — on `remove()` (voluntary departure: serving
+      scale-down, planned decommission). Same fleet fields.
 
     `alive_devices()` flattens alive workers' devices in REGISTRATION
     order — a stable order, so an elastic replan maps logical replicas
@@ -103,6 +105,11 @@ class WorkerRegistry:
             alive = len(self._alive_unlocked())
             total = len(self._workers)
             degraded = self._degraded_unlocked()
+        role = (worker.meta or {}).get("role")
+        if role is not None:
+            # e.g. "serving" for fleet replicas — consumers (SloEngine)
+            # pick the recovery proof matching the worker's domain
+            extra = {"role": role, **extra}
         try:
             self.telemetry.event(
                 kind, worker=worker.worker_id,
@@ -160,6 +167,18 @@ class WorkerRegistry:
         wid = self.worker_for_device(device)
         if wid is not None:
             self.mark_lost(wid, reason=reason)
+
+    def remove(self, worker_id: str) -> bool:
+        """Deregister a worker entirely — a VOLUNTARY departure (serving
+        scale-down, planned decommission), not a failure: emits
+        `worker_left` (with the post-departure fleet counts), never
+        `worker_lost`. Returns True when the worker existed."""
+        with self._lock:
+            w = self._workers.pop(worker_id, None)
+        if w is None:
+            return False
+        self._event("worker_left", w, reason="removed")
+        return True
 
     def sweep(self) -> List[str]:
         """Expire stale leases; returns the newly-lost worker ids."""

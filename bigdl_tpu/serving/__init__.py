@@ -1,22 +1,27 @@
-"""bigdl_tpu.serving — dynamic micro-batching inference engine.
+"""bigdl_tpu.serving — micro-batching inference engine + replicated fleet.
 
 BigDL 2.0 grew Cluster Serving (arXiv 2204.01715 §4) over the original
 training stack: queued requests, arrival-rate batching, backpressure, and
 latency reporting. This package is that layer rebuilt TPU-native and
-in-process: an `InferenceEngine` that concurrent clients `submit()`
-`Sample`s to and get futures back, with
+in-process, in two tiers:
 
-- micro-batching under a `(max_batch_size, max_wait_ms)` policy,
-- power-of-two shape buckets so the jitted forward compiles once per
-  bucket (`warmup()` precompiles them all),
-- a bounded queue with blocking or reject-on-full admission, per-request
-  deadlines, and error isolation per batch,
-- drain-then-shutdown `close()` joining the non-daemon dispatcher, and
-- queue-wait / batch-size / latency histograms plus queue-depth and
-  bucket-hit-rate gauges through `observability.Telemetry` sinks.
+- `InferenceEngine` — one replica: concurrent clients `submit()`
+  `Sample`s and get futures back, with micro-batching under a
+  `(max_batch_size, max_wait_ms)` policy, power-of-two shape buckets so
+  the jitted forward compiles once per bucket (`warmup()` precompiles
+  them all), a bounded queue with blocking or reject-on-full admission,
+  per-request deadlines, error isolation per batch, an optional
+  per-bucket circuit breaker, and drain-then-shutdown `close()`.
+- `ServingFleet` — N replicas behind a `Router`: lease/heartbeat
+  membership (`resilience.membership.WorkerRegistry`), consistent-hash
+  session affinity + power-of-two-choices balancing, drain with bounded
+  grace and exactly-once re-route on replica loss
+  (`ServingReroutedError` when re-route is not allowed), re-warm on
+  rejoin, and `AutoscalePolicy`-driven grow/shrink that never drops
+  accepted work.
 
-`optim.predictor.PredictionService` is the API-compatible facade over this
-engine. See docs/serving.md for architecture and tuning.
+`optim.predictor.PredictionService` is the API-compatible facade over the
+single engine. See docs/serving.md for architecture and tuning.
 """
 
 from bigdl_tpu.serving.engine import (EngineClosedError, InferenceEngine,
@@ -24,10 +29,15 @@ from bigdl_tpu.serving.engine import (EngineClosedError, InferenceEngine,
                                       ServingTimeoutError,
                                       ServingUnavailableError,
                                       default_buckets)
+from bigdl_tpu.serving.fleet import (AutoscalePolicy, Router,
+                                     ServingFleet, ServingReroutedError,
+                                     default_router_policy)
 from bigdl_tpu.serving.stats import WindowedHistogram
 
 __all__ = [
     "InferenceEngine", "default_buckets", "WindowedHistogram",
+    "ServingFleet", "Router", "AutoscalePolicy", "default_router_policy",
     "ServingError", "QueueFullError", "ServingTimeoutError",
-    "ServingUnavailableError", "EngineClosedError",
+    "ServingUnavailableError", "ServingReroutedError",
+    "EngineClosedError",
 ]

@@ -227,6 +227,10 @@ class InferenceEngine:
         `trace` record (telemetry attached) and lands as a span tree
         (submit->queue->dispatch->forward->fetch) on a per-request lane,
         flow-linked to its batch's dispatch span (tracer attached).
+    replica_id : optional fleet identity (serving/fleet.py). When set,
+        every `trace` record this engine emits carries a `replica_id`
+        field, so a merged fleet stream attributes each request to the
+        replica that served it.
     start : spawn the dispatcher immediately; `False` lets tests stage a
         full queue deterministically, then `start()`.
     """
@@ -239,7 +243,7 @@ class InferenceEngine:
                  telemetry=None, tracer=None, emit_every: int = 50,
                  hist_window: int = 8192,
                  breaker: Optional[Dict] = None, trace_sample: int = 1,
-                 start: bool = True):
+                 replica_id: Optional[str] = None, start: bool = True):
         if queue_capacity < 1:
             raise ValueError(
                 f"queue_capacity must be >= 1, got {queue_capacity}")
@@ -313,6 +317,7 @@ class InferenceEngine:
             raise ValueError(
                 f"trace_sample must be >= 1, got {trace_sample}")
         self.trace_sample = int(trace_sample)
+        self.replica_id = replica_id
         self._req_seq = itertools.count()
 
         _LIVE_ENGINES.add(self)
@@ -802,6 +807,8 @@ class InferenceEngine:
             rec = {"type": "trace", "trace_id": r.ctx.trace_id,
                    "kind": "serving_request", "status": status,
                    "latency_ms": round(total_ms, 3)}
+            if self.replica_id is not None:
+                rec["replica_id"] = self.replica_id
             if status == "ok" and self.trace_sample > 1:
                 # this record stands in for trace_sample completed
                 # requests; SLO consumers weight it so sampling cannot

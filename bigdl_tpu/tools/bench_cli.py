@@ -837,6 +837,180 @@ def bench_chaos_device_loss(lose_at: int = 5, rejoin_at: int = 12,
     return out
 
 
+def bench_serve_fleet(replicas: int = 3, clients: int = 6,
+                      requests_per_client: int = 40,
+                      crash: bool = False, deadline_ms: float = 15_000.0,
+                      maintain_every_s: float = 0.005):
+    """Serving-fleet drill: closed-loop clients against a replicated
+    `ServingFleet`; with `crash`, a `serve.replica_crash` fault plan
+    kills one replica mid-traffic and the drill measures the recovery.
+
+    Every client thread issues its requests back-to-back through
+    `fleet.predict` with session affinity, while the main thread ticks
+    `fleet.maintain()` (heartbeats + the chaos site). The crash plan
+    targets replica1 on the second maintenance tick — after traffic is
+    flowing — so the drill exercises the full drain path: in-flight
+    grace, exactly-once re-route of queued work to survivors, and the
+    router's transient re-route of requests the dead engine failed.
+
+    Figures come off the telemetry stream itself (the operator's view):
+    MTTR is the gap between the `worker_lost` event and the first
+    subsequent status-ok `trace` record; degraded throughput compares
+    completed-request rates in equal windows after vs before the loss.
+    When BIGDL_TPU_TELEMETRY names a directory the stream also lands in
+    `serve_fleet_<pid>.jsonl`, which `metrics_cli slo --check --mttr-s N`
+    replays as the CI gate (scripts/run_ci.sh). Prints ONE json line:
+    outcome tallies (every request must resolve — ok, deadline timeout,
+    or ServingReroutedError), reroute count, MTTR, and the
+    degraded-throughput fraction."""
+    import threading
+    from concurrent.futures import TimeoutError as FuturesTimeoutError
+
+    import bigdl_tpu.nn as nn_
+    from bigdl_tpu.dataset.sample import Sample
+    from bigdl_tpu.observability import InMemorySink, Telemetry
+    from bigdl_tpu.resilience import FaultInjector, FaultSpec
+    from bigdl_tpu.serving import (ServingFleet, ServingReroutedError,
+                                   ServingTimeoutError)
+
+    model = (nn_.Sequential().add(nn_.Reshape([784]))
+             .add(nn_.Linear(784, 64)).add(nn_.Tanh())
+             .add(nn_.Linear(64, 10)).add(nn_.LogSoftMax()))
+    model.ensure_params()
+    rs = np.random.RandomState(0)
+    samples = [Sample(rs.rand(28, 28).astype(np.float32))
+               for _ in range(32)]
+
+    sink = InMemorySink()
+    sinks = [sink]
+    tel_dir = os.environ.get("BIGDL_TPU_TELEMETRY")
+    if tel_dir:
+        from bigdl_tpu.observability import JsonlSink
+        os.makedirs(tel_dir, exist_ok=True)
+        sinks.append(JsonlSink(os.path.join(
+            tel_dir, f"serve_fleet_{os.getpid()}.jsonl")))
+    telemetry = Telemetry(*sinks, resources=False)
+
+    fleet = ServingFleet(
+        model, n_replicas=replicas, warmup_sample=samples[0],
+        telemetry=telemetry, drain_grace_s=0.5, lease_s=30.0,
+        engine_kwargs={"max_batch_size": 8, "max_wait_ms": 1.0,
+                       "queue_capacity": 256})
+    counts = {"ok": 0, "timed_out": 0, "rerouted": 0, "other": 0}
+    clock = threading.Lock()
+
+    def worker(k, burst=4):
+        # each client keeps a small submit window in flight (not one
+        # blocking predict at a time) so the fleet carries real queue
+        # depth — the crash then catches queued work, which is exactly
+        # what the drain/re-route machinery exists for
+        futs = []
+
+        def collect():
+            for fut in futs:
+                try:
+                    fut.result(timeout=60.0)
+                    key = "ok"
+                except ServingReroutedError:
+                    key = "rerouted"
+                except FuturesTimeoutError:
+                    key = "timed_out"
+                except ServingTimeoutError:
+                    key = "timed_out"
+                except Exception as e:
+                    key = "other"
+                    print(f"fleet request failed: {e!r}", file=sys.stderr)
+                with clock:
+                    counts[key] += 1
+            futs.clear()
+
+        for i in range(requests_per_client):
+            s = samples[(k * 31 + i) % len(samples)]
+            try:
+                futs.append(fleet.submit(s, deadline_ms=deadline_ms,
+                                         session=f"client{k}"))
+            except Exception as e:
+                print(f"fleet submit failed: {e!r}", file=sys.stderr)
+                with clock:
+                    counts["other"] += 1
+            if len(futs) >= burst:
+                collect()
+        collect()
+
+    total = clients * requests_per_client
+
+    def _mid_traffic(ctx):
+        # fire only while traffic is genuinely mid-flight (25%..75%
+        # resolved): a crash before warm traffic proves nothing, and one
+        # after the last request leaves no post-loss stream to measure
+        # recovery on — the progress gate makes the drill timing-robust
+        if ctx.get("replica") != "replica1":
+            return False
+        with clock:
+            done = sum(counts.values())
+        return total * 0.25 <= done < total * 0.75
+
+    plan = FaultInjector(
+        FaultSpec("serve.replica_crash", at_hit=1, when=_mid_traffic),
+        telemetry=telemetry)
+    threads = [threading.Thread(target=worker, args=(k,))
+               for k in range(clients)]
+    try:
+        cm = plan if crash else contextlib.nullcontext()
+        with cm:
+            for t in threads:
+                t.start()
+            while any(t.is_alive() for t in threads):
+                fleet.maintain()
+                time.sleep(maintain_every_s)
+            for t in threads:
+                t.join()
+            fleet.maintain()
+    finally:
+        fleet.close()
+        telemetry.close()
+
+    stats = fleet.stats()
+    resolved = sum(counts.values())
+    t_lost = next((r["time"] for r in sink.records
+                   if r.get("event") == "worker_lost"), None)
+    ok_times = sorted(r["time"] for r in sink.records
+                      if r.get("type") == "trace"
+                      and r.get("status") == "ok")
+    mttr = None
+    degraded_frac = None
+    if t_lost is not None and ok_times:
+        post = [t for t in ok_times if t > t_lost]
+        mttr = round(post[0] - t_lost, 4) if post else None
+        # equal windows either side of the loss: completed-request rate
+        # after vs before — the operator's "how much service survived"
+        w = min(1.0, t_lost - ok_times[0],
+                (ok_times[-1] - t_lost) if post else 0.0)
+        if w > 0:
+            before = sum(1 for t in ok_times if t_lost - w <= t <= t_lost)
+            after = sum(1 for t in ok_times if t_lost < t <= t_lost + w)
+            if before:
+                degraded_frac = round(after / before, 3)
+    recovered = (resolved == total and counts["other"] == 0
+                 and (not crash or (t_lost is not None
+                                    and mttr is not None)))
+    out = {
+        "metric": "serve_fleet",
+        "replicas": replicas,
+        "clients": clients,
+        "requests": total,
+        "chaos_replica_loss": crash,
+        **counts,
+        "reroutes": stats.get("reroutes_total"),
+        "drains": stats.get("drains_total"),
+        "mttr_s": mttr,
+        "degraded_throughput_frac": degraded_frac,
+        "recovered": recovered,
+    }
+    print(json.dumps(out), flush=True)
+    return out
+
+
 def bench_baseline_configs():
     """One stderr line per remaining BASELINE.md config (the headline
     already covers ResNet-50): LeNet-5, Inception-v1, PTB LSTM, and
@@ -1191,6 +1365,8 @@ def main():
     chaos = False
     chaos_crash_at = 8
     device_loss = False
+    serve_fleet = False
+    replica_loss = False
     it = iter(sys.argv[1:])
     for a in it:
         if a == "--telemetry":
@@ -1225,8 +1401,28 @@ def main():
         elif a == "--device-loss":
             chaos = True  # the flag alone must run the drill, never be
             device_loss = True  # silently swallowed by the headline path
+        elif a == "--serve-fleet":
+            serve_fleet = True
+        elif a == "--replica-loss":
+            chaos = True  # same policy as --device-loss: the flag alone
+            replica_loss = True  # must run the drill
         else:
             argv.append(a)
+    if serve_fleet or replica_loss:
+        # serving-fleet drill: closed-loop clients over N replicas;
+        # with --chaos --replica-loss an injected serve.replica_crash
+        # drains one replica mid-traffic and the drill measures reroute
+        # count, recovery MTTR, and degraded throughput off the
+        # telemetry stream (CI smoke gate: nonzero exit on a failed
+        # recovery; the stream itself gates through metrics_cli slo)
+        logging.getLogger("bigdl_tpu.optim").setLevel(logging.ERROR)
+        logging.getLogger("bigdl_tpu.serving").setLevel(logging.ERROR)
+        logging.getLogger("bigdl_tpu.resilience").setLevel(logging.ERROR)
+        _configure_compile_cache()
+        out = bench_serve_fleet(crash=chaos and replica_loss)
+        if not out.get("recovered"):
+            raise SystemExit(1)
+        return
     if chaos and device_loss:
         # elastic chaos drill: injected device loss -> shrink -> replay
         # -> grow; MTTR + degraded throughput off the telemetry stream

@@ -39,6 +39,16 @@ BIGDL_TPU_TELEMETRY="$chaos_dir" \
 python -m bigdl_tpu.tools.metrics_cli slo --check --mttr-s 60 \
   "$chaos_dir"/chaos_device_loss_*.jsonl
 
+# serving-fleet chaos smoke: injected serve.replica_crash mid-traffic ->
+# drain -> exactly-once re-route to survivors; the drill exits nonzero
+# unless every accepted request resolved and service recovered, and the
+# emitted stream replays through the same SLO gate (serving MTTR =
+# worker_lost -> first completed request)
+BIGDL_TPU_TELEMETRY="$chaos_dir" \
+  python -m bigdl_tpu.tools.bench_cli --serve-fleet --chaos --replica-loss
+python -m bigdl_tpu.tools.metrics_cli slo --check --mttr-s 60 \
+  "$chaos_dir"/serve_fleet_*.jsonl
+
 python -c "
 import jax; jax.config.update('jax_platforms', 'cpu')
 import __graft_entry__ as g
