@@ -62,3 +62,63 @@ class TransformerLM(Module):
             x = blk.apply(params[f"block{i}"], x, ctx)
         logits = x @ params["head"]
         return jax.nn.log_softmax(logits, axis=-1)
+
+    # ------------------------------------------------- incremental decoding
+    # The O(1) autoregressive serving path (serving/generation.py): a
+    # preallocated per-slot KV cache updated in place, so emitting one
+    # token costs one single-position forward instead of a full-sequence
+    # recompute. Portable constant-memory caching per arXiv 2603.09555.
+
+    def init_cache(self, slots: int, max_len: int, dtype=jnp.float32):
+        """Preallocated per-slot KV decode cache: a pytree of 2*n_layer
+        fixed [slots, n_head, max_len, head_dim] buffers. Shapes never
+        change across a serving run — the decode executable compiles
+        exactly once and updates the buffers in place under donation."""
+        if slots < 1:
+            raise ValueError(f"slots must be >= 1, got {slots}")
+        if max_len < 1:
+            raise ValueError(f"max_len must be >= 1, got {max_len}")
+        attn = self.blocks[0].attn
+        shape = (slots, attn.h, max_len, attn.hd)
+        return {"k": [jnp.zeros(shape, dtype) for _ in self.blocks],
+                "v": [jnp.zeros(shape, dtype) for _ in self.blocks]}
+
+    def apply_step(self, params, tokens, cache, positions):
+        """One decode step over ALL cache slots: `tokens` [S] (1-based
+        ids, one per slot), `positions` [S] (each slot's 0-based token
+        position — slots at MIXED ages batch into one fixed-shape step;
+        the causal mask follows each slot's own position). Writes each
+        token's K/V at its position and returns ([S, vocab] next-token
+        log-probs, updated cache)."""
+        x = params["embed"][tokens.astype(jnp.int32) - 1][:, None, :]
+        ks, vs = [], []
+        for i, blk in enumerate(self.blocks):
+            x, k_c, v_c = blk.apply_step(params[f"block{i}"], x,
+                                         cache["k"][i], cache["v"][i],
+                                         positions)
+            ks.append(k_c)
+            vs.append(v_c)
+        logits = x[:, 0] @ params["head"]
+        return jax.nn.log_softmax(logits, axis=-1), {"k": ks, "v": vs}
+
+    def apply_prefill(self, params, tokens, cache, slot_ids, lengths):
+        """Prefill a batch of prompts into cache slots: `tokens` [B, T]
+        right-padded 1-based prompts, `slot_ids` [B] each prompt's cache
+        slot, `lengths` [B] real prompt lengths. One full-sequence causal
+        forward (same math as `apply` in eval mode — right-pad garbage
+        sits at LATER positions, which causal attention never lets a real
+        token see) whose per-layer K/V land in the cache. Returns
+        ([B, vocab] log-probs at each prompt's LAST real token — the
+        first generated token's distribution — and the updated cache)."""
+        from bigdl_tpu.nn.attention import cache_commit
+        x = params["embed"][tokens.astype(jnp.int32) - 1]
+        ks, vs = [], []
+        for i, blk in enumerate(self.blocks):
+            x, k, v = blk.apply_prefill(params[f"block{i}"], x)
+            ks.append(cache_commit(cache["k"][i], k, slot_ids))
+            vs.append(cache_commit(cache["v"][i], v, slot_ids))
+        logits = x @ params["head"]
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        last = jnp.take_along_axis(
+            logp, (lengths.astype(jnp.int32) - 1)[:, None, None], axis=1)
+        return last[:, 0], {"k": ks, "v": vs}

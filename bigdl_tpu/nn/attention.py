@@ -12,6 +12,7 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+from jax import lax
 
 from bigdl_tpu.nn.initialization import Xavier
 from bigdl_tpu.nn.module import ApplyContext, Module
@@ -22,16 +23,47 @@ from bigdl_tpu.ops.attention_kernel import (blockwise_attention,
 
 def rope(x, positions=None, base: float = 10000.0):
     """Rotary position embedding over [B, H, T, D] (D even). Angles are
-    computed in f32; the result keeps x's dtype (bf16 stays bf16)."""
+    computed in f32; the result keeps x's dtype (bf16 stays bf16).
+
+    `positions` may be [T] (shared across the batch; default `arange(T)`)
+    or [B, T] (per-row positions — the decode path, where every cache
+    slot sits at its own token position)."""
     b, h, t, d = x.shape
     if positions is None:
         positions = jnp.arange(t)
+    positions = jnp.asarray(positions)
     inv = base ** (-jnp.arange(0, d, 2, dtype=jnp.float32) / d)  # [D/2]
-    ang = positions.astype(jnp.float32)[:, None] * inv[None, :]  # [T, D/2]
+    ang = positions.astype(jnp.float32)[..., :, None] * inv  # [(B,)T, D/2]
     sin, cos = jnp.sin(ang), jnp.cos(ang)
+    if positions.ndim == 2:  # per-row positions: broadcast over heads
+        sin, cos = sin[:, None], cos[:, None]  # [B, 1, T, D/2]
     x1, x2 = x[..., 0::2], x[..., 1::2]
     out = jnp.stack([x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
     return out.reshape(b, h, t, d).astype(x.dtype)
+
+
+def cache_write(cache, new, positions):
+    """Write `new` [B, H, T, hd] into `cache` [B, H, L, hd] starting at
+    per-row sequence position `positions` [B] — a per-row
+    `lax.dynamic_update_slice`, so under donation the decode step updates
+    its preallocated KV buffers in place (O(1) memory and step cost per
+    token; never a per-token concat/retrace)."""
+    def one(c, n, p):
+        return lax.dynamic_update_slice(c, n, (0, p, 0))
+    return jax.vmap(one)(cache, new, positions)
+
+
+def cache_commit(cache, new, slot_ids):
+    """Commit per-request prefill K/V `new` [B, H, T, hd] into slots of a
+    fleet-wide cache [S, H, L, hd] at sequence position 0. Rows may
+    repeat (bucket padding replicates the last request's row INCLUDING
+    its slot id): the scan writes in request order, so a padded
+    duplicate rewrites identical values and the last write wins."""
+    def body(c, inp):
+        n, s = inp
+        return lax.dynamic_update_slice(c, n[None], (s, 0, 0, 0)), None
+    out, _ = lax.scan(body, cache, (new, slot_ids))
+    return out
 
 
 class ScaledDotProductAttention(Module):
@@ -98,12 +130,14 @@ class MultiHeadAttention(Module):
         b, h, t, hd = x.shape
         return jnp.transpose(x, (0, 2, 1, 3)).reshape(b, t, h * hd)
 
-    def apply(self, params, input, ctx):
-        from bigdl_tpu.utils.table import Table
-        if isinstance(input, (Table, list, tuple)):
-            xq, xkv = list(input)  # Table is 1-based; iterate
-        else:
-            xq = xkv = input
+    def project_qkv(self, params, xq, xkv=None, positions=None):
+        """The q/k/v head of `apply`, factored so the serving prefill and
+        decode paths share it: linear projections + bias + head split +
+        (optional) RoPE at explicit `positions` ([T] shared, [B, T]
+        per-row, or None = `arange`). Returns post-RoPE q, k, v
+        [B, H, T, hd]."""
+        if xkv is None:
+            xkv = xq
         q = xq @ params["wq"]
         k = xkv @ params["wk"]
         v = xkv @ params["wv"]
@@ -111,15 +145,50 @@ class MultiHeadAttention(Module):
             q, k, v = q + params["bq"], k + params["bk"], v + params["bv"]
         q, k, v = self._split(q), self._split(k), self._split(v)
         if self.use_rope:
-            q, k = rope(q), rope(k)
+            q, k = rope(q, positions), rope(k, positions)
+        return q, k, v
+
+    def _attend(self, q, k, v):
         if self.use_flash:
-            o = flash_attention(q, k, v, self.causal)
-        else:
-            o = naive_attention(q, k, v, self.causal)
+            return flash_attention(q, k, v, self.causal)
+        return naive_attention(q, k, v, self.causal)
+
+    def _finish(self, params, o):
         o = self._merge(o) @ params["wo"]
         if self.with_bias:
             o = o + params["bo"]
         return o
+
+    def apply(self, params, input, ctx):
+        from bigdl_tpu.utils.table import Table
+        if isinstance(input, (Table, list, tuple)):
+            xq, xkv = list(input)  # Table is 1-based; iterate
+        else:
+            xq = xkv = input
+        q, k, v = self.project_qkv(params, xq, xkv)
+        return self._finish(params, self._attend(q, k, v))
+
+    def apply_step(self, params, x, k_cache, v_cache, positions):
+        """Position-indexed single-step attention — the O(1)-per-token
+        incremental apply shared by the serving decode loop (and, fed one
+        token at a time, exactly reproducing `apply`; parity-tested at
+        every position in tests/test_generation.py).
+
+        `x` [B, 1, E] holds ONE new token per row; `k_cache`/`v_cache`
+        [B, H, L, hd] are each row's KV history; `positions` [B] is each
+        row's 0-based token position. Writes the new (post-RoPE) K/V at
+        `positions` via `cache_write`, then attends over the causal cache
+        prefix (key position <= row position) — mask-correct for MIXED
+        row ages, so cache slots at different depths batch into one
+        fixed-shape step. Returns (out [B, 1, E], k_cache, v_cache)."""
+        q, k, v = self.project_qkv(params, x, positions=positions[:, None])
+        k_cache = cache_write(k_cache, k, positions)
+        v_cache = cache_write(v_cache, v, positions)
+        length = k_cache.shape[2]
+        mask = (jnp.arange(length)[None, :]
+                <= positions[:, None])[:, None, None, :]
+        o = naive_attention(q, k_cache, v_cache, mask=mask)
+        return self._finish(params, o), k_cache, v_cache
 
 
 class TransformerBlock(Module):
@@ -156,3 +225,30 @@ class TransformerBlock(Module):
             keep = 1.0 - self.dropout
             h = h * jax.random.bernoulli(ctx.make_rng(), keep, h.shape) / keep
         return x + (h @ params["w2"] + params["b2"])
+
+    def _mlp(self, params, x):
+        # inference-form MLP tail (no dropout) shared by the incremental
+        # step and prefill applies; matches `apply`'s eval-mode math
+        h = self.ln2.apply(params["ln2"], x, None)
+        h = jax.nn.gelu(h @ params["w1"] + params["b1"])
+        return h @ params["w2"] + params["b2"]
+
+    def apply_step(self, params, x, k_cache, v_cache, positions):
+        """One-token incremental block apply (inference): x [B, 1, E] at
+        per-row `positions` [B] against this layer's KV cache. Returns
+        (out [B, 1, E], k_cache, v_cache)."""
+        h = self.ln1.apply(params["ln1"], x, None)
+        a, k_cache, v_cache = self.attn.apply_step(
+            params["attn"], h, k_cache, v_cache, positions)
+        x = x + a
+        return x + self._mlp(params, x), k_cache, v_cache
+
+    def apply_prefill(self, params, x):
+        """Full-sequence inference apply that ALSO returns this layer's
+        post-RoPE K/V [B, H, T, hd], so a serving prefill can commit them
+        into a decode cache. Same math as eval-mode `apply`."""
+        h = self.ln1.apply(params["ln1"], x, None)
+        q, k, v = self.attn.project_qkv(params["attn"], h)
+        x = x + self.attn._finish(params["attn"],
+                                  self.attn._attend(q, k, v))
+        return x + self._mlp(params, x), k, v

@@ -84,6 +84,7 @@ class PrometheusTextSink(TelemetrySink):
         self._lock = threading.Lock()
         self._step: Dict = {}
         self._serving: Dict = {}
+        self._generation: Dict = {}  # newest generation record
         self._fleet: Dict = {}  # newest membership/elastic event
         self._serving_fleet: Dict = {}  # newest serving_fleet record
         self._slo: Dict[str, Dict] = {}  # newest slo_status per objective
@@ -100,6 +101,8 @@ class PrometheusTextSink(TelemetrySink):
                 self._step = dict(record)
             elif rtype in ("serving_stats", "serving_summary"):
                 self._serving = dict(record)
+            elif rtype == "generation":
+                self._generation = dict(record)
             elif rtype == "serving_fleet":
                 self._serving_fleet = dict(record)
             elif rtype == "slo_status" and record.get("slo"):
@@ -157,6 +160,7 @@ class PrometheusTextSink(TelemetrySink):
         with self._lock:
             step = dict(self._step)
             serving = dict(self._serving)
+            generation = dict(self._generation)
             serving_fleet = dict(self._serving_fleet)
             fleet = dict(self._fleet)
             slo = {k: dict(v) for k, v in self._slo.items()}
@@ -240,6 +244,34 @@ class PrometheusTextSink(TelemetrySink):
                 if isinstance(count, int):
                     lines.append(
                         f"{self.namespace}_serving_{pre}_count {count}")
+        # --- generation: the newest generation record (continuous-
+        # batching decode loop, serving/generation.py) — token
+        # throughput and decode-slot occupancy are THE capacity signals
+        # for the autoregressive tier
+        for field, name, mtype, help_ in (
+                ("tokens_per_sec", "serving_tokens_per_sec", "gauge",
+                 "Aggregate generated tokens/sec (engine lifetime, idle "
+                 "time included)."),
+                ("decode_occupancy", "serving_decode_occupancy", "gauge",
+                 "Mean active-slot fraction of the continuous-batching "
+                 "decode step."),
+                ("active_slots", "serving_decode_active_slots", "gauge",
+                 "Decode slots currently holding a live stream."),
+                ("slots", "serving_decode_slots", "gauge",
+                 "Decode slots (fixed batch width of the decode "
+                 "executable)."),
+                ("tokens_total", "serving_tokens_total", "counter",
+                 "Tokens generated over the engine lifetime."),
+                ("slot_joins", "serving_slot_joins_total", "counter",
+                 "Requests that joined a decode slot (slot churn, "
+                 "join side)."),
+                ("slot_leaves", "serving_slot_leaves_total", "counter",
+                 "Requests that left a decode slot (slot churn, leave "
+                 "side)."),
+        ):
+            val = generation.get(field)
+            if isinstance(val, (int, float)) and not isinstance(val, bool):
+                self._sample(lines, name, mtype, help_, [(None, val)])
         # --- serving fleet: the newest serving_fleet record
         # (serving/fleet.py emits one per membership change / maintain
         # tick), so a scrape sees replica loss, drains, and re-routes
@@ -264,6 +296,11 @@ class PrometheusTextSink(TelemetrySink):
                  "Autoscale scale-up events."),
                 ("scale_downs_total", "counter",
                  "Autoscale scale-down events."),
+                ("generations_total", "counter",
+                 "Generation streams routed by the fleet."),
+                ("stream_reroutes_total", "counter",
+                 "Generation streams restarted from their prompt on a "
+                 "survivor after replica loss."),
         ):
             val = serving_fleet.get(field)
             if isinstance(val, (int, float)) and not isinstance(val, bool):

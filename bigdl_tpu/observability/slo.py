@@ -277,18 +277,22 @@ class SloEngine(TelemetrySink):
 
     def _ingest_trace(self, record: Dict, t: float):
         status = record.get("status", "ok")
-        if record.get("kind") == "serving_request" \
+        if record.get("kind") in ("serving_request", "generate") \
                 and record.get("replica_id") \
                 and status in ("cancelled", "shed", "timeout"):
             # a FLEET-managed engine's transient-shaped failure: the
             # router may transparently re-route it (drain casualty,
             # open-breaker shed, queue lapse), so the caller-visible
             # outcome of that request is a SEPARATE record — an ok
-            # trace on the survivor, or a `fleet_request` record when
-            # the router surfaced the failure. Counting the replica-
-            # internal record too would burn budget for requests whose
-            # callers saw success (measured live: a drained-and-
-            # re-routed batch double-burned the error budget).
+            # trace on the survivor, or a `fleet_request`/
+            # `fleet_generate` record when the router surfaced the
+            # failure. Counting the replica-internal record too would
+            # burn budget for requests whose callers saw success
+            # (measured live: a drained-and-re-routed batch
+            # double-burned the error budget; a generation stream a
+            # FleetTokenStream restarts from its prompt is the same
+            # shape — its replica emits a cancelled `generate` record
+            # while the caller receives every token).
             # Standalone engines (no replica_id) have no router hiding
             # failures, so their records all still count; permanent
             # engine errors (status="error") always surface unchanged

@@ -221,15 +221,33 @@ RECORD_SCHEMAS: Dict[str, Dict] = {
                      "metrics": dict},
     },
     # one per completed serving request (serving/engine.py): the
-    # critical-path phase breakdown under the request's trace identity
+    # critical-path phase breakdown under the request's trace identity.
+    # kind="generate" requests (serving/generation.py) carry the
+    # prefill/decode split and the emitted token count instead of the
+    # batch-forward phases.
     "trace": {
         "required": {"trace_id": str, "kind": str, "status": str},
         "optional": {"latency_ms": _NUM, "queue_wait_ms": _NUM,
                      "batch_form_ms": _NUM, "dispatch_ms": _NUM,
                      "forward_ms": _NUM, "fetch_ms": _NUM,
+                     "prefill_ms": _NUM, "decode_ms": _NUM, "tokens": int,
                      "batch": int, "bucket": int,
                      "critical_path": list, "error": str,
                      "sample_weight": int, "replica_id": str},
+    },
+    # continuous-batching generation snapshot (serving/generation.py),
+    # one every emit_every decode steps plus a final one at close;
+    # PrometheusTextSink renders the newest as the serving_tokens_per_sec
+    # / serving_decode_occupancy gauge family
+    "generation": {
+        "required": {"slots": int, "active_slots": int,
+                     "tokens_total": int, "decode_steps": int,
+                     "prefill_requests": int, "slot_joins": int,
+                     "slot_leaves": int, "tokens_per_sec": _OPT_NUM,
+                     "decode_occupancy": _OPT_NUM},
+        "optional": {"queue_depth": int, "max_len": int,
+                     "prefill_batches": int, "prefill_s_total": _NUM,
+                     "decode_s_total": _NUM},
     },
     # fleet-level counters/gauges (serving/fleet.py), one per
     # membership change or maintain() tick; PrometheusTextSink renders
@@ -240,6 +258,8 @@ RECORD_SCHEMAS: Dict[str, Dict] = {
         "optional": {"routed_total": int, "affinity_routes_total": int,
                      "reroute_failed_total": int, "drains_total": int,
                      "scale_ups_total": int, "scale_downs_total": int,
+                     "generations_total": int,
+                     "stream_reroutes_total": int,
                      "replica_queue_depth": dict},
     },
     # periodic per-objective evaluation (observability/slo.py)

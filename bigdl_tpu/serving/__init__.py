@@ -12,6 +12,11 @@ in-process, in two tiers:
   them all), a bounded queue with blocking or reject-on-full admission,
   per-request deadlines, error isolation per batch, an optional
   per-bucket circuit breaker, and drain-then-shutdown `close()`.
+- `GenerationEngine` — continuous-batching autoregressive serving for
+  cache-aware models (`models/transformer.py`): prefill shape buckets,
+  a preallocated per-slot KV decode cache updated in place (O(1) step
+  cost per token), ONE fixed-shape decode executable over all slots
+  with join/leave between steps, and streaming `TokenStream` futures.
 - `ServingFleet` — N replicas behind a `Router`: lease/heartbeat
   membership (`resilience.membership.WorkerRegistry`), consistent-hash
   session affinity + power-of-two-choices balancing, drain with bounded
@@ -29,14 +34,21 @@ from bigdl_tpu.serving.engine import (EngineClosedError, InferenceEngine,
                                       ServingTimeoutError,
                                       ServingUnavailableError,
                                       default_buckets)
-from bigdl_tpu.serving.fleet import (AutoscalePolicy, Router,
-                                     ServingFleet, ServingReroutedError,
+from bigdl_tpu.serving.fleet import (AutoscalePolicy, FleetTokenStream,
+                                     Router, ServingFleet,
+                                     ServingReroutedError,
                                      default_router_policy)
+from bigdl_tpu.serving.generation import (GenerationEngine, TokenStream,
+                                          default_seq_buckets,
+                                          greedy_decode_reference)
 from bigdl_tpu.serving.stats import WindowedHistogram
 
 __all__ = [
     "InferenceEngine", "default_buckets", "WindowedHistogram",
-    "ServingFleet", "Router", "AutoscalePolicy", "default_router_policy",
+    "GenerationEngine", "TokenStream", "default_seq_buckets",
+    "greedy_decode_reference",
+    "ServingFleet", "Router", "AutoscalePolicy", "FleetTokenStream",
+    "default_router_policy",
     "ServingError", "QueueFullError", "ServingTimeoutError",
     "ServingUnavailableError", "ServingReroutedError",
     "EngineClosedError",

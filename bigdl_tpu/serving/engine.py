@@ -420,6 +420,16 @@ class InferenceEngine:
             if (self.telemetry is not None or self.tracer is not None) \
             else None
         req = _Request(feats, deadline, ctx=ctx, seq=next(self._req_seq))
+        self._admit(req)
+        return req.future
+
+    def _admit(self, req):
+        """Shared admission: bounded-queue backpressure (block-with-
+        deadline or reject-on-full), closed-engine refusal, and the
+        submitted counter. `req` only needs a `deadline` attribute — the
+        generation subclass admits its own request type through the SAME
+        queue/deadline machinery."""
+        deadline = req.deadline
         with self._lock:
             if self._closing:
                 raise EngineClosedError("engine is closed")
@@ -446,7 +456,6 @@ class InferenceEngine:
             with self._slock:
                 self._n["submitted"] += 1
             self._not_empty.notify()
-        return req.future
 
     def predict(self, sample, timeout: Optional[float] = None,
                 deadline_ms: Optional[float] = None) -> np.ndarray:

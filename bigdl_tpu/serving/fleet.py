@@ -264,6 +264,195 @@ class AutoscalePolicy:
         return 0
 
 
+class FleetTokenStream:
+    """Caller-facing generation stream over the fleet: pulls from a
+    replica-pinned `GenerationEngine` TokenStream, transparently
+    RESTARTING FROM THE PROMPT on a survivor when the pinned replica is
+    lost.
+
+    A decode stream is STATEFUL — its per-slot KV cache lives on one
+    replica — so replica loss cannot transparently migrate it the way a
+    one-shot request re-routes. But greedy decode is deterministic: the
+    restarted stream re-produces the SAME token sequence, and this
+    wrapper's index-based pulls (`get(i)`) consume the dead replica's
+    delivered prefix from its buffer, then read position `i` onward from
+    the survivor's fresh stream — exactly-once token delivery, no gap,
+    no duplicate. Idempotent-only: a non-idempotent stream (or one past
+    `max_reroutes`) fails with `ServingReroutedError` instead, because
+    the dead replica may have produced (and a side effect consumed)
+    tokens the caller never saw.
+    """
+
+    def __init__(self, fleet: "ServingFleet", prompt, session,
+                 idempotent: bool, gen_kwargs: Dict,
+                 deadline_ms: Optional[float] = None):
+        self._fleet = fleet
+        self._prompt = prompt
+        self._session = session
+        self._idempotent = idempotent
+        self._kw = gen_kwargs
+        self._excluded: Set[str] = set()
+        self.reroutes = 0
+        self.replica_id: Optional[str] = None
+        self._stream = None
+        self.t_submit = time.perf_counter()
+        # ONE absolute deadline for the stream's whole fleet life: a
+        # re-route passes the REMAINING budget, never a fresh one
+        self._deadline = self.t_submit + deadline_ms / 1e3 \
+            if deadline_ms is not None else None
+        self._failure_traced = False
+        try:
+            self._attach()
+        except Exception as e:
+            # a synchronous admission failure is caller-visible: the SLO
+            # stream must see it (no engine record is coming — the PR 13
+            # round-4 contract, generation edition)
+            self._trace_failure(_status_of(e), e)
+            raise
+        with fleet._lock:
+            fleet._generations_total += 1
+
+    def _attach(self):
+        """Start (or restart) the stream on a routable replica. Like
+        `Router._route`: a replica whose admission fails shed-shaped
+        (full queue, closing, open breaker) is excluded and the next
+        attempt tries another, up to `route_attempts`."""
+        deadline_ms = None
+        if self._deadline is not None:
+            deadline_ms = (self._deadline - time.perf_counter()) * 1e3
+            if deadline_ms <= 0:
+                raise ServingTimeoutError(
+                    "deadline lapsed before the generation stream "
+                    "reached a replica")
+        tried: Set[str] = set(self._excluded)
+        last_exc: Optional[BaseException] = None
+        for _ in range(self._fleet.router.route_attempts):
+            rep = self._fleet.router._pick(self._session, tried)
+            gen = getattr(rep.engine, "generate", None)
+            if gen is None:
+                raise ServingError(
+                    f"replica {rep.replica_id} does not support "
+                    "generation — build the fleet with an "
+                    "engine_factory returning GenerationEngine replicas")
+            try:
+                self._stream = gen(self._prompt, deadline_ms=deadline_ms,
+                                   **self._kw)
+            except (QueueFullError, EngineClosedError,
+                    ServingUnavailableError) as e:
+                tried.add(rep.replica_id)
+                last_exc = e
+                continue
+            self.replica_id = rep.replica_id
+            return
+        raise last_exc if last_exc is not None else \
+            ServingUnavailableError("no routable replica")
+
+    def _reroute(self, cause: BaseException):
+        if self.replica_id is not None:
+            self._excluded.add(self.replica_id)
+        self.reroutes += 1
+        try:
+            self._attach()
+        except Exception as e:
+            err = ServingReroutedError(
+                "generation stream lost its replica and could not "
+                f"restart on a survivor: {e!r}")
+            err.__cause__ = cause
+            self._trace_failure(_status_of(err), err)
+            raise err from cause
+        with self._fleet._lock:
+            self._fleet._stream_reroutes_total += 1
+        self._fleet._event("stream_rerouted", replica=self.replica_id,
+                           reroutes=self.reroutes)
+
+    def _recoverable(self, exc: BaseException) -> bool:
+        return (self._idempotent
+                and self.reroutes < self._fleet.router.max_reroutes
+                and self._fleet.router.retry_policy.is_transient(exc))
+
+    def _trace_failure(self, status: str, exc: BaseException):
+        """ONE caller-visible `fleet_generate` trace per surfaced
+        failure (repeated get() calls re-raise without re-counting)."""
+        if self._failure_traced:
+            return
+        self._failure_traced = True
+        self._fleet._trace_outcome(self, status, error=repr(exc),
+                                   kind="fleet_generate")
+
+    def get(self, i: int, timeout: Optional[float] = None):
+        """Token `i` (blocking), or None when the stream finished OK
+        with fewer tokens — restarting on a survivor when the pinned
+        replica died before producing it."""
+        while True:
+            try:
+                return self._stream.get(i, timeout)
+            except ServingTimeoutError as e:
+                # a client-side wait timeout (the stream itself is
+                # fine) or a replica queue-deadline lapse: neither is a
+                # replica loss, so neither re-routes; only the
+                # stream-fatal lapse is a caller-visible outcome
+                if self._stream.done:
+                    self._trace_failure("timeout", e)
+                raise
+            except Exception as e:
+                if not self._recoverable(e):
+                    if self._fleet.router.retry_policy.is_transient(e) \
+                            and not isinstance(e, ServingReroutedError):
+                        err = ServingReroutedError(
+                            f"generation stream on replica "
+                            f"{self.replica_id} was lost and was not "
+                            f"re-routed: "
+                            f"{'already re-routed once' if self.reroutes else 'non-idempotent' if not self._idempotent else 'not recoverable'}")
+                        err.__cause__ = e
+                        self._trace_failure(_status_of(err), err)
+                        raise err from e
+                    self._trace_failure(_status_of(e), e)
+                    raise
+                self._reroute(e)
+
+    def cancel(self):
+        """Cancel the CURRENT backing stream (frees its decode slot)."""
+        if self._stream is not None:
+            self._stream.cancel()
+
+    @property
+    def done(self) -> bool:
+        """True once no further tokens will EVER arrive: the backing
+        stream finished OK, or failed UNRECOVERABLY. A backing failure
+        the next `get()` would transparently restart from (replica loss
+        on an idempotent stream with re-route budget) is NOT done."""
+        st = self._stream
+        if st is None or not st.done:
+            return False
+        if st.status == "ok":
+            return True
+        exc = st.error
+        return exc is None or not self._recoverable(exc)
+
+    def __iter__(self):
+        i = 0
+        while True:
+            tok = self.get(i)
+            if tok is None:
+                return
+            yield tok
+            i += 1
+
+    def result(self, timeout: Optional[float] = None) -> List[int]:
+        """Block for completion; returns ALL tokens (re-routes included,
+        exactly once each)."""
+        deadline = time.monotonic() + timeout if timeout is not None \
+            else None
+        out: List[int] = []
+        while True:
+            wait = None if deadline is None \
+                else max(0.0, deadline - time.monotonic())
+            tok = self.get(len(out), wait)
+            if tok is None:
+                return out
+            out.append(tok)
+
+
 class _FleetRequest:
     """One caller-facing request: the router's future is distinct from
     whichever replica engine future currently backs it, so a re-route
@@ -680,6 +869,8 @@ class ServingFleet:
         self._drains_total = 0
         self._scale_ups_total = 0
         self._scale_downs_total = 0
+        self._generations_total = 0
+        self._stream_reroutes_total = 0
         self._last_counts: Dict[str, tuple] = {}  # rid -> (shed, subm)
         self.router = Router(self, retry_policy=retry_policy,
                              max_reroutes=max_reroutes,
@@ -782,6 +973,32 @@ class ServingFleet:
             fut.cancel()  # abandoned: the router/drain won't re-route it
             raise ServingTimeoutError(
                 f"result not ready within {timeout}s") from None
+
+    def generate(self, prompt, session=None,
+                 max_new_tokens: Optional[int] = None,
+                 eos_id: Optional[int] = None,
+                 deadline_ms: Optional[float] = None,
+                 idempotent: bool = True) -> FleetTokenStream:
+        """Route one autoregressive generation stream through the fleet
+        (replicas must be `GenerationEngine`s — pass an
+        `engine_factory`). `session` pins the stream to its replica via
+        the SAME consistent-hash affinity as `submit` — a decode stream
+        is stateful (its KV cache lives on that replica), so affinity is
+        correctness here, not just cache-warmth. On replica loss the
+        stream RESTARTS FROM THE PROMPT on a survivor with
+        already-delivered tokens skipped (greedy decode is
+        deterministic — exactly-once delivery); `idempotent=False`
+        streams fail fast with `ServingReroutedError` instead. See
+        `FleetTokenStream`."""
+        if self._closing:
+            raise EngineClosedError("serving fleet is closed")
+        kw: Dict = {}
+        if max_new_tokens is not None:
+            kw["max_new_tokens"] = max_new_tokens
+        if eos_id is not None:
+            kw["eos_id"] = eos_id
+        return FleetTokenStream(self, prompt, session, idempotent, kw,
+                                deadline_ms=deadline_ms)
 
     # ------------------------------------------------------------ failures
     def fail(self, replica_id: str, reason: str = "observed failure"):
@@ -1202,8 +1419,9 @@ class ServingFleet:
         return True
 
     # ------------------------------------------------------------ telemetry
-    def _trace_outcome(self, req: _FleetRequest, status: str,
-                       error: Optional[str] = None):
+    def _trace_outcome(self, req, status: str,
+                       error: Optional[str] = None,
+                       kind: str = "fleet_request"):
         """One caller-visible `trace` record for an outcome the ROUTER
         decided (a surfaced transient failure, a refused re-route, a
         deadline lapsed mid-re-route): the replica engines recorded such
@@ -1217,7 +1435,7 @@ class ServingFleet:
         from bigdl_tpu.observability.spans import TraceContext
         rec = {"type": "trace",
                "trace_id": TraceContext.new_trace().trace_id,
-               "kind": "fleet_request", "status": status,
+               "kind": kind, "status": status,
                "latency_ms": round(
                    (time.perf_counter() - req.t_submit) * 1e3, 3)}
         if req.replica_id is not None:
@@ -1273,6 +1491,8 @@ class ServingFleet:
                 "drains_total": self._drains_total,
                 "scale_ups_total": self._scale_ups_total,
                 "scale_downs_total": self._scale_downs_total,
+                "generations_total": self._generations_total,
+                "stream_reroutes_total": self._stream_reroutes_total,
                 "replica_queue_depth": depths,
             }
 

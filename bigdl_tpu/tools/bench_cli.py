@@ -628,6 +628,154 @@ def bench_serving_ab(clients: int = 8, segments: int = 20,
     return out
 
 
+def bench_generation_ab(clients: int = 8, segments: int = 4,
+                        streams_per_client: int = 2,
+                        max_new_tokens: int = 24, slots: int = None,
+                        n_prompts: int = 16):
+    """Generation A/B: closed-loop concurrent clients, one-request-at-a-
+    time FULL-RECOMPUTE greedy decode (the O(L^2) serial path: every
+    emitted token pays a whole padded-sequence forward, and concurrent
+    callers serialize through one device) vs the continuous-batching
+    `GenerationEngine` (prefill buckets + the O(1) per-slot KV decode
+    cache + ONE fixed-shape decode step over all slots).
+
+    Both modes run the SAME model and params. Serial uses one fixed
+    [1, max_len] jitted full apply (one compile — the honest baseline);
+    the engine is warmed. Measurement is the alternated pair-ratio
+    estimator from docs/PERF.md (strictly alternated serial/engine
+    segments, per-pair aggregate tokens/sec ratios, median) so container
+    machine-speed drift cancels inside each pair.
+
+    Before measuring, the drill verifies the PARITY contract: every
+    prompt's continuous-batched token sequence must equal its serial
+    full-recompute sequence exactly (`parity` in the output; the CLI
+    exits nonzero on a break — the CI generation smoke leans on this).
+    When BIGDL_TPU_TELEMETRY names a directory, the engine's stream
+    (generation snapshots + kind=generate trace records) lands in
+    `generate_<pid>.jsonl` for the `metrics_cli slo --check` gate.
+    Prints ONE json line."""
+    import threading
+
+    import jax
+    import jax.numpy as jnp
+
+    from bigdl_tpu.models.transformer import TransformerLM
+    from bigdl_tpu.observability import InMemorySink, Telemetry
+    from bigdl_tpu.serving import (GenerationEngine,
+                                   greedy_decode_reference)
+
+    vocab, max_len = 256, 64
+    model = TransformerLM(vocab, embed_dim=64, n_layer=2, n_head=4,
+                          use_flash=False, max_len=max_len)
+    model.ensure_params(jax.random.PRNGKey(0))
+    params = model.ensure_params()
+    rs = np.random.RandomState(0)
+    prompts = [rs.randint(1, vocab + 1,
+                          size=rs.randint(4, 17)).astype(np.int32)
+               for _ in range(n_prompts)]
+    slots = slots or max(8, clients)
+
+    sinks = [InMemorySink()]
+    tel_dir = os.environ.get("BIGDL_TPU_TELEMETRY")
+    if tel_dir:
+        from bigdl_tpu.observability import JsonlSink
+        os.makedirs(tel_dir, exist_ok=True)
+        sinks.append(JsonlSink(os.path.join(
+            tel_dir, f"generate_{os.getpid()}.jsonl")))
+    telemetry = Telemetry(*sinks, resources=False)
+
+    engine = GenerationEngine(model, slots=slots, max_len=max_len,
+                              max_new_tokens=max_new_tokens,
+                              telemetry=telemetry)
+    engine.warmup()
+    # serial baseline: ONE fixed-shape compile shared by every request
+    fwd = jax.jit(lambda p, t: model.apply(p, t, None))
+    serial_lock = threading.Lock()
+
+    def serial_one(prompt):
+        # one-request-at-a-time: the pre-engine story — requests
+        # serialize through the single device
+        with serial_lock:
+            return greedy_decode_reference(model, params, prompt,
+                                           max_new_tokens,
+                                           pad_to=max_len, fwd=fwd)
+
+    def engine_one(prompt):
+        return engine.generate(prompt).result(timeout=120.0)
+
+    try:
+        serial_one(prompts[0])  # compile the serial path
+        # parity gate: continuous-batched greedy decode must reproduce
+        # the serial sequences token-for-token, under real concurrency
+        refs = [serial_one(p) for p in prompts]
+        outs = [None] * len(prompts)
+
+        def check(i):
+            outs[i] = engine_one(prompts[i])
+
+        threads = [threading.Thread(target=check, args=(i,))
+                   for i in range(len(prompts))]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        parity = outs == refs
+
+        def run_mode(fn):
+            barrier = threading.Barrier(clients + 1)
+            counts = [0] * clients
+
+            def worker(k):
+                barrier.wait()
+                for i in range(streams_per_client):
+                    counts[k] += len(
+                        fn(prompts[(k * 7 + i) % len(prompts)]))
+
+            threads = [threading.Thread(target=worker, args=(k,))
+                       for k in range(clients)]
+            for t in threads:
+                t.start()
+            barrier.wait()
+            t0 = time.perf_counter()
+            for t in threads:
+                t.join()
+            return sum(counts) / (time.perf_counter() - t0)
+
+        run_mode(serial_one)  # throwaway pair: scheduler warmup
+        run_mode(engine_one)
+        serial_rates, pair_ratios = [], []
+        for _ in range(segments):
+            s_tps = run_mode(serial_one)
+            e_tps = run_mode(engine_one)
+            serial_rates.append(s_tps)
+            pair_ratios.append(e_tps / s_tps)
+        gen_stats = engine.generation_stats()
+        compiles = engine.compile_count()
+    finally:
+        engine.close()
+        telemetry.close()
+
+    serial = float(np.median(serial_rates))
+    speedup = float(np.median(pair_ratios))
+    out = {
+        "metric": "generation_ab",
+        "clients": clients,
+        "slots": slots,
+        "max_new_tokens": max_new_tokens,
+        "max_len": max_len,
+        "serial_tokens_per_sec": round(serial, 1),
+        # derived from the drift-robust pair-ratio median, same policy
+        # as the serving/input-pipeline A/Bs
+        "engine_tokens_per_sec": round(serial * speedup, 1),
+        "speedup": round(speedup, 3),
+        "parity": parity,
+        "decode_occupancy": gen_stats.get("decode_occupancy"),
+        "compile_count": compiles,
+    }
+    print(json.dumps(out), flush=True)
+    return out
+
+
 def bench_chaos(crash_at: int = 8, iters: int = 16, ckpt_every: int = 4,
                 batch_size: int = 64, n_samples: int = 1024,
                 keep_last_n: int = 3):
@@ -1367,6 +1515,8 @@ def main():
     device_loss = False
     serve_fleet = False
     replica_loss = False
+    generate = False
+    generate_clients = 8
     it = iter(sys.argv[1:])
     for a in it:
         if a == "--telemetry":
@@ -1403,11 +1553,31 @@ def main():
             device_loss = True  # silently swallowed by the headline path
         elif a == "--serve-fleet":
             serve_fleet = True
+        elif a == "--generate":
+            generate = True
+        elif a.startswith("--generate-clients="):
+            generate = True
+            generate_clients = int(a.split("=", 1)[1])
+        elif a == "--generate-clients":
+            generate = True
+            generate_clients = int(next(it, "8"))
         elif a == "--replica-loss":
             chaos = True  # same policy as --device-loss: the flag alone
             replica_loss = True  # must run the drill
         else:
             argv.append(a)
+    if generate:
+        # generation A/B: serial full-recompute greedy decode vs the
+        # continuous-batching engine, WITH the token-parity gate (exits
+        # nonzero on a parity break — the CI generation smoke); one json
+        # line on stdout, see docs/PERF.md "Generation"
+        logging.getLogger("bigdl_tpu.optim").setLevel(logging.ERROR)
+        logging.getLogger("bigdl_tpu.serving").setLevel(logging.ERROR)
+        _configure_compile_cache()
+        out = bench_generation_ab(clients=generate_clients)
+        if not out.get("parity"):
+            raise SystemExit(1)
+        return
     if serve_fleet or replica_loss:
         # serving-fleet drill: closed-loop clients over N replicas;
         # with --chaos --replica-loss an injected serve.replica_crash

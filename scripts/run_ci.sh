@@ -49,6 +49,16 @@ BIGDL_TPU_TELEMETRY="$chaos_dir" \
 python -m bigdl_tpu.tools.metrics_cli slo --check --mttr-s 60 \
   "$chaos_dir"/serve_fleet_*.jsonl
 
+# generation smoke: continuous-batching greedy decode must reproduce the
+# serial full-recompute reference token-for-token (bench_cli exits
+# nonzero on a parity break), and the generation trace stream (one
+# kind=generate record per request) must hold its latency/error
+# objectives through the same SLO gate as the other smokes
+BIGDL_TPU_TELEMETRY="$chaos_dir" \
+  python -m bigdl_tpu.tools.bench_cli --generate --generate-clients=4
+python -m bigdl_tpu.tools.metrics_cli slo --check --latency-p99-ms 60000 \
+  "$chaos_dir"/generate_*.jsonl
+
 python -c "
 import jax; jax.config.update('jax_platforms', 'cpu')
 import __graft_entry__ as g
