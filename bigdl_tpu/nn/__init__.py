@@ -36,6 +36,8 @@ from bigdl_tpu.nn.pooling import (Pooler, ResizeBilinear, SpatialAveragePooling,
                                   TemporalMaxPooling, UpSampling1D, UpSampling2D,
                                   UpSampling3D, VolumetricAveragePooling,
                                   VolumetricMaxPooling)
+from bigdl_tpu.nn.fusion import (fusible_activation, fusible_bn,
+                                 fusion_enabled, fusion_scope, set_fusion)
 from bigdl_tpu.nn.normalization import (BatchNormalization, LayerNormalization,
                                         Normalize, NormalizeScale,
                                         SpatialBatchNormalization,

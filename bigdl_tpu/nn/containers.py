@@ -86,9 +86,29 @@ class Sequential(Container):
     """
 
     def apply(self, params, input, ctx):
+        from bigdl_tpu.nn.fusion import (fusible_activation, fusible_bn,
+                                         fusion_enabled)
         x = input
-        for i in range(len(self.children)):
+        fuse = fusion_enabled()
+        i, n = 0, len(self.children)
+        while i < n:
+            child = self.children[i]
+            if fuse and i + 1 < n and fusible_bn(child) \
+                    and fusible_activation(self.children[i + 1]):
+                # BN+ReLU adjacency: one fused elementwise tail
+                # (ops/bn_relu_kernel.py) under the BN child's state path;
+                # the ReLU child is parameter- and state-less, so skipping
+                # its dispatch changes nothing but the op count
+                key = self._child_keys[i]
+                ctx.push(key)
+                try:
+                    x = child.apply_with_activation(params[key], x, ctx)
+                finally:
+                    ctx.pop()
+                i += 2
+                continue
             x = self._apply_child(i, params, x, ctx)
+            i += 1
         return x
 
 
@@ -395,7 +415,29 @@ class Graph(Container):
             self.children.append(n.module)
             self._child_keys.append(n.key)
 
+    def _fusion_plan(self):
+        """BN->ReLU adjacency over the DAG: a ReLU node whose sole input
+        is a single-consumer BN node (and the BN is not itself a graph
+        output) fuses. Returns (fused_bn_ids, skip: relu_id -> bn_id).
+        Re-computed per apply — trace-time cost only."""
+        from bigdl_tpu.nn.fusion import fusible_activation, fusible_bn
+        consumers: Dict[int, int] = {}
+        for node in self.exec_order:
+            for p in node.prev:
+                consumers[p.id] = consumers.get(p.id, 0) + 1
+        out_ids = {n.id for n in self.output_nodes}
+        fused, skip = set(), {}
+        for node in self.exec_order:
+            if fusible_activation(node.module) and len(node.prev) == 1:
+                p = node.prev[0]
+                if (fusible_bn(p.module) and consumers.get(p.id) == 1
+                        and p.id not in out_ids):
+                    fused.add(p.id)
+                    skip[node.id] = p.id
+        return fused, skip
+
     def apply(self, params, input, ctx):
+        from bigdl_tpu.nn.fusion import fusion_enabled
         if isinstance(input, Table):
             inputs = list(input)
         elif isinstance(input, (list, tuple)):
@@ -405,10 +447,15 @@ class Graph(Container):
         if len(inputs) != len(self.input_nodes):
             raise ValueError(
                 f"graph expects {len(self.input_nodes)} inputs, got {len(inputs)}")
+        fused, skip = self._fusion_plan() if fusion_enabled() else (set(), {})
         values: Dict[int, any] = {}
         for node, x in zip(self.input_nodes, inputs):
             values[node.id] = x
         for i, node in enumerate(self.exec_order):
+            if node.id in skip:
+                # the ReLU already ran inside its BN's fused tail
+                values[node.id] = values[skip[node.id]]
+                continue
             if not node.prev:
                 x = values.get(node.id)
             elif len(node.prev) == 1:
@@ -417,7 +464,12 @@ class Graph(Container):
                 x = T(*[values[p.id] for p in node.prev])
             ctx.push(node.key)
             try:
-                values[node.id] = node.module.apply(params[node.key], x, ctx)
+                if node.id in fused:
+                    values[node.id] = node.module.apply_with_activation(
+                        params[node.key], x, ctx)
+                else:
+                    values[node.id] = node.module.apply(params[node.key], x,
+                                                        ctx)
             finally:
                 ctx.pop()
         outs = [values[n.id] for n in self.output_nodes]

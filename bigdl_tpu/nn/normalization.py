@@ -53,7 +53,11 @@ class BatchNormalization(Module):
         return {"mean": jnp.zeros((self.n_output,), self.dtype),
                 "var": jnp.ones((self.n_output,), self.dtype)}
 
-    def apply(self, params, input, ctx: ApplyContext):
+    def _stats_scale_shift(self, params, input, ctx: ApplyContext):
+        """Statistics + folded affine coefficients, shared by the plain
+        and the fused (BN+ReLU) tails: returns (x_f32, scale, shift,
+        out_dtype). State updates happen here, so both tails keep the
+        running-stat semantics identical."""
         x = input
         # mixed-precision guard: statistics always accumulate in f32 —
         # a bf16 mean over batch*H*W elements loses ~3 decimal digits and
@@ -86,7 +90,31 @@ class BatchNormalization(Module):
             shift = params["bias"].astype(x.dtype) - mean * scale
         else:
             scale, shift = inv, -mean * inv
+        return x, scale, shift, out_dtype
+
+    def apply(self, params, input, ctx: ApplyContext):
+        x, scale, shift, out_dtype = self._stats_scale_shift(params, input,
+                                                             ctx)
         return (x * scale + shift).astype(out_dtype)
+
+    def apply_with_activation(self, params, input, ctx: ApplyContext,
+                              relu: bool = True):
+        """BN + activation as ONE fused elementwise tail
+        (ops/bn_relu_kernel.py): a single VMEM-resident read-modify-write
+        on TPU instead of separate normalize and ReLU HBM passes;
+        off-TPU it lowers to the exact unfused expressions (bit-identical
+        — the containers' pattern matcher relies on this). Statistics,
+        state updates, and the folded coefficients are shared with the
+        plain `apply`."""
+        if getattr(self, "data_format", "NHWC") != "NHWC":
+            # NCHW transposes around the tail; keep a correct fallback
+            # (the pattern matcher never fuses NCHW — belt and braces)
+            y = self.apply(params, input, ctx)
+            return jax.nn.relu(y) if relu else y
+        from bigdl_tpu.ops.bn_relu_kernel import bn_relu
+        x, scale, shift, out_dtype = self._stats_scale_shift(params, input,
+                                                             ctx)
+        return bn_relu(x, scale, shift, relu, out_dtype)
 
 
 class SpatialBatchNormalization(BatchNormalization):

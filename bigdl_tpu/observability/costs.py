@@ -129,12 +129,36 @@ def _conv_flops(eqn) -> float:
     return 2.0 * _prod(out) * k_spatial * in_ch / groups
 
 
+#: Memory-movement primitives counted as zero FLOPs in the fallback walk:
+#: `get`/`swap` are Pallas/state ref loads/stores (they dominate a kernel
+#: body's eqn list but do no arithmetic), `copy` is a device copy.
+_MEMORY_PRIMITIVES = frozenset({"get", "swap", "copy"})
+
+
+def _pallas_grid_size(eqn) -> float:
+    """Number of grid cells a pallas_call's kernel body runs for (1 for
+    a gridless call)."""
+    gm = eqn.params.get("grid_mapping")
+    grid = tuple(getattr(gm, "grid", ()) or ())
+    # symbolic/dynamic grid axes fall back to 1 — a floor, never a crash
+    return _prod(d for d in grid if isinstance(d, int)) or 1.0
+
+
 def jaxpr_flops(jaxpr) -> float:
     """Estimated FLOPs of a (closed) jaxpr: exact matmul/conv counts plus
     one FLOP per output element for everything else, recursing through
     call/pjit/custom-derivative sub-jaxprs and scaling scan bodies by
     their trip count. A floor estimate — used only when the backend's
-    own cost model reports nothing."""
+    own cost model reports nothing.
+
+    Fused-kernel attribution: a `pallas_call` body counts once per GRID
+    CELL (the body jaxpr sees one block; the walk used to count it once,
+    under-reporting fused steps by the grid factor), with ref
+    loads/stores (`get`/`swap`) excluded as memory movement — so a fused
+    BN+ReLU / stem / flash step attributes ~the unfused equivalent's
+    count (regression-pinned in tests/test_attribution.py).
+    `custom_vjp_call*` descends through `fun_jaxpr`/`call_jaxpr` like the
+    other call primitives."""
     inner = getattr(jaxpr, "jaxpr", jaxpr)
     total = 0.0
     for eqn in inner.eqns:
@@ -148,6 +172,15 @@ def jaxpr_flops(jaxpr) -> float:
                 continue
         except Exception:
             pass  # malformed params: fall through to the generic count
+        if name == "pallas_call":
+            try:
+                total += jaxpr_flops(eqn.params["jaxpr"]) \
+                    * _pallas_grid_size(eqn)
+                continue
+            except Exception:
+                pass  # unexpected params shape: generic count below
+        if name in _MEMORY_PRIMITIVES:
+            continue
         sub = None
         for key in ("jaxpr", "call_jaxpr", "fun_jaxpr"):
             if key in eqn.params:

@@ -7,6 +7,8 @@ from bigdl_tpu.ops.attention_kernel import (attention_state_finish,
                                             flash_attention,
                                             flash_attention_forward,
                                             naive_attention)
+from bigdl_tpu.ops.bn_relu_kernel import (bn_relu, bn_relu_backward,
+                                          bn_relu_forward, bn_relu_pallas)
 from bigdl_tpu.ops import operation
 from bigdl_tpu.ops import feature_col
 from bigdl_tpu.ops.operation import (Abs, Add, All, Any, ApproximateEqual,

@@ -1,0 +1,279 @@
+"""Pallas kernel for the fused BatchNorm/bias + activation tail.
+
+The round-5 perf record (docs/PERF.md) puts the residual gap to peak in
+ResNet-50's memory-bound stages: after every conv, the BatchNorm
+normalize-affine and the ReLU each cost a full HBM read-modify-write of
+the [B, H, W, C] activation. XLA fuses SOME of these into the adjacent
+conv, but the BN tail's scale/shift (computed from batch statistics) plus
+the separate ReLU module boundary leave up to three elementwise HBM
+round trips per block on the profile. This kernel collapses the tail to
+ONE VMEM-resident pass:
+
+    y = max(x * scale + shift, 0)        (relu=True)
+    y =     x * scale + shift            (relu=False — bias+identity tails)
+
+with `scale`/`shift` the per-channel folded BN coefficients the module
+already computes (nn/normalization.py folds weight/rsqrt(var) into one
+multiply-add). The backward fuses the same way (`custom_vjp`): one kernel
+produces dx and per-tile partial reductions for dscale/dshift, so training
+never materializes the mask or the pre-activation in HBM.
+
+Routing follows the stem-kernel convention (ops/stem_kernel.py): on TPU
+`bn_relu` dispatches the Pallas custom_vjp pair (`bn_relu_pallas`);
+off-TPU it INLINES the exact unfused op sequence with no
+custom-derivative boundary, so the CPU fused graph is structurally the
+unfused graph minus the module dispatch — autodiff and trajectories stay
+bit-identical (the CI parity gate pins this; a custom_vjp boundary on
+CPU measurably perturbs XLA's fusion/FMA grouping at the ~1e-7 level).
+The raw kernels remain reachable in interpreter mode for parity tests
+(`bn_relu_forward` / `bn_relu_backward`, the `_pick_tile_n` boundary
+suite), and `FORCE_PALLAS=True` routes the public op through the
+interpreter-mode custom_vjp off-TPU for end-to-end kernel drills —
+forward bit-identical, backward within 1e-6 of the unfused autodiff
+(the tiled partial reductions regroup sums).
+
+No reference counterpart: the reference's CPU BN calls MKL's fused
+batchnorm primitive; this exists because on TPU the fusion has to be
+expressed, not linked.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+# test hook, same convention as ops/attention_kernel.py: run the Pallas
+# kernels in interpreter mode (CPU) when True
+INTERPRET = False
+
+# test/drill hook: route the public `bn_relu` through the Pallas kernels
+# even off-TPU (interpreter mode) — the end-to-end kernel path on CPU
+FORCE_PALLAS = False
+
+#: VMEM budget the row-tile picker sizes against: ~6 live f32 copies of a
+#: [tile_n, C] block (x, the product, the cast, g/dx on the backward).
+_VMEM_BUDGET_BYTES = 8 * 2 ** 20
+
+
+def _pick_tile_n(n: int, c: int, tile_n: Optional[int] = None) -> int:
+    """Largest row tile that (a) divides n, (b) is a multiple of 8 (the
+    f32 sublane quantum — same Mosaic rule as stem `_pick_tile_w`), and
+    (c) keeps ~6 live f32 copies of the [tile, c] block under the VMEM
+    budget. Falls back to the full n when no candidate exists (tiny or
+    odd row counts: interpret mode and Mosaic both accept a full-array
+    block)."""
+    if tile_n is None:
+        tile_n = max(8, _VMEM_BUDGET_BYTES // (6 * 4 * max(c, 1)))
+    cands = [d for d in range(min(tile_n, n), 0, -1)
+             if n % d == 0 and d % 8 == 0]
+    return cands[0] if cands else n
+
+
+def _fwd_kernel(x_ref, s_ref, b_ref, o_ref, *, relu: bool):
+    """One program = one row tile: fused normalize-affine (+ ReLU).
+
+    The multiply-add runs in f32 registers; the cast to the output dtype
+    happens BEFORE the max, mirroring the unfused graph's op order
+    (BN casts to out_dtype, then the ReLU module runs) so the fused
+    forward is bit-identical to the unfused one."""
+    v = x_ref[...] * s_ref[...] + b_ref[...]
+    v = v.astype(o_ref.dtype)
+    o_ref[...] = jnp.maximum(v, 0) if relu else v
+
+
+def bn_relu_forward(x2, scale, shift, relu: bool = True,
+                    out_dtype=None, tile_n: Optional[int] = None,
+                    interpret: Optional[bool] = None):
+    """Pallas forward for the fused tail over a [N, C] view.
+
+    x2: [N, C] f32 activations (the module flattens leading axes)
+    scale/shift: [C] folded BN coefficients (f32)
+    out_dtype: output dtype (the module's activation dtype, e.g. bf16)
+    """
+    from jax.experimental import pallas as pl
+
+    if interpret is None:
+        interpret = INTERPRET
+    n, c = x2.shape
+    out_dtype = out_dtype or x2.dtype
+    tn = _pick_tile_n(n, c, tile_n)
+    kernel = functools.partial(_fwd_kernel, relu=relu)
+    return pl.pallas_call(
+        kernel,
+        grid=(n // tn,),
+        in_specs=[
+            pl.BlockSpec((tn, c), lambda i: (i, 0)),
+            pl.BlockSpec((c,), lambda i: (0,)),
+            pl.BlockSpec((c,), lambda i: (0,)),
+        ],
+        out_specs=pl.BlockSpec((tn, c), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((n, c), out_dtype),
+        interpret=interpret,
+    )(x2, scale, shift)
+
+
+def _bwd_kernel(x_ref, s_ref, b_ref, g_ref, dx_ref, ds_ref, db_ref, *,
+                relu: bool):
+    """One program = one row tile of the fused backward: recompute the
+    pre-activation in VMEM (nothing was saved to HBM), apply the ReLU
+    mask to the cotangent, and emit dx plus this tile's PARTIAL
+    dscale/dshift row sums (the caller reduces over tiles)."""
+    x = x_ref[...]
+    s = s_ref[...]
+    g = g_ref[...]
+    if relu:
+        pre = (x * s + b_ref[...]).astype(g.dtype)
+        g = jnp.where(pre > 0, g, 0)
+    g32 = g.astype(jnp.float32)
+    dx_ref[...] = g32 * s
+    ds_ref[...] = jnp.sum(g32 * x, axis=0, keepdims=True)
+    db_ref[...] = jnp.sum(g32, axis=0, keepdims=True)
+
+
+def bn_relu_backward(x2, scale, shift, g2, relu: bool = True,
+                     tile_n: Optional[int] = None,
+                     interpret: Optional[bool] = None
+                     ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Pallas backward for the fused tail: (dx [N,C], dscale [C],
+    dshift [C]) from the cotangent g2 [N, C] (activation dtype)."""
+    from jax.experimental import pallas as pl
+
+    if interpret is None:
+        interpret = INTERPRET
+    n, c = x2.shape
+    tn = _pick_tile_n(n, c, tile_n)
+    n_tiles = n // tn
+    kernel = functools.partial(_bwd_kernel, relu=relu)
+    dx, ds_part, db_part = pl.pallas_call(
+        kernel,
+        grid=(n_tiles,),
+        in_specs=[
+            pl.BlockSpec((tn, c), lambda i: (i, 0)),
+            pl.BlockSpec((c,), lambda i: (0,)),
+            pl.BlockSpec((c,), lambda i: (0,)),
+            pl.BlockSpec((tn, c), lambda i: (i, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((tn, c), lambda i: (i, 0)),
+            pl.BlockSpec((1, c), lambda i: (i, 0)),
+            pl.BlockSpec((1, c), lambda i: (i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((n, c), jnp.float32),
+            jax.ShapeDtypeStruct((n_tiles, c), jnp.float32),
+            jax.ShapeDtypeStruct((n_tiles, c), jnp.float32),
+        ],
+        interpret=interpret,
+    )(x2, scale, shift, g2)
+    return dx, jnp.sum(ds_part, axis=0), jnp.sum(db_part, axis=0)
+
+
+# ---------------------------------------------------------------------- #
+# reference (unfused-equivalent) expressions — the off-TPU lowering
+# ---------------------------------------------------------------------- #
+
+def _reference_forward(x, scale, shift, relu: bool, out_dtype):
+    """EXACTLY the unfused graph's op sequence (normalization.py tail,
+    then jax.nn.relu = maximum(·, 0)): multiply-add in x's dtype, cast,
+    max. Elementwise, so XLA fuses it — and the CPU CI fused-vs-unfused
+    trajectory parity gate is bit-exact."""
+    y = (x * scale + shift).astype(out_dtype)
+    return jnp.maximum(y, 0) if relu else y
+
+
+def _reference_backward(x, scale, shift, g, relu: bool, out_dtype):
+    """The unfused graph's autodiff, written out: relu's custom_jvp mask
+    on the cast pre-activation, convert adjoint back to f32, then the
+    broadcast-multiply adjoints."""
+    if relu:
+        pre = (x * scale + shift).astype(out_dtype)
+        g = jnp.where(pre > 0, g, 0)
+    g32 = g.astype(x.dtype)
+    axes = tuple(range(x.ndim - 1))
+    return g32 * scale, jnp.sum(g32 * x, axis=axes), jnp.sum(g32, axis=axes)
+
+
+# ---------------------------------------------------------------------- #
+# public op: backend-routed dispatcher over the custom_vjp kernel pair
+# ---------------------------------------------------------------------- #
+
+def _flat(x):
+    return x.reshape(-1, x.shape[-1])
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def bn_relu_pallas(x, scale, shift, relu: bool = True, out_dtype=None):
+    """The fused tail as a custom_vjp over the Pallas kernels (forward
+    AND backward fuse; interpreter mode off-TPU). `relu`/`out_dtype` are
+    static. Use `bn_relu` for backend-routed production dispatch."""
+    out_dtype = jnp.dtype(out_dtype or x.dtype)
+    y2 = bn_relu_forward(_flat(x), scale, shift, relu=relu,
+                         out_dtype=out_dtype,
+                         interpret=jax.default_backend() != "tpu")
+    return y2.reshape(x.shape)
+
+
+def _bn_relu_fwd_rule(x, scale, shift, relu, out_dtype):
+    return bn_relu_pallas(x, scale, shift, relu, out_dtype), (x, scale,
+                                                              shift)
+
+
+def _bn_relu_bwd_rule(relu, out_dtype, res, g):
+    x, scale, shift = res
+    dx2, ds, db = bn_relu_backward(
+        _flat(x), scale, shift, _flat(g), relu=relu,
+        interpret=jax.default_backend() != "tpu")
+    return dx2.reshape(x.shape), ds, db
+
+
+bn_relu_pallas.defvjp(_bn_relu_fwd_rule, _bn_relu_bwd_rule)
+
+
+def bn_relu(x, scale, shift, relu: bool = True, out_dtype=None):
+    """Fused `activation(x * scale + shift)` over the trailing channel
+    axis of x (any leading rank).
+
+    On TPU (or under `FORCE_PALLAS`) this is the Pallas custom_vjp pair —
+    one VMEM-resident pass each direction. Off-TPU it inlines the EXACT
+    unfused op sequence (multiply-add, cast, `jax.nn.relu`) with no
+    custom-derivative boundary, so the CPU fused graph autodiffs
+    bit-identically to the unfused one — XLA fuses the chain itself and
+    the CI trajectory parity gate stays exact. With scale=1 this is the
+    bias+activation tail; nn/normalization.py feeds it the folded BN
+    coefficients."""
+    out_dtype = jnp.dtype(out_dtype or x.dtype)
+    if FORCE_PALLAS or jax.default_backend() == "tpu":
+        return bn_relu_pallas(x, scale, shift, relu, out_dtype)
+    y = (x * scale + shift).astype(out_dtype)
+    # jax.nn.relu, not jnp.maximum: its custom_jvp zeroes the gradient at
+    # 0 exactly like the standalone ReLU module the pattern replaced
+    return jax.nn.relu(y) if relu else y
+
+
+def count_fused_calls(jaxpr) -> int:
+    """Number of `bn_relu` custom_vjp call sites in a (closed) jaxpr,
+    recursing through sub-jaxprs — the jaxpr-level fusion assertion the
+    suite pins (a fused graph must carry one per matched BN+ReLU pair
+    and NO standalone relu custom_jvp eqns)."""
+    inner = getattr(jaxpr, "jaxpr", jaxpr)
+    total = 0
+    for eqn in inner.eqns:
+        if eqn.primitive.name.startswith("custom_vjp_call"):
+            sub = eqn.params.get("fun_jaxpr") or eqn.params.get("call_jaxpr")
+            names = {e.primitive.name
+                     for e in getattr(sub, "jaxpr", sub).eqns} if sub else set()
+            # the bn_relu forward body: a mul+add+(max) chain or a single
+            # pallas_call — either way it touches no other custom calls
+            if names and names <= {"mul", "add", "max",
+                                   "convert_element_type", "broadcast_in_dim",
+                                   "pallas_call", "reshape"}:
+                total += 1
+                continue
+        for key in ("jaxpr", "call_jaxpr", "fun_jaxpr", "body_jaxpr"):
+            if key in eqn.params:
+                total += count_fused_calls(eqn.params[key])
+                break
+    return total

@@ -24,6 +24,7 @@ from bigdl_tpu.optim.validation import (AccuracyResult, ContiguousResult,
                                         Top1Accuracy, Top5Accuracy,
                                         TreeNNAccuracy, ValidationMethod,
                                         ValidationResult)
+from bigdl_tpu.optim.bucketing import GradientBucketPlan
 from bigdl_tpu.optim.metrics import Metrics, Timer
 from bigdl_tpu.optim.local_optimizer import LocalOptimizer
 from bigdl_tpu.optim.distri_optimizer import (DistriOptimizer,

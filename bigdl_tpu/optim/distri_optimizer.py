@@ -87,9 +87,8 @@ class DistriOptimizer(BaseOptimizer):
         self.retry_policy = retry_policy
         self._step = None
         self._param_shardings = None
-        self._pristine_params = None
-        self._pristine_state = None
         self._elastic = None
+        self._bucketing = None
 
     # ------------------------------------------------------------------ #
     @property
@@ -187,7 +186,11 @@ class DistriOptimizer(BaseOptimizer):
                 (loss, new_ms), grads = loss_and_grads(params, model_state,
                                                        x, y, step_rng)
             grads = clip(grads)
-            new_params, new_opt = optim.update(grads, opt_state, params, lr)
+            # full merged state out (model_state is donated: untouched
+            # leaves must alias through the step, not dangle on host)
+            new_ms = merge_state(model_state, new_ms)
+            new_params, new_opt = optim.update_with_masters(
+                grads, opt_state, params, lr)
             (new_params, new_opt, new_ms), aux = guards(
                 guard, need_norms, loss, grads,
                 (params, opt_state, model_state),
@@ -196,19 +199,20 @@ class DistriOptimizer(BaseOptimizer):
 
         # jit with sharding propagated from the placed inputs; XLA SPMD
         # partitions the computation and inserts the ICI collectives;
-        # donated: params, optimizer slots, and the rng chain. With
-        # telemetry attached, the compile-telemetry wrapper emits one
-        # `compile` record per distinct (x, y) signature and carries the
-        # executable's FLOP count for step-record attribution; without
-        # it the plain jit fast path is kept (attribution is
-        # observability — an unobserved run must not pay for it)
+        # donated: params, optimizer slots, model state, and the rng
+        # chain. With telemetry attached, the compile-telemetry wrapper
+        # emits one `compile` record per distinct (x, y) signature and
+        # carries the executable's FLOP count for step-record
+        # attribution; without it the plain jit fast path is kept
+        # (attribution is observability — an unobserved run must not pay
+        # for it)
         if self.telemetry is None:
-            return jax.jit(step, donate_argnums=(0, 1, 6))
+            return jax.jit(step, donate_argnums=(0, 1, 2, 6))
         from bigdl_tpu.observability.compilation import CompiledFunction
         return CompiledFunction(
             step, label=f"distri.step/{type(self.model).__name__}",
             telemetry=self.telemetry, sig_argnums=(3, 4),
-            donate_argnums=(0, 1, 6))
+            donate_argnums=(0, 1, 2, 6))
 
     # ------------------------------------------------------------------ #
     def _retry_policy(self) -> RetryPolicy:
@@ -223,6 +227,10 @@ class DistriOptimizer(BaseOptimizer):
         return self.retry_policy
 
     def optimize(self) -> Module:
+        # a snapshot left over from a previous run is stale: the retry
+        # handler must never restore pre-last-run weights after an early
+        # failure in THIS run (each attempt re-snapshots on entry)
+        self._pristine_params = self._pristine_state = None
         self._maybe_optimize_graph()
         if self._preemption is not None:
             # clear any stale latch from a previous preempted run before
@@ -317,11 +325,15 @@ class DistriOptimizer(BaseOptimizer):
             params, model_state = self._place(params, model_state, None)
         resume_slots = getattr(self, "_resume_slots", None)
         if resume_slots is not None:
-            # restore checkpointed optimizer moments, placed like the params
-            opt_state = jax.tree_util.tree_map(jnp.asarray, resume_slots)
+            # restore checkpointed optimizer moments, placed like the
+            # params. COPY, never alias (jnp.array, not asarray): the
+            # donated step would otherwise delete the checkpoint loader's
+            # arrays out from under the retry/`_resume_slots` handling
+            # when they are already jax.Arrays (orbax sharded restores)
+            opt_state = jax.tree_util.tree_map(jnp.array, resume_slots)
             self._resume_slots = None
         else:
-            opt_state = self.optim_method.init_state(params)
+            opt_state = self.optim_method.init_state_with_masters(params)
         step = self._step_fn = self._build_step()
         driver_state = self.optim_method.state
         # per-host shard feeds this loop; scale records by host count so
@@ -411,7 +423,7 @@ class DistriOptimizer(BaseOptimizer):
                 # every dispatched step up to here has completed
                 with self._span("loss sync"):
                     loss_val = float(loss)
-            model_state = merge_state(model_state, new_ms)
+            model_state = new_ms  # step returns the FULL merged state
 
             n = batch.size() * num_hosts  # global records this step
             driver_state["neval"] += 1
@@ -600,6 +612,62 @@ class DistriOptimizer(BaseOptimizer):
 
         return jax.jit(shard_step)
 
+    def set_gradient_bucketing(self, bucket_mb: float = 4.0,
+                               enabled: bool = True):
+        """Arm size-bucketed, comm/compute-overlapped gradient exchange
+        for the explicit (elastic) exchange plan: instead of one
+        post-backward barrier reduction over every shard's full gradient
+        tree, the tree splits into reverse-topological buckets of at most
+        `bucket_mb` MiB (optim/bucketing.py), and each bucket's
+        cross-shard transfer + donated accumulate dispatches AS SOON AS
+        its shard's results exist — overlapping the reduction of shard i
+        with shard i+1's backward compute, with no
+        `jax.block_until_ready` anywhere in the chain.
+
+        Bit-identity: buckets accumulate shards in the same fixed logical
+        order as the barrier combine, so the elastic bit-identical
+        trajectory contract is preserved (suite-asserted; the
+        `--chaos --device-loss` smoke runs with bucketing on). Compile
+        discipline: one accumulate executable per distinct bucket layout,
+        reused across shards and steps.
+
+        The fused SPMD step is unaffected: there XLA's SPMD partitioner
+        inserts the all-reduces and its combiner/latency-hiding scheduler
+        owns bucketing and overlap (see ParallelOptimizer).
+        `set_gradient_bucketing(enabled=False)` disarms."""
+        if not enabled:
+            self._bucketing = None
+            return self
+        if bucket_mb <= 0:
+            raise ValueError(f"bucket_mb must be > 0, got {bucket_mb}")
+        self._bucketing = {"bucket_bytes": int(bucket_mb * 2 ** 20)}
+        return self
+
+    setGradientBucketing = set_gradient_bucketing
+
+    @staticmethod
+    def _elastic_mean(losses, states, R0: int):
+        """Shared post-reduction tail of both exchange plans: mean loss
+        over shards plus float-leaf-averaged model state (counters take
+        shard 0's value)."""
+        loss = losses[0]
+        for li in losses[1:]:
+            loss = loss + li
+        loss = loss / R0
+
+        def avg(*ls):
+            a = ls[0]
+            if not (hasattr(a, "dtype")
+                    and jnp.issubdtype(a.dtype, jnp.floating)):
+                return a  # counters etc. take shard 0's value
+            s = a
+            for o in ls[1:]:
+                s = s + o
+            return s / R0
+
+        ms = states[0] if R0 == 1 else jax.tree_util.tree_map(avg, *states)
+        return loss, ms
+
     def _build_elastic_combine(self, R0: int):
         """Jitted fixed-order reduction + weight update on the lead
         device: sum the R0 shard gradients SEQUENTIALLY (never a psum —
@@ -607,6 +675,7 @@ class DistriOptimizer(BaseOptimizer):
         update. Model-state float leaves average the same way."""
         optim = self.optim_method
         clip = self._clip_grads_expr
+        mean_tail = self._elastic_mean
 
         def combine(params, opt_state, lr, losses, grads, states):
             g = grads[0]
@@ -614,27 +683,46 @@ class DistriOptimizer(BaseOptimizer):
                 g = jax.tree_util.tree_map(jnp.add, g, gi)
             g = jax.tree_util.tree_map(lambda a: a / R0, g)
             g = clip(g)
-            new_params, new_opt = optim.update(g, opt_state, params, lr)
-            loss = losses[0]
-            for li in losses[1:]:
-                loss = loss + li
-            loss = loss / R0
-
-            def avg(*ls):
-                a = ls[0]
-                if not (hasattr(a, "dtype")
-                        and jnp.issubdtype(a.dtype, jnp.floating)):
-                    return a  # counters etc. take shard 0's value
-                s = a
-                for o in ls[1:]:
-                    s = s + o
-                return s / R0
-
-            ms = states[0] if R0 == 1 else \
-                jax.tree_util.tree_map(avg, *states)
+            new_params, new_opt = optim.update_with_masters(g, opt_state,
+                                                            params, lr)
+            loss, ms = mean_tail(losses, states, R0)
             return new_params, new_opt, ms, loss
 
         return jax.jit(combine)
+
+    def _build_bucket_add(self):
+        """ONE accumulate callable for every bucket: adds a shard's
+        bucket leaves into the running accumulator, which is DONATED —
+        the chain never blocks the host, and jax compiles one executable
+        per distinct bucket layout (the compile-telemetry wrapper makes
+        that budget observable when telemetry is attached)."""
+        def bucket_add(acc, g):
+            return tuple(a + b for a, b in zip(acc, g))
+
+        if self.telemetry is None:
+            return jax.jit(bucket_add, donate_argnums=(0,))
+        from bigdl_tpu.observability.compilation import CompiledFunction
+        return CompiledFunction(bucket_add, label="distri.bucket_add",
+                                telemetry=self.telemetry,
+                                donate_argnums=(0,))
+
+    def _build_elastic_finalize(self, R0: int):
+        """Jitted tail of the BUCKETED exchange: the gradients arrive
+        already summed over shards (per-bucket donated chains), so only
+        mean, clip, update, and the loss/state averaging remain."""
+        optim = self.optim_method
+        clip = self._clip_grads_expr
+        mean_tail = self._elastic_mean
+
+        def finalize(params, opt_state, lr, g_sum, losses, states):
+            g = jax.tree_util.tree_map(lambda a: a / R0, g_sum)
+            g = clip(g)
+            new_params, new_opt = optim.update_with_masters(g, opt_state,
+                                                            params, lr)
+            loss, ms = mean_tail(losses, states, R0)
+            return new_params, new_opt, ms, loss
+
+        return jax.jit(finalize)
 
     @staticmethod
     def _elastic_recoverable(e: BaseException) -> bool:
@@ -714,9 +802,18 @@ class DistriOptimizer(BaseOptimizer):
                                                      resume_slots), lead)
             self._resume_slots = None
         else:
-            opt_state = self.optim_method.init_state(params)
+            opt_state = self.optim_method.init_state_with_masters(params)
         shard_fn = self._build_elastic_shard_fn()
         combine_fn = self._build_elastic_combine(R0)
+        bplan = bucket_add = finalize_fn = None
+        if self._bucketing is not None:
+            from bigdl_tpu.optim.bucketing import GradientBucketPlan
+            bplan = GradientBucketPlan(params,
+                                       self._bucketing["bucket_bytes"])
+            bucket_add = self._build_bucket_add()
+            finalize_fn = self._build_elastic_finalize(R0)
+            if self.telemetry is not None:
+                self.telemetry.event("bucket_plan", **bplan.describe())
         driver_state = self.optim_method.state
         num_hosts = getattr(self.dataset, "num_hosts", 1)
         epoch_size = getattr(self.dataset, "global_size", None) or \
@@ -790,6 +887,7 @@ class DistriOptimizer(BaseOptimizer):
                         per_dev[d] = (params, model_state) if d is lead \
                             else (place(params, d), place(model_state, d))
                     losses_d, grads_d, ms_d = [], [], []
+                    acc = [None] * len(bplan) if bplan is not None else None
                     for i in range(R0):
                         d = controller.shard_device(plan, i)
                         p_d, ms_dv = per_dev[d]
@@ -807,16 +905,37 @@ class DistriOptimizer(BaseOptimizer):
                                 jax.device_put(shard_rngs[i], d))
                         if d is not lead:
                             l_i = jax.device_put(l_i, lead)
-                            g_i = place(g_i, lead)
                             m_i = place(m_i, lead)
                         losses_d.append(l_i)
-                        grads_d.append(g_i)
                         ms_d.append(m_i)
+                        if bplan is None:
+                            grads_d.append(g_i if d is lead
+                                           else place(g_i, lead))
+                            continue
+                        # bucketed exchange: transfer + accumulate THIS
+                        # shard's buckets now, async (donation chains the
+                        # accumulators; no block_until_ready anywhere) —
+                        # the lead reduces shard i's gradients while
+                        # shard i+1's backward still runs on its device.
+                        # Shard order per bucket matches the barrier
+                        # combine's sequential sum, so the trajectory
+                        # stays BIT-identical.
+                        for b, leaves in enumerate(bplan.split(g_i)):
+                            if d is not lead:
+                                leaves = tuple(jax.device_put(l, lead)
+                                               for l in leaves)
+                            acc[b] = leaves if acc[b] is None \
+                                else bucket_add(acc[b], leaves)
                     faults.fire("mesh.collective", step=step_no,
                                 n_active=plan.n_active)
-                    params, opt_state, new_ms, loss = combine_fn(
-                        params, opt_state, lr, tuple(losses_d),
-                        tuple(grads_d), tuple(ms_d))
+                    if bplan is None:
+                        params, opt_state, new_ms, loss = combine_fn(
+                            params, opt_state, lr, tuple(losses_d),
+                            tuple(grads_d), tuple(ms_d))
+                    else:
+                        params, opt_state, new_ms, loss = finalize_fn(
+                            params, opt_state, lr, bplan.join(acc),
+                            tuple(losses_d), tuple(ms_d))
                 do_sync = step_no % sync_every == 0
                 if do_sync:
                     with self._span("loss sync"):

@@ -78,6 +78,12 @@ class BaseOptimizer:
         self._active_pipeline = None
         self._preemption = None
         self._resume_cursor = None
+        # host snapshot for donation-safe failure recovery: the jitted
+        # step donates the model's device arrays, so an aborted run must
+        # restore the model from this instead of leaving it holding
+        # deleted buffers
+        self._pristine_params = None
+        self._pristine_state = None
 
     # fluent setters (Optimizer.scala:93-452)
     def set_gradient_accumulation(self, steps: int):
@@ -933,6 +939,10 @@ class LocalOptimizer(BaseOptimizer):
         self.batch_size = batch_size
 
     def optimize(self) -> Module:
+        # a snapshot left over from a PREVIOUS run is stale: a failure
+        # early in this run (before _optimize_impl re-snapshots) must
+        # not revert the model to pre-last-run weights
+        self._pristine_params = self._pristine_state = None
         if self._preemption is not None:
             # a latch left set by a previous preempted run is stale: the
             # next optimize() (train-more / drill reuse) must train, not
@@ -942,9 +952,14 @@ class LocalOptimizer(BaseOptimizer):
         try:
             return self._optimize_impl()
         except (KeyboardInterrupt, SystemExit):
+            self._restore_pristine()
             raise
         except Exception as e:
             self._telemetry_run_abort(e)
+            # the donated step killed the model's device arrays; put the
+            # pre-run host snapshot back so the instance stays usable
+            # (pre-donation behavior: params unchanged on failure)
+            self._restore_pristine()
             raise
         finally:
             # join prefetch workers whether the run finished or died —
@@ -952,6 +967,13 @@ class LocalOptimizer(BaseOptimizer):
             self._close_data_pipeline(self._active_pipeline)
             if self._preemption is not None:
                 self._preemption.uninstall()
+
+    def _restore_pristine(self):
+        """Put the pre-run host snapshot back on the model after a failed
+        donated run (the step aliased the model's old device buffers)."""
+        if self._pristine_params is not None:
+            self.model.set_params(self._pristine_params)
+            self.model._state = self._pristine_state
 
     def _build_step(self):
         model, criterion = self.model, self.criterion
@@ -978,14 +1000,26 @@ class LocalOptimizer(BaseOptimizer):
 
             (loss, new_ms), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
             grads = clip(grads)
-            new_params, new_opt = optim.update(grads, opt_state, params, lr)
+            # return the FULL merged state, not the partial update:
+            # model_state is donated, so untouched old leaves must flow
+            # through the step (aliased by XLA) rather than be re-read
+            # from dead host references
+            new_ms = merge_state(model_state, new_ms)
+            new_params, new_opt = optim.update_with_masters(
+                grads, opt_state, params, lr)
             (new_params, new_opt, new_ms), aux = guards(
                 guard, need_norms, loss, grads,
                 (params, opt_state, model_state),
                 (new_params, new_opt, new_ms))
             return new_params, new_opt, new_ms, loss, aux
 
-        # with telemetry attached, route the step through the
+        # donation: params, optimizer slots, and model state alias their
+        # output buffers (PERF.md measured a ~20x dispatch penalty for
+        # non-donated same-shape probes on the distri path; the local
+        # loop now gets the same aliasing). The guards' skip-mode revert
+        # stays donation-safe: jnp.where selects between traced values.
+        #
+        # With telemetry attached, route the step through the
         # compile-telemetry wrapper: one `compile` record per distinct
         # step signature, FLOPs/bytes off the executable for the step
         # records' attribution fields. Signature = the batch args only —
@@ -994,24 +1028,33 @@ class LocalOptimizer(BaseOptimizer):
         # kept — attribution is observability, and an unobserved run
         # must not pay for it
         if self.telemetry is None:
-            return jax.jit(step)
+            return jax.jit(step, donate_argnums=(0, 1, 2))
         from bigdl_tpu.observability.compilation import CompiledFunction
         return CompiledFunction(
             step, label=f"local.step/{type(self.model).__name__}",
-            telemetry=self.telemetry, sig_argnums=(3, 4))
+            telemetry=self.telemetry, sig_argnums=(3, 4),
+            donate_argnums=(0, 1, 2))
 
     def _optimize_impl(self) -> Module:
         self._maybe_optimize_graph()
         params = self.model.ensure_params()
         model_state = self.model._state
+        # host snapshot BEFORE the first donated step kills these buffers:
+        # a failed run restores it so the model instance stays usable
+        self._pristine_params = jax.device_get(params)
+        self._pristine_state = jax.device_get(model_state)
         resume_slots = getattr(self, "_resume_slots", None)
         if resume_slots is not None:
             # checkpointed optimizer moments (Adam m/v, SGD velocity)
-            # from resume_from_latest_checkpoint
-            opt_state = jax.tree_util.tree_map(jnp.asarray, resume_slots)
+            # from resume_from_latest_checkpoint. COPY, never alias
+            # (jnp.array, not asarray): the donated step would otherwise
+            # delete the checkpoint loader's own arrays out from under
+            # `_resume_slots`/retry handling when they are already
+            # jax.Arrays (the orbax sharded format restores those)
+            opt_state = jax.tree_util.tree_map(jnp.array, resume_slots)
             self._resume_slots = None
         else:
-            opt_state = self.optim_method.init_state(params)
+            opt_state = self.optim_method.init_state_with_masters(params)
         step = self._step_fn = self._build_step()
         state = self.optim_method.state  # epoch/neval bookkeeping
         driver_state = state
@@ -1064,7 +1107,7 @@ class LocalOptimizer(BaseOptimizer):
             if do_sync:
                 with self._span("loss sync"):
                     loss_val = float(loss)  # waits for the step to finish
-            model_state = merge_state(model_state, new_ms)
+            model_state = new_ms  # step returns the FULL merged state
 
             n = batch.size()
             driver_state["neval"] += 1

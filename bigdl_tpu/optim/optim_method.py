@@ -59,6 +59,64 @@ class OptimMethod:
             return _tree(lambda g, p: g + wd * p, grads, params)
         return grads
 
+    # -- fp32 master weights for sub-f32 parameter trees ----------------- #
+    #
+    # When the MODEL's params are bf16 (not just the compute cast of
+    # set_compute_precision, whose masters are already the f32 params), a
+    # bare update loses precision: bf16's ~8 mantissa bits swallow any
+    # lr*grad smaller than ~eps/2 of the weight, stalling training. The
+    # wrappers below keep an fp32 master copy in opt_state, run every
+    # method's update against the masters (slots init in f32 too), and
+    # cast the result back to each param's storage dtype — so the fused,
+    # donated train step stays precision-safe with bf16-resident weights.
+    # f32 trees pass through untouched (identical opt_state structure,
+    # old checkpoints keep loading).
+
+    _MASTER_KEY = "__f32_masters__"
+
+    @staticmethod
+    def _has_low_precision(params) -> bool:
+        return any(
+            hasattr(l, "dtype") and jnp.issubdtype(l.dtype, jnp.floating)
+            and jnp.finfo(l.dtype).bits < 32
+            for l in jax.tree_util.tree_leaves(params))
+
+    @staticmethod
+    def _to_f32(tree):
+        def up(l):
+            if hasattr(l, "dtype") and jnp.issubdtype(l.dtype, jnp.floating):
+                return l.astype(jnp.float32)
+            return l
+        return _tree(up, tree)
+
+    def init_state_with_masters(self, params):
+        """`init_state`, plus fp32 masters when any param leaf is a
+        sub-f32 float. The train-step builders call this (and
+        `update_with_masters`) instead of the raw pair."""
+        if not self._has_low_precision(params):
+            return self.init_state(params)
+        masters = self._to_f32(params)
+        return {self._MASTER_KEY: masters,
+                "slots": self.init_state(masters)}
+
+    def update_with_masters(self, grads, opt_state, params, lr):
+        """`update` against the fp32 masters when opt_state carries them:
+        grads upcast, the method's own update runs in f32, new params are
+        the new masters cast back to each leaf's storage dtype."""
+        if not (isinstance(opt_state, dict)
+                and self._MASTER_KEY in opt_state):
+            return self.update(grads, opt_state, params, lr)
+        masters = opt_state[self._MASTER_KEY]
+        new_masters, new_slots = self.update(
+            self._to_f32(grads), opt_state["slots"], masters, lr)
+        new_params = _tree(
+            lambda m, p: m.astype(p.dtype)
+            if hasattr(p, "dtype") and jnp.issubdtype(p.dtype, jnp.floating)
+            else m,
+            new_masters, params)
+        return new_params, {self._MASTER_KEY: new_masters,
+                            "slots": new_slots}
+
     # -- host-side hyperparameter plumbing (reference updateHyperParameter) --
     def get_learning_rate(self) -> float:
         return float(self.learning_rate)

@@ -776,6 +776,287 @@ def bench_generation_ab(clients: int = 8, segments: int = 4,
     return out
 
 
+def _loss_trajectory(model_fn, batches, fused: bool, iters: int,
+                     force_pallas: bool = False, lr: float = 0.05):
+    """One deterministic LocalOptimizer run (fixed init, fixed data);
+    returns the per-iteration loss list. `fused` toggles BN+ReLU pattern
+    fusion; `force_pallas` routes the fused tail through the Pallas
+    kernels in interpreter mode (the parity gate's configuration)."""
+    import jax
+
+    import bigdl_tpu.nn as nn_
+    import bigdl_tpu.optim as optim
+    from bigdl_tpu.dataset.dataset import LocalDataSet
+    from bigdl_tpu.nn import fusion
+    from bigdl_tpu.ops import bn_relu_kernel
+    from bigdl_tpu.optim.local_optimizer import LocalOptimizer
+    from bigdl_tpu.optim.trigger import max_iteration
+
+    prev_force = bn_relu_kernel.FORCE_PALLAS
+    bn_relu_kernel.FORCE_PALLAS = force_pallas and fused
+    try:
+        with fusion.fusion_scope(fused):
+            model = model_fn()
+            model.ensure_params(jax.random.PRNGKey(0))
+            opt = LocalOptimizer(model, LocalDataSet(list(batches)),
+                                 nn_.ClassNLLCriterion(),
+                                 batches[0].size())
+            opt.set_optim_method(optim.SGD(learning_rate=lr, momentum=0.9))
+            opt.set_end_when(max_iteration(iters))
+            losses = []
+            opt.set_iteration_hook(lambda s: losses.append(s["loss"]))
+            opt.optimize()
+        return losses
+    finally:
+        bn_relu_kernel.FORCE_PALLAS = prev_force
+
+
+def bench_fusion_ab(segments: int = 10, seg_iters: int = 6,
+                    batch_size: int = 16, parity_iters: int = 6):
+    """Fusion A/B: pattern-fused BN+ReLU tails vs the unfused graph on
+    the ResNet/CIFAR config, through the REAL LocalOptimizer loop.
+
+    Gates the PARITY contract first (same pattern as the generation
+    smoke), two legs per model (LeNet — no BN, fusion must be a no-op —
+    and ResNet-8/CIFAR):
+    (1) production CPU routing: fused loss trajectories BIT-identical to
+        the unfused graph (the inline tail is structurally the unfused
+        ops);
+    (2) kernel routing (Pallas custom_vjp FORCED, interpreter mode):
+        step-0 loss bit-identical (fused forward is exact) and every
+        step's |Δloss| <= 1e-6 (the fused backward's tiled partial
+        reductions regroup sums at the last-ulp level).
+    The CLI exits nonzero on a break.
+
+    Then measures: per-step `bytes_accessed`/`flops` of the compiled
+    fused vs unfused step executables (the PR 8 attribution stream —
+    compile records off the CompiledFunction wrapper), and wall-clock
+    step time via the alternated pair-ratio estimator from docs/PERF.md.
+    CPU guard: off-TPU the fused tail lowers to the same XLA-fused
+    elementwise expressions, so the CPU ratio measures only the pattern
+    rewrite (~1.0x expected); the kernel's HBM win needs the TPU capture
+    (docs/PERF.md "Fusion and overlap"). Prints ONE json line."""
+    import jax
+
+    import bigdl_tpu.nn as nn_
+    import bigdl_tpu.optim as optim
+    from bigdl_tpu.dataset.dataset import LocalDataSet
+    from bigdl_tpu.dataset.sample import MiniBatch
+    from bigdl_tpu.models.lenet import LeNet5
+    from bigdl_tpu.models.resnet import ResNet
+    from bigdl_tpu.nn import fusion
+    from bigdl_tpu.observability import InMemorySink, Telemetry
+    from bigdl_tpu.optim.local_optimizer import LocalOptimizer
+    from bigdl_tpu.optim.trigger import max_iteration
+
+    rs = np.random.RandomState(0)
+    resnet_batches = [
+        MiniBatch(rs.rand(batch_size, 32, 32, 3).astype(np.float32),
+                  (rs.randint(0, 10, batch_size) + 1).astype(np.int32))
+        for _ in range(4)]
+    lenet_batches = [
+        MiniBatch(rs.rand(batch_size, 28, 28).astype(np.float32),
+                  (rs.randint(0, 10, batch_size) + 1).astype(np.int32))
+        for _ in range(4)]
+    resnet_fn = lambda: ResNet(class_num=10, depth=8, data_set="cifar10")
+    lenet_fn = lambda: LeNet5(10)
+
+    # -- parity gate: exact leg (CPU routing) + bounded kernel leg ------
+    parity = True
+    for name, fn, bs in (("resnet8_cifar", resnet_fn, resnet_batches),
+                         ("lenet", lenet_fn, lenet_batches)):
+        ref = _loss_trajectory(fn, bs, fused=False, iters=parity_iters)
+        got = _loss_trajectory(fn, bs, fused=True, iters=parity_iters)
+        if ref != got:
+            parity = False
+            print(f"fusion parity BREAK on {name} (production routing, "
+                  f"bit-identity): unfused {ref} vs fused {got}",
+                  file=sys.stderr)
+        krn = _loss_trajectory(fn, bs, fused=True, iters=parity_iters,
+                               force_pallas=True)
+        if krn[0] != ref[0] or any(abs(a - b) > 1e-6
+                                   for a, b in zip(ref, krn)):
+            parity = False
+            print(f"fusion parity BREAK on {name} (interpret-mode "
+                  f"kernels, step-0 exact + |d|<=1e-6): unfused {ref} "
+                  f"vs fused(pallas) {krn}", file=sys.stderr)
+
+    # -- attribution: bytes/flops of the compiled step, per mode --------
+    def step_costs(fused):
+        sink = InMemorySink()
+        tel = Telemetry(sink, resources=False)
+        with fusion.fusion_scope(fused):
+            model = resnet_fn()
+            model.ensure_params(jax.random.PRNGKey(0))
+            opt = LocalOptimizer(model, LocalDataSet(list(resnet_batches)),
+                                 nn_.ClassNLLCriterion(), batch_size)
+            opt.set_optim_method(optim.SGD(learning_rate=0.05,
+                                           momentum=0.9))
+            opt.set_end_when(max_iteration(2))
+            opt.set_telemetry(tel)
+            opt.optimize()
+        tel.close()
+        rec = next((r for r in sink.records if r.get("type") == "compile"
+                    and str(r.get("label", "")).startswith("local.step")),
+                   {})
+        return rec.get("bytes_accessed"), rec.get("flops")
+
+    bytes_fused, flops_fused = step_costs(True)
+    bytes_unfused, flops_unfused = step_costs(False)
+
+    # -- throughput: alternated pair-ratio segments ---------------------
+    def run_seg(fused):
+        with fusion.fusion_scope(fused):
+            model = resnet_fn()
+            model.ensure_params(jax.random.PRNGKey(0))
+            opt = LocalOptimizer(model, LocalDataSet(list(resnet_batches)),
+                                 nn_.ClassNLLCriterion(), batch_size)
+            opt.set_optim_method(optim.SGD(learning_rate=0.05,
+                                           momentum=0.9))
+            opt.set_end_when(max_iteration(2 + seg_iters))
+            times = []
+            opt.set_iteration_hook(
+                lambda s: times.append(time.perf_counter()))
+            opt.optimize()
+        return list(np.diff(times)[2:])  # drop compile/warmup iterations
+
+    speedup = None
+    if segments > 0:
+        run_seg(True)   # throwaway pair: allocator/compile warmup
+        run_seg(False)
+        pair_ratios = []
+        for _ in range(segments):
+            f_seg = run_seg(True)
+            u_seg = run_seg(False)
+            pair_ratios.append(float(np.median(u_seg) / np.median(f_seg)))
+        speedup = float(np.median(pair_ratios))
+
+    delta = None
+    if bytes_fused and bytes_unfused:
+        delta = round(1.0 - bytes_fused / bytes_unfused, 4)
+    out = {
+        "metric": "fusion_ab",
+        "parity": parity,
+        "batch_size": batch_size,
+        "speedup": round(speedup, 3) if speedup is not None else None,
+        "bytes_accessed_fused": bytes_fused,
+        "bytes_accessed_unfused": bytes_unfused,
+        "bytes_accessed_reduction": delta,
+        "flops_fused": flops_fused,
+        "flops_unfused": flops_unfused,
+        "backend": __import__("jax").default_backend(),
+        "cpu_guard": __import__("jax").default_backend() != "tpu",
+    }
+    print(json.dumps(out), flush=True)
+    return out
+
+
+def bench_overlap_ab(segments: int = 6, seg_iters: int = 8,
+                     batch_size: int = 64, bucket_kb: int = 64,
+                     parity_iters: int = 6):
+    """Overlap A/B: size-bucketed comm/compute-overlapped gradient
+    exchange vs the single post-backward barrier reduction, through the
+    REAL elastic DistriOptimizer loop on >= 2 (virtual) devices.
+
+    Gates the PARITY contract first: bucketed and barrier exchanges must
+    produce BIT-identical parameters at matched step counts (the elastic
+    trajectory contract with bucketing on); exits nonzero on a break.
+    Then the alternated pair-ratio estimator (docs/PERF.md) compares
+    per-iteration step time. CPU guard: virtual devices share host
+    cores, so the CPU ratio mostly reflects dispatch-chain overhead, not
+    ICI overlap — the TPU capture is the real figure. Prints ONE json
+    line with the ratio, bucket plan, and the compile budget (one
+    accumulate executable per bucket layout)."""
+    import jax
+
+    import bigdl_tpu.nn as nn_
+    import bigdl_tpu.optim as optim
+    from bigdl_tpu.dataset.dataset import LocalDataSet
+    from bigdl_tpu.dataset.sample import MiniBatch
+    from bigdl_tpu.observability import InMemorySink, Telemetry
+    from bigdl_tpu.optim.distri_optimizer import DistriOptimizer
+    from bigdl_tpu.optim.trigger import max_iteration
+    from bigdl_tpu.parallel.mesh import build_mesh
+
+    n_dev = jax.device_count()
+    if n_dev < 2:
+        out = {"metric": "overlap_ab", "skipped": True,
+               "reason": f"{n_dev} device(s); need >= 2 "
+                         "(set --xla_force_host_platform_device_count)"}
+        print(json.dumps(out), flush=True)
+        return out
+    n_use = min(4, n_dev)
+    rs = np.random.RandomState(0)
+    batches = [
+        MiniBatch(rs.rand(batch_size, 28, 28).astype(np.float32),
+                  (rs.randint(0, 10, batch_size) + 1).astype(np.int32))
+        for _ in range(4)]
+
+    def run(bucketed, iters, telemetry=None):
+        model = (nn_.Sequential().add(nn_.Reshape([784]))
+                 .add(nn_.Linear(784, 256)).add(nn_.Tanh())
+                 .add(nn_.Linear(256, 256)).add(nn_.Tanh())
+                 .add(nn_.Linear(256, 10)).add(nn_.LogSoftMax()))
+        model.ensure_params(jax.random.PRNGKey(0))
+        opt = DistriOptimizer(model, LocalDataSet(list(batches)),
+                              nn_.ClassNLLCriterion(),
+                              mesh=build_mesh(data=n_use, model=1,
+                                              devices=jax.devices()[:n_use]),
+                              retry_times=0)
+        opt.set_optim_method(optim.SGD(learning_rate=0.05, momentum=0.9))
+        opt.set_end_when(max_iteration(iters))
+        opt.set_elastic()
+        if telemetry is not None:
+            opt.set_telemetry(telemetry)
+        if bucketed:
+            opt.set_gradient_bucketing(bucket_mb=bucket_kb / 1024.0)
+        times = []
+        opt.set_iteration_hook(lambda s: times.append(time.perf_counter()))
+        opt.optimize()
+        return model, list(np.diff(times)[2:])
+
+    # -- parity gate: bucketed == barrier, bitwise ----------------------
+    sink = InMemorySink()
+    tel = Telemetry(sink, resources=False)
+    m_b, _ = run(True, parity_iters, telemetry=tel)
+    tel.close()
+    m_s, _ = run(False, parity_iters)
+    import jax.tree_util as jtu
+    parity = all(
+        np.array_equal(np.asarray(a), np.asarray(b))
+        for a, b in zip(jtu.tree_leaves(m_b.parameters()),
+                        jtu.tree_leaves(m_s.parameters())))
+    plan_ev = next((r for r in sink.records
+                    if r.get("event") == "bucket_plan"), {})
+    add_compiles = sum(1 for r in sink.records
+                       if r.get("type") == "compile"
+                       and r.get("label") == "distri.bucket_add")
+
+    # -- throughput: alternated pair-ratio segments ---------------------
+    pair_ratios = []
+    for _ in range(segments):
+        _, b_seg = run(True, 2 + seg_iters)
+        _, s_seg = run(False, 2 + seg_iters)
+        if b_seg and s_seg:
+            pair_ratios.append(float(np.median(s_seg) / np.median(b_seg)))
+    speedup = float(np.median(pair_ratios)) if pair_ratios else None  # None when parity-only (segments=0)
+
+    out = {
+        "metric": "overlap_ab",
+        "devices": n_use,
+        "parity": parity,
+        "speedup": round(speedup, 3) if speedup else None,
+        "n_buckets": plan_ev.get("n_buckets"),
+        "n_layouts": plan_ev.get("n_layouts"),
+        "bucket_kb": bucket_kb,
+        "bucket_add_compiles": add_compiles,
+        "backend": jax.default_backend(),
+        "cpu_guard": jax.default_backend() != "tpu",
+    }
+    print(json.dumps(out), flush=True)
+    return out
+
+
 def bench_chaos(crash_at: int = 8, iters: int = 16, ckpt_every: int = 4,
                 batch_size: int = 64, n_samples: int = 1024,
                 keep_last_n: int = 3):
@@ -932,6 +1213,9 @@ def bench_chaos_device_loss(lose_at: int = 5, rejoin_at: int = 12,
     opt.set_end_when(max_iteration(iters))
     opt.set_sync_interval(sync)
     opt.set_elastic(registry=cluster.registry)
+    # bucketed exchange ON in the chaos drill: the recovery smoke gates
+    # that bucketing preserves the elastic shrink/replay/grow contract
+    opt.set_gradient_bucketing()
     opt.set_telemetry(telemetry)
     opt.set_iteration_hook(
         lambda s: cluster.restore("worker1")
@@ -1517,6 +1801,9 @@ def main():
     replica_loss = False
     generate = False
     generate_clients = 8
+    fusion_ab = False
+    overlap_ab = False
+    ab_segments = None  # --parity-only sets 0
     it = iter(sys.argv[1:])
     for a in it:
         if a == "--telemetry":
@@ -1564,8 +1851,41 @@ def main():
         elif a == "--replica-loss":
             chaos = True  # same policy as --device-loss: the flag alone
             replica_loss = True  # must run the drill
+        elif a == "--fusion":
+            fusion_ab = True
+        elif a == "--overlap":
+            overlap_ab = True
+        elif a == "--parity-only":
+            # CI mode: run the bit-identity/bounded parity gates and the
+            # attribution A/B but skip the wall-clock segments — on CPU
+            # the throughput ratio is documented as meaningless anyway
+            ab_segments = 0
         else:
             argv.append(a)
+    if fusion_ab:
+        # fusion A/B: pattern-fused BN+ReLU tails vs the unfused graph,
+        # WITH the interpret-mode trajectory parity gate (exits nonzero
+        # on a break — the CI fusion smoke); one json line on stdout,
+        # see docs/PERF.md "Fusion and overlap"
+        logging.getLogger("bigdl_tpu.optim").setLevel(logging.ERROR)
+        _configure_compile_cache()
+        out = bench_fusion_ab(**({} if ab_segments is None
+                                 else {"segments": ab_segments}))
+        if not out.get("parity"):
+            raise SystemExit(1)
+        return
+    if overlap_ab:
+        # overlap A/B: bucketed vs barrier gradient exchange through the
+        # elastic loop, WITH the bitwise params-parity gate (exits
+        # nonzero on a break); one json line on stdout
+        logging.getLogger("bigdl_tpu.optim").setLevel(logging.ERROR)
+        logging.getLogger("bigdl_tpu.resilience").setLevel(logging.ERROR)
+        _configure_compile_cache()
+        out = bench_overlap_ab(**({} if ab_segments is None
+                                  else {"segments": ab_segments}))
+        if not (out.get("parity") or out.get("skipped")):
+            raise SystemExit(1)
+        return
     if generate:
         # generation A/B: serial full-recompute greedy decode vs the
         # continuous-batching engine, WITH the token-parity gate (exits

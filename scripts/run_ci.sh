@@ -49,6 +49,20 @@ BIGDL_TPU_TELEMETRY="$chaos_dir" \
 python -m bigdl_tpu.tools.metrics_cli slo --check --mttr-s 60 \
   "$chaos_dir"/serve_fleet_*.jsonl
 
+# fusion parity smoke: pattern-fused BN+ReLU (Pallas kernels forced in
+# interpreter mode) must train LeNet and ResNet-8/CIFAR with loss
+# trajectories BIT-identical to the unfused graph (exits nonzero on a
+# parity break), and reports the step-executable bytes_accessed A/B.
+# --parity-only skips the wall-clock segments (meaningless on CPU —
+# the full A/B is the TPU capture, docs/PERF.md "Fusion and overlap")
+python -m bigdl_tpu.tools.bench_cli --fusion --parity-only
+
+# overlap parity smoke: bucketed comm/compute-overlapped gradient
+# exchange must produce BIT-identical parameters to the barrier
+# reduction through the elastic loop (exits nonzero on a break), with
+# one accumulate compile per bucket layout
+python -m bigdl_tpu.tools.bench_cli --overlap --parity-only
+
 # generation smoke: continuous-batching greedy decode must reproduce the
 # serial full-recompute reference token-for-token (bench_cli exits
 # nonzero on a parity break), and the generation trace stream (one

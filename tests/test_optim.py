@@ -968,3 +968,298 @@ class TestSyncIntervalInvariance:
         for pa, pb in [(a, b), (a, c)]:
             jax.tree_util.tree_map(
                 lambda x, y: np.testing.assert_array_equal(x, y), pa, pb)
+
+
+class TestDonatedStepHotPath:
+    """The donated train step (params/opt_state/model_state aliased into
+    their outputs) plus the fp32-master machinery: the hot-path contracts
+    of the fused-step PR."""
+
+    def _data(self):
+        rs = np.random.RandomState(7)
+        X = rs.rand(64, 8).astype(np.float32)
+        Y = (rs.randint(0, 3, 64) + 1).astype(np.int32)
+        return X, Y
+
+    def test_local_kill_and_resume_bit_identity_under_donation(self,
+                                                               tmp_path):
+        """Satellite contract: LocalOptimizer step donation must not
+        break resume_from_latest_checkpoint — kill at iteration k,
+        resume in a fresh optimizer, and the final params must equal the
+        uninterrupted oracle bit-for-bit (the resumed opt_state tree is
+        fed straight into a donated call)."""
+        from bigdl_tpu.utils.random_generator import RNG
+        X, Y = self._data()
+
+        def run(end_iter, ck=None, resume=False):
+            RNG.setSeed(42)
+            m = (nn.Sequential().add(nn.Linear(8, 16)).add(nn.Tanh())
+                 .add(nn.Linear(16, 3)).add(nn.LogSoftMax()))
+            o = optim.Optimizer(m, (X, Y), nn.ClassNLLCriterion(),
+                                batch_size=16, local=True)
+            o.set_optim_method(optim.SGD(learning_rate=0.1, momentum=0.9))
+            o.set_end_when(optim.max_iteration(end_iter))
+            if ck:
+                o.set_checkpoint(ck, optim.several_iteration(3))
+                if resume:
+                    assert o.resume_from_latest_checkpoint()
+            o.optimize()
+            return jax.tree_util.tree_leaves(m.ensure_params())
+
+        oracle = run(9)
+        ckdir = str(tmp_path / "ck")
+        run(6, ck=ckdir)          # "killed" after 6 iterations
+        resumed = run(9, ck=ckdir, resume=True)
+        for a, b in zip(oracle, resumed):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_resume_slots_survive_donation_as_jax_arrays(self, tmp_path):
+        """The donated step must never alias the checkpoint loader's own
+        arrays: hand the optimizer jax.Array resume slots (what the orbax
+        sharded loader restores), train, then read the ORIGINAL arrays —
+        they must still be alive."""
+        X, Y = self._data()
+        m = (nn.Sequential().add(nn.Linear(8, 8)).add(nn.Tanh())
+             .add(nn.Linear(8, 3)).add(nn.LogSoftMax()))
+        o = optim.Optimizer(m, (X, Y), nn.ClassNLLCriterion(),
+                            batch_size=16, local=True)
+        o.set_optim_method(optim.SGD(learning_rate=0.1, momentum=0.9))
+        o.set_end_when(optim.max_iteration(2))
+        params = m.ensure_params()
+        slots = o.optim_method.init_state(params)
+        slots = jax.tree_util.tree_map(jnp.asarray, slots)
+        o._resume_slots = slots
+        o.optimize()
+        for leaf in jax.tree_util.tree_leaves(slots):
+            np.asarray(leaf)  # raises "Array has been deleted" on a break
+
+    def test_model_restored_after_midrun_failure(self):
+        """A failed run must leave the model holding LIVE params (the
+        pre-run snapshot), not the donated-dead buffers."""
+        X, Y = self._data()
+        m = (nn.Sequential().add(nn.Linear(8, 8)).add(nn.Tanh())
+             .add(nn.Linear(8, 3)).add(nn.LogSoftMax()))
+        before = jax.tree_util.tree_map(np.asarray, m.ensure_params())
+        from bigdl_tpu.optim.local_optimizer import LocalOptimizer
+        from bigdl_tpu.dataset.dataset import LocalDataSet
+        from bigdl_tpu.dataset.sample import MiniBatch
+        batches = [MiniBatch(X[i * 16:(i + 1) * 16],
+                             Y[i * 16:(i + 1) * 16]) for i in range(4)]
+        o = LocalOptimizer(m, LocalDataSet(batches),
+                           nn.ClassNLLCriterion(), 16)
+        o.set_optim_method(optim.SGD(learning_rate=0.1))
+        o.set_end_when(optim.max_iteration(8))
+
+        def hook(state):
+            if state["neval"] == 3:
+                raise RuntimeError("injected mid-run failure")
+
+        o.set_iteration_hook(hook)
+        with pytest.raises(RuntimeError, match="injected"):
+            o.optimize()
+        after = m.ensure_params()
+        jax.tree_util.tree_map(
+            lambda a, b: np.testing.assert_array_equal(a, np.asarray(b)),
+            before, after)
+        # and the instance trains fine afterwards
+        o2 = LocalOptimizer(m, LocalDataSet(batches),
+                            nn.ClassNLLCriterion(), 16)
+        o2.set_optim_method(optim.SGD(learning_rate=0.1))
+        o2.set_end_when(optim.max_iteration(2))
+        o2.optimize()
+
+    def test_stale_snapshot_never_reverts_a_trained_model(self):
+        """A failure EARLY in a second optimize() (before the new run
+        snapshots) must not restore the FIRST run's pre-training params
+        — the stale-snapshot regression found in review."""
+        X, Y = self._data()
+        from bigdl_tpu.optim.local_optimizer import LocalOptimizer
+        from bigdl_tpu.dataset.dataset import LocalDataSet
+        from bigdl_tpu.dataset.sample import MiniBatch
+        batches = [MiniBatch(X[i * 16:(i + 1) * 16],
+                             Y[i * 16:(i + 1) * 16]) for i in range(4)]
+        m = (nn.Sequential().add(nn.Linear(8, 8)).add(nn.Tanh())
+             .add(nn.Linear(8, 3)).add(nn.LogSoftMax()))
+        o = LocalOptimizer(m, LocalDataSet(batches),
+                           nn.ClassNLLCriterion(), 16)
+        o.set_optim_method(optim.SGD(learning_rate=0.1))
+        o.set_end_when(optim.max_iteration(4))
+        o.optimize()  # run 1 succeeds; model now holds trained params
+        trained = jax.tree_util.tree_map(np.asarray, m.ensure_params())
+
+        class Boom:
+            def __call__(self, *a, **k):
+                raise RuntimeError("fails before the run-2 snapshot")
+        o2 = LocalOptimizer(m, LocalDataSet(batches),
+                            nn.ClassNLLCriterion(), 16)
+        o2.set_optim_method(optim.SGD(learning_rate=0.1))
+        o2.set_end_when(optim.max_iteration(2))
+        o2._pristine_params = jax.tree_util.tree_map(
+            np.zeros_like, trained)  # simulate a stale leftover snapshot
+        o2._pristine_state = {}
+        o2._maybe_optimize_graph = Boom()
+        with pytest.raises(RuntimeError, match="before the run-2"):
+            o2.optimize()
+        after = m.ensure_params()
+        jax.tree_util.tree_map(
+            lambda a, b: np.testing.assert_array_equal(a, np.asarray(b)),
+            trained, after)
+
+    def test_bf16_params_get_f32_masters_and_move(self):
+        """bf16-resident weights: lr*grad below bf16's ulp must still
+        accumulate through the fp32 masters (a masterless bf16 update
+        rounds to a no-op), slots must be f32, and the returned params
+        must stay bf16."""
+        method = optim.SGD(learning_rate=1.0)
+        p = {"w": jnp.ones((4,), jnp.bfloat16)}
+        g = {"w": jnp.full((4,), 1e-3, jnp.bfloat16)}
+        st = method.init_state_with_masters(p)
+        assert optim.OptimMethod._MASTER_KEY in st
+        masters = st[optim.OptimMethod._MASTER_KEY]
+        assert masters["w"].dtype == jnp.float32
+        p2, st2 = p, st
+        for _ in range(100):
+            p2, st2 = method.update_with_masters(g, st2, p2, 0.001)
+        assert p2["w"].dtype == jnp.bfloat16
+        # 100 steps of 1e-6: masters accumulate 1e-4 exactly; a bare
+        # bf16 update would have stayed at 1.0 every step
+        np.testing.assert_allclose(
+            np.asarray(st2[optim.OptimMethod._MASTER_KEY]["w"],
+                       np.float32), 1.0 - 1e-4, rtol=1e-5)
+        bare = p["w"]
+        for _ in range(3):
+            bare2, _ = method.update(g, {}, {"w": bare}, 0.001)
+            bare = bare2["w"]
+        np.testing.assert_array_equal(np.asarray(bare, np.float32),
+                                      np.ones(4, np.float32))
+
+    def test_f32_params_opt_state_structure_unchanged(self):
+        """No masters for f32 trees: init_state_with_masters must return
+        the method's own structure (old checkpoints keep loading)."""
+        method = optim.Adam(learning_rate=1e-3)
+        p = {"w": jnp.ones((4,), jnp.float32)}
+        st = method.init_state_with_masters(p)
+        assert set(st) == {"m", "v", "t"}
+        p2, st2 = method.update_with_masters(
+            {"w": jnp.ones((4,))}, st, p, 1e-3)
+        assert set(st2) == {"m", "v", "t"}
+
+    def test_bf16_training_through_local_loop(self):
+        """End-to-end: a bf16-weight model trains through the donated
+        LocalOptimizer step with masters in the opt_state and makes
+        progress."""
+        X, Y = self._data()
+        m = (nn.Sequential().add(nn.Linear(8, 16)).add(nn.Tanh())
+             .add(nn.Linear(16, 3)).add(nn.LogSoftMax()))
+        p32 = m.ensure_params()
+        m.set_params(jax.tree_util.tree_map(
+            lambda l: l.astype(jnp.bfloat16), p32))
+        o = optim.Optimizer(m, (X, Y), nn.ClassNLLCriterion(),
+                            batch_size=16, local=True)
+        o.set_optim_method(optim.SGD(learning_rate=0.1, momentum=0.9))
+        o.set_end_when(optim.max_iteration(32))
+        losses = []
+        o.set_iteration_hook(lambda s: losses.append(s["loss"]))
+        o.optimize()
+        for leaf in jax.tree_util.tree_leaves(m.ensure_params()):
+            assert leaf.dtype == jnp.bfloat16
+        # robust progress check on a tiny noisy problem: the tail window
+        # must sit below the head window
+        assert np.mean(losses[-8:]) < np.mean(losses[:4])
+
+
+class TestBucketedGradientExchange:
+    """Size-bucketed comm/compute-overlapped exchange (optim/bucketing.py
+    + DistriOptimizer.set_gradient_bucketing): plan invariants, bitwise
+    parity with the barrier combine, and the compile-budget contract."""
+
+    def test_plan_reverse_topological_and_bounded(self):
+        p = {"a": jnp.zeros((100,)), "b": jnp.zeros((200,)),
+             "c": jnp.zeros((50,))}
+        plan = optim.GradientBucketPlan(p, bucket_bytes=1024)
+        flat_order = [i for b in plan.buckets for i in b]
+        assert flat_order == list(range(plan.n_leaves))[::-1]
+        for b in plan.buckets[:-1]:
+            pass  # greedy fill: every bucket except possibly a single
+        # oversized leaf stays under the bound
+        sizes = [sum(100 * 4 if i == 0 else 200 * 4 if i == 1 else 50 * 4
+                     for i in b) for b in plan.buckets]
+        assert all(s <= 1024 or len(b) == 1
+                   for s, b in zip(sizes, plan.buckets))
+
+    def test_split_join_roundtrip(self):
+        rs = np.random.RandomState(0)
+        p = {"a": jnp.asarray(rs.rand(17)), "b": jnp.asarray(rs.rand(3, 5)),
+             "c": {"d": jnp.asarray(rs.rand(9))}}
+        plan = optim.GradientBucketPlan(p, bucket_bytes=64)
+        back = plan.join(plan.split(p))
+        jax.tree_util.tree_map(
+            lambda x, y: np.testing.assert_array_equal(np.asarray(x),
+                                                       np.asarray(y)),
+            p, back)
+
+    @pytest.mark.skipif(jax.device_count() < 2, reason="needs >=2 devices")
+    def test_elastic_bucketed_bitwise_equals_barrier(self):
+        """The elastic determinism contract with bucketing on: bucketed
+        and barrier exchanges accumulate shards in the same fixed order,
+        so the trained params must be BIT-identical — and the accumulate
+        compile budget is one executable per bucket layout."""
+        from bigdl_tpu.dataset.dataset import LocalDataSet
+        from bigdl_tpu.dataset.sample import Sample
+        from bigdl_tpu.dataset.transformer import SampleToMiniBatch as S2M
+        from bigdl_tpu.observability import InMemorySink, Telemetry
+        from bigdl_tpu.optim.distri_optimizer import DistriOptimizer
+        from bigdl_tpu.parallel.mesh import build_mesh
+
+        rs = np.random.RandomState(0)
+        samples = [Sample(rs.rand(12).astype(np.float32),
+                          np.int32(rs.randint(0, 3) + 1))
+                   for _ in range(128)]
+
+        def run(bucketed):
+            model = (nn.Sequential().add(nn.Linear(12, 16)).add(nn.Tanh())
+                     .add(nn.Linear(16, 3)).add(nn.LogSoftMax()))
+            model.ensure_params(jax.random.PRNGKey(0))
+            ds = LocalDataSet(list(samples)).transform(
+                S2M(32, drop_remainder=True))
+            sink = InMemorySink()
+            tel = Telemetry(sink, resources=False)
+            o = DistriOptimizer(model, ds, nn.ClassNLLCriterion(),
+                                mesh=build_mesh(data=2, model=1,
+                                                devices=jax.devices()[:2]),
+                                retry_times=0)
+            o.set_optim_method(optim.SGD(learning_rate=0.05, momentum=0.9))
+            o.set_end_when(optim.max_iteration(6))
+            o.set_sync_interval(2)
+            o.set_elastic()
+            o.set_telemetry(tel)
+            if bucketed:
+                o.set_gradient_bucketing(bucket_mb=0.0001)  # many buckets
+            o.optimize()
+            tel.close()
+            return model.parameters(), sink
+
+        pb, sb = run(True)
+        ps, _ = run(False)
+        for a, b in zip(jax.tree_util.tree_leaves(pb),
+                        jax.tree_util.tree_leaves(ps)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        plan_ev = next(r for r in sb.records
+                       if r.get("event") == "bucket_plan")
+        compiles = [r for r in sb.records if r.get("type") == "compile"
+                    and r.get("label") == "distri.bucket_add"]
+        # one compile per layout — 6 steps x 2 shards must NOT grow it
+        assert len(compiles) == plan_ev["n_layouts"]
+        assert plan_ev["n_buckets"] >= 2
+
+    def test_bucketing_rejects_bad_size_and_disarms(self):
+        from bigdl_tpu.optim.distri_optimizer import DistriOptimizer
+        from bigdl_tpu.dataset.dataset import LocalDataSet
+        m = nn.Sequential().add(nn.Linear(2, 2))
+        o = DistriOptimizer(m, LocalDataSet([]), nn.MSECriterion())
+        with pytest.raises(ValueError):
+            o.set_gradient_bucketing(bucket_mb=0)
+        o.set_gradient_bucketing(bucket_mb=1.0)
+        assert o._bucketing is not None
+        o.set_gradient_bucketing(enabled=False)
+        assert o._bucketing is None
