@@ -1,0 +1,46 @@
+"""Project-specific static analysis: the invariant classes this repo's
+hardest bugs violated, machine-checked on every PR.
+
+The suite runs over the package's own source with stdlib `ast` (plus a
+small executed layer for the tile-picker invariants) — no third-party
+deps, importable before jax. Checkers:
+
+- `donation`    — use-after-donate / self-aliased donated args
+                  (the PR 15 resume-slot bug class)
+- `locks`       — guarded-field reads/writes outside the lock in
+                  serving/ and resilience/ (the PR 13 bug class)
+- `recompile`   — compile-storm-shaped call sites in the hot paths
+- `telemetry`   — emit-site record literals vs RECORD_SCHEMAS
+- `fault-sites` — `fire()`/`FaultSpec` literals vs the site registry
+- `tiling`      — Pallas block shapes vs the Mosaic tile discipline
+
+Front-end: `python -m bigdl_tpu.tools.lint_cli check` (docs/analysis.md
+covers the baseline/ratchet workflow and the escape-hatch convention).
+"""
+
+from bigdl_tpu.analysis.core import (Checker, Finding, apply_baseline,
+                                     default_baseline_path,
+                                     iter_source_files, load_baseline,
+                                     repo_root, run_checkers,
+                                     save_baseline)
+from bigdl_tpu.analysis.donation import DonationChecker
+from bigdl_tpu.analysis.fault_sites import FaultSiteChecker
+from bigdl_tpu.analysis.locks import LockChecker
+from bigdl_tpu.analysis.recompile import RecompileChecker
+from bigdl_tpu.analysis.telemetry_schema import TelemetryChecker
+from bigdl_tpu.analysis.tiling import TilingChecker
+
+
+def default_checkers():
+    """One fresh instance of every checker, in suite order."""
+    return [DonationChecker(), LockChecker(), RecompileChecker(),
+            TelemetryChecker(), FaultSiteChecker(), TilingChecker()]
+
+
+__all__ = [
+    "Checker", "Finding", "DonationChecker", "LockChecker",
+    "RecompileChecker", "TelemetryChecker", "FaultSiteChecker",
+    "TilingChecker", "default_checkers", "run_checkers",
+    "iter_source_files", "load_baseline", "save_baseline",
+    "apply_baseline", "default_baseline_path", "repo_root",
+]

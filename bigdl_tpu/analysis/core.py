@@ -1,0 +1,333 @@
+"""Shared machinery for the project-specific static checkers.
+
+The repo's hardest bugs are invariant violations, not algorithm errors:
+a donated buffer read after the call that killed it (PR 15's resume-slot
+bug), a guarded field touched outside its lock (PR 13's fleet races), a
+jitted hot path recompiling per iteration. Each of those classes now has
+a checker (`bigdl_tpu.analysis.*`); this module holds what they share:
+
+- `Finding` — one diagnostic: checker id, file:line, message, fix hint,
+  and a stable `key` used by the baseline (keyed on the *source text* of
+  the flagged line, not its line number, so unrelated edits above a
+  finding don't churn the baseline).
+- `SourceFile` — a parsed module: ast tree, raw lines, and the parsed
+  escape-hatch comments (`# lint: <token>(reason)`).
+- `Checker` — the three-phase protocol (`begin` over all files for
+  cross-file registries, `check` per file, `finalize`).
+- baseline I/O — `load_baseline` / `save_baseline` / `apply_baseline`:
+  the committed `analysis/baseline.json` suppresses accepted findings so
+  the CI gate ratchets (new findings fail; old ones are documented with
+  a reason string, never silently).
+
+Escape-hatch convention (docs/analysis.md): a finding is suppressed when
+its line — or the line directly above it — carries a comment
+
+    # lint: unguarded-ok(reason)          lock-discipline checker
+    # lint: <checker-id>-ok(reason)       any checker, by id
+
+The reason is mandatory: an escape hatch without one is itself reported
+(`escape-hatch-missing-reason`). Everything here is stdlib-only (`ast`,
+`json`, `re`) — the linter must run before the heavy imports it lints.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+import re
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+#: canonical repo-relative form of a path, for finding keys and output
+def relpath(path: str, root: Optional[str] = None) -> str:
+    root = root or repo_root()
+    try:
+        rel = os.path.relpath(os.path.abspath(path), root)
+    except ValueError:  # different drive (windows) — keep absolute
+        return path.replace(os.sep, "/")
+    return rel.replace(os.sep, "/")
+
+
+def repo_root() -> str:
+    """The directory holding the `bigdl_tpu` package (= the repo root in
+    a checkout, the site-packages parent in an install)."""
+    return os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+
+class Finding:
+    """One checker diagnostic, carrying everything the CLI and the
+    baseline need: `checker` (id), `rule` (sub-rule id), `path`/`line`,
+    a one-line `message`, and a one-line fix `hint`."""
+
+    __slots__ = ("checker", "rule", "path", "line", "message", "hint",
+                 "_key")
+
+    def __init__(self, checker: str, rule: str, path: str, line: int,
+                 message: str, hint: str = "", key: Optional[str] = None):
+        self.checker = checker
+        self.rule = rule
+        self.path = relpath(path)
+        self.line = line
+        self.message = message
+        self.hint = hint
+        self._key = key
+
+    @property
+    def key(self) -> str:
+        """Baseline identity: checker + file + the flagged line's source
+        text (whitespace-collapsed). Line-number independent, so edits
+        elsewhere in the file don't invalidate baseline entries."""
+        return self._key or f"{self.checker}:{self.path}:{self.rule}"
+
+    def as_dict(self) -> Dict:
+        return {"checker": self.checker, "rule": self.rule,
+                "path": self.path, "line": self.line,
+                "message": self.message, "hint": self.hint,
+                "key": self.key}
+
+    def text(self) -> str:
+        loc = f"{self.path}:{self.line}"
+        out = f"{loc}: [{self.checker}/{self.rule}] {self.message}"
+        if self.hint:
+            out += f"\n    hint: {self.hint}"
+        return out
+
+    def __repr__(self):
+        return f"Finding({self.checker}/{self.rule} @ {self.path}:{self.line})"
+
+
+#: `# lint: token(reason)` — token is e.g. `unguarded-ok` or
+#: `donation-ok`; reason is free text (may itself hold parens as long as
+#: the comment's last `)` closes the hatch)
+_HATCH = re.compile(r"#\s*lint:\s*([a-z0-9-]+)\s*(?:\(\s*(.*?)\s*\))?\s*$")
+
+
+class SourceFile:
+    """A parsed source module plus the line-level lint metadata the
+    checkers share: `tree` (ast; None for non-Python files), `lines`
+    (raw), and `hatches` (line -> (token, reason) escape-hatch comments,
+    covering the comment's own line AND the next line so a hatch can sit
+    above a long statement)."""
+
+    def __init__(self, path: str, text: Optional[str] = None):
+        self.path = path
+        self.rel = relpath(path)
+        if text is None:
+            with open(path, encoding="utf-8") as f:
+                text = f.read()
+        self.text = text
+        self.lines = text.splitlines()
+        self.tree: Optional[ast.Module] = None
+        self.parse_error: Optional[SyntaxError] = None
+        try:
+            self.tree = ast.parse(text, filename=path)
+        except SyntaxError as e:
+            self.parse_error = e
+        self.hatches: Dict[int, Tuple[str, str]] = {}
+        for i, raw in enumerate(self.lines, 1):
+            m = _HATCH.search(raw)
+            if m:
+                self.hatches[i] = (m.group(1), m.group(2) or "")
+
+    def line_text(self, lineno: int) -> str:
+        if 1 <= lineno <= len(self.lines):
+            return self.lines[lineno - 1].strip()
+        return ""
+
+    def hatch_for(self, lineno: int, tokens: Sequence[str]
+                  ) -> Optional[Tuple[str, str]]:
+        """The escape hatch covering `lineno` for any of `tokens`: the
+        line itself, or a standalone hatch comment on the line above."""
+        for ln in (lineno, lineno - 1):
+            h = self.hatches.get(ln)
+            if h and h[0] in tokens:
+                if ln == lineno - 1 and \
+                        not self.line_text(ln).startswith("#"):
+                    continue  # previous line is code: its hatch is ITS
+                return h
+        return None
+
+    def finding_key(self, checker: str, lineno: int, occurrence: int = 0
+                    ) -> str:
+        """Stable baseline key: checker + file + collapsed source text of
+        the flagged line (+ a disambiguating occurrence index when the
+        same text is flagged more than once in one file)."""
+        code = re.sub(r"\s+", " ", self.line_text(lineno))
+        key = f"{checker}:{self.rel}:{code}"
+        if occurrence:
+            key += f"#{occurrence}"
+        return key
+
+
+class Checker:
+    """Base class: override `id`, `check`; optionally `begin` (sees every
+    file first — build cross-file registries there) and `finalize`
+    (emit findings that needed the whole tree)."""
+
+    id = "checker"
+    #: escape-hatch tokens this checker honors (besides `<id>-ok`)
+    hatch_tokens: Tuple[str, ...] = ()
+
+    def begin(self, files: Sequence[SourceFile]):
+        pass
+
+    def check(self, src: SourceFile) -> List[Finding]:
+        raise NotImplementedError
+
+    def finalize(self) -> List[Finding]:
+        return []
+
+    # ------------------------------------------------------------ helpers
+    def _tokens(self) -> Tuple[str, ...]:
+        return (f"{self.id}-ok",) + tuple(self.hatch_tokens)
+
+    def make_findings(self, src: SourceFile, raw: Iterable[Tuple]
+                      ) -> List[Finding]:
+        """Turn (rule, lineno, message, hint) tuples into `Finding`s,
+        applying escape hatches and occurrence-indexed keys. A hatch with
+        an empty reason becomes its own finding — silent suppressions
+        are the thing this suite exists to kill."""
+        out: List[Finding] = []
+        seen: Dict[str, int] = {}
+        for rule, lineno, message, hint in raw:
+            hatch = src.hatch_for(lineno, self._tokens())
+            if hatch is not None:
+                if not hatch[1]:
+                    out.append(Finding(
+                        self.id, "escape-hatch-missing-reason", src.path,
+                        lineno,
+                        f"escape hatch '{hatch[0]}' suppresses a finding "
+                        f"without a reason",
+                        "write `# lint: %s(why this is safe)`" % hatch[0],
+                        key=src.finding_key(self.id, lineno)))
+                continue
+            base = src.finding_key(self.id, lineno)
+            n = seen.get(base, 0)
+            seen[base] = n + 1
+            out.append(Finding(self.id, rule, src.path, lineno, message,
+                               hint, key=src.finding_key(self.id, lineno,
+                                                         n)))
+        return out
+
+
+# ---------------------------------------------------------------------- #
+# suite driver
+# ---------------------------------------------------------------------- #
+
+_SKIP_DIRS = {"__pycache__", ".git", "build", "dist", ".claude",
+              "node_modules", "proto"}  # proto: generated *_pb2 files
+
+
+def iter_source_files(paths: Sequence[str]) -> List[str]:
+    """Expand files/directories into the sorted list of `.py` files the
+    suite runs over (generated protos and caches skipped)."""
+    out: List[str] = []
+    for p in paths:
+        if os.path.isfile(p):
+            out.append(p)
+            continue
+        for dirpath, dirnames, filenames in os.walk(p):
+            dirnames[:] = sorted(d for d in dirnames
+                                 if d not in _SKIP_DIRS)
+            for fn in sorted(filenames):
+                if fn.endswith(".py") and not fn.endswith("_pb2.py"):
+                    out.append(os.path.join(dirpath, fn))
+    # dedup, keep deterministic order
+    seen = set()
+    uniq = []
+    for p in out:
+        ap = os.path.abspath(p)
+        if ap not in seen:
+            seen.add(ap)
+            uniq.append(p)
+    return uniq
+
+
+def run_checkers(paths: Sequence[str], checkers: Sequence[Checker]
+                 ) -> List[Finding]:
+    """Run the three-phase suite over `paths`; returns every finding
+    (baseline NOT applied — that's `apply_baseline`). A file that fails
+    to parse yields one `parse-error` finding instead of crashing the
+    suite."""
+    files = []
+    findings: List[Finding] = []
+    for path in iter_source_files(paths):
+        src = SourceFile(path)
+        if src.parse_error is not None:
+            e = src.parse_error
+            findings.append(Finding(
+                "core", "parse-error", path, e.lineno or 1,
+                f"cannot parse: {e.msg}", "fix the syntax error",
+                key=f"core:{relpath(path)}:parse-error"))
+            continue
+        files.append(src)
+    for c in checkers:
+        c.begin(files)
+    for src in files:
+        for c in checkers:
+            findings.extend(c.check(src))
+    for c in checkers:
+        findings.extend(c.finalize())
+    findings.sort(key=lambda f: (f.path, f.line, f.checker, f.rule))
+    return findings
+
+
+# ---------------------------------------------------------------------- #
+# baseline (the ratchet)
+# ---------------------------------------------------------------------- #
+
+def default_baseline_path() -> str:
+    return os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "baseline.json")
+
+
+def load_baseline(path: str) -> Dict[str, str]:
+    """`{finding key: reason}` from a baseline file; empty when the file
+    does not exist. Raises ValueError on a malformed file (a broken
+    baseline must not silently approve everything)."""
+    if not os.path.exists(path):
+        return {}
+    with open(path, encoding="utf-8") as f:
+        data = json.load(f)
+    if not isinstance(data, dict) or "findings" not in data \
+            or not isinstance(data["findings"], list):
+        raise ValueError(f"{path}: baseline must be "
+                         '{"version": 1, "findings": [...]}')
+    out: Dict[str, str] = {}
+    for entry in data["findings"]:
+        if not isinstance(entry, dict) or "key" not in entry:
+            raise ValueError(f"{path}: baseline entry {entry!r} has no "
+                             f"'key'")
+        if not entry.get("reason"):
+            raise ValueError(
+                f"{path}: baseline entry {entry['key']!r} has no reason "
+                f"— accepted findings are documented, never silent")
+        out[entry["key"]] = entry["reason"]
+    return out
+
+
+def save_baseline(path: str, findings: Sequence[Finding],
+                  reason: str = "accepted pre-existing finding"):
+    """Write `findings` as a fresh baseline (each entry carries `reason`
+    — edit per-entry reasons in place afterwards; `load_baseline`
+    rejects empty ones)."""
+    entries = [{"key": f.key, "reason": reason,
+                "location": f"{f.path}:{f.line}",
+                "rule": f"{f.checker}/{f.rule}"}
+               for f in findings]
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump({"version": 1, "findings": entries}, f, indent=2,
+                  sort_keys=False)
+        f.write("\n")
+
+
+def apply_baseline(findings: Sequence[Finding], baseline: Dict[str, str]
+                   ) -> Tuple[List[Finding], List[str]]:
+    """Split findings into (new, unused-baseline-keys): `new` is what
+    fails CI; unused keys are reported so the baseline ratchets DOWN as
+    fixes land (a stale entry is a fixed bug still being excused)."""
+    new = [f for f in findings if f.key not in baseline]
+    used = {f.key for f in findings if f.key in baseline}
+    unused = sorted(k for k in baseline if k not in used)
+    return new, unused

@@ -1,0 +1,266 @@
+"""Donation-safety checker (`donation`).
+
+`jax.jit(fn, donate_argnums=...)` / `CompiledFunction(...,
+donate_argnums=...)` alias the donated arguments' buffers into the
+outputs: after the call, the Python bindings that held those arguments
+point at DELETED device arrays. Reading one is the PR 15 resume-slot bug
+class — "Array has been deleted", or worse, silently stale state on the
+paths that catch it.
+
+Two rules, both over plain `ast` (no tracing):
+
+- `use-after-donate` — inside one function: a local name passed in a
+  donated position of a known-donating callable is READ again after the
+  call without an intervening rebind. The idiomatic loop
+  `params, opt = step(params, opt, ...)` is safe (the call's own
+  assignment rebinds the names); `step(params, ...); loss2 = f(params)`
+  is the bug.
+- `self-alias` — a bare `self.<attr>` expression passed in a donated
+  position while the same statement does NOT rebind that attribute: the
+  instance retains a field aliasing a dead buffer (exactly how the
+  orbax-restored `_resume_slots` died in PR 15 — the fix is to copy with
+  `jnp.array(...)` or rebind the attr from the call's result).
+
+Donating callables are discovered per module: local variables and
+`self.<attr>` fields assigned from `jax.jit(..., donate_argnums=...)` or
+`CompiledFunction(..., donate_argnums=...)` anywhere in the same class
+(methods commonly build in `_build_step` and call in `optimize`).
+`donate_argnums` must be a literal int/tuple to be tracked — dynamic
+values are skipped, not guessed.
+
+Escape hatch: `# lint: donation-ok(reason)`.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from bigdl_tpu.analysis.core import Checker, Finding, SourceFile
+
+#: constructor names treated as "jit-like with donate_argnums"
+_DONATING_FACTORIES = {"jit", "CompiledFunction", "pjit"}
+
+
+def call_name(node: ast.AST) -> Optional[str]:
+    """Trailing identifier of a call target: `jax.jit` -> 'jit',
+    `CompiledFunction` -> 'CompiledFunction'."""
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+def literal_argnums(node: ast.AST) -> Optional[Tuple[int, ...]]:
+    """A literal `donate_argnums` value: int or tuple/list of ints."""
+    try:
+        val = ast.literal_eval(node)
+    except (ValueError, SyntaxError):
+        return None
+    if isinstance(val, int) and not isinstance(val, bool):
+        return (val,)
+    if isinstance(val, (tuple, list)) and all(
+            isinstance(v, int) and not isinstance(v, bool) for v in val):
+        return tuple(val)
+    return None
+
+
+def donating_call(node: ast.Call) -> Optional[Tuple[int, ...]]:
+    """If `node` constructs a donating callable, its donated positions
+    (empty donate_argnums counts as non-donating)."""
+    if call_name(node.func) not in _DONATING_FACTORIES:
+        return None
+    for kw in node.keywords:
+        if kw.arg in ("donate_argnums", "donate"):
+            nums = literal_argnums(kw.value)
+            if nums:
+                return nums
+    return None
+
+
+def self_attr(node: ast.AST) -> Optional[str]:
+    """'attr' when node is `self.attr`, else None."""
+    if isinstance(node, ast.Attribute) and \
+            isinstance(node.value, ast.Name) and node.value.id == "self":
+        return node.attr
+    return None
+
+
+class _ClassBindings(ast.NodeVisitor):
+    """Collect `self.X = <donating call>` across a class body."""
+
+    def __init__(self):
+        self.attrs: Dict[str, Tuple[int, ...]] = {}
+
+    def visit_Assign(self, node: ast.Assign):
+        val = node.value
+        if isinstance(val, ast.Call):
+            nums = donating_call(val)
+            if nums:
+                for t in node.targets:
+                    # chained `step = self._step_fn = jax.jit(...)` binds
+                    # both the local and the field
+                    attr = self_attr(t)
+                    if attr:
+                        self.attrs[attr] = nums
+        self.generic_visit(node)
+
+
+class _FunctionScan:
+    """Per-function donation analysis."""
+
+    def __init__(self, fn: ast.AST, class_attrs: Dict[str, Tuple[int, ...]]):
+        self.fn = fn
+        self.class_attrs = class_attrs
+        # local name -> donated positions (assigned inside this function)
+        self.local: Dict[str, Tuple[int, ...]] = {}
+        self.raw: List[Tuple[str, int, str, str]] = []
+
+    # -------------------------------------------------- name-event stream
+    def _events(self) -> List[Tuple[int, int, str, str]]:
+        """(lineno, col, kind, name) for every Name load/store in the
+        function, in source order. Nested defs/lambdas are included —
+        a closure reading a donated name after the call is still a
+        read (conservative; hatch out false positives)."""
+        ev = []
+        for node in ast.walk(self.fn):
+            if isinstance(node, ast.Name):
+                kind = "load" if isinstance(node.ctx, ast.Load) else "store"
+                ev.append((node.lineno, node.col_offset, kind, node.id))
+        ev.sort()
+        return ev
+
+    def scan(self) -> List[Tuple[str, int, str, str]]:
+        body = self.fn.body
+        # pass 1: local donating bindings anywhere in the function
+        for node in ast.walk(self.fn):
+            if isinstance(node, ast.Assign) and \
+                    isinstance(node.value, ast.Call):
+                nums = donating_call(node.value)
+                if nums:
+                    for t in node.targets:
+                        if isinstance(t, ast.Name):
+                            self.local[t.id] = nums
+        # pass 2: call sites of donating callables
+        events = self._events()
+        for stmt in ast.walk(self.fn):
+            if not isinstance(stmt, (ast.Assign, ast.Expr, ast.AugAssign,
+                                     ast.Return, ast.AnnAssign)):
+                continue
+            val = getattr(stmt, "value", None)
+            if not isinstance(val, ast.Call):
+                continue
+            nums = self._donated_positions(val)
+            if nums is None:
+                continue
+            rebound_names, rebound_attrs = self._stmt_targets(stmt)
+            for pos in nums:
+                if pos >= len(val.args):
+                    continue
+                arg = val.args[pos]
+                name = arg.id if isinstance(arg, ast.Name) else None
+                attr = self_attr(arg)
+                if name is not None:
+                    if name in rebound_names:
+                        continue  # params, _ = step(params, ...) idiom
+                    self._check_use_after(name, stmt, events)
+                elif attr is not None:
+                    if attr in rebound_attrs:
+                        continue  # self.c, t = fn(self.c) rebinds the field
+                    self.raw.append((
+                        "self-alias", arg.lineno,
+                        f"`self.{attr}` is passed in donated position "
+                        f"{pos} of `{call_name(val.func)}` but the "
+                        f"attribute still references the (now deleted) "
+                        f"buffer after the call",
+                        f"copy before donating (jnp.array(self.{attr})) "
+                        f"or rebind self.{attr} from the call's result "
+                        f"in the same statement"))
+        return self.raw
+
+    def _donated_positions(self, call: ast.Call
+                           ) -> Optional[Tuple[int, ...]]:
+        """Donated arg positions when `call` invokes a known donating
+        binding (`step(...)` / `self._decode_fn(...)`)."""
+        name = None
+        if isinstance(call.func, ast.Name):
+            name = self.local.get(call.func.id)
+        else:
+            attr = self_attr(call.func)
+            if attr is not None:
+                name = self.class_attrs.get(attr) or self.local.get(attr)
+        return name
+
+    @staticmethod
+    def _stmt_targets(stmt: ast.stmt) -> Tuple[Set[str], Set[str]]:
+        """Names / self-attrs rebound by the statement holding the call
+        (evaluated AFTER the call: `a, b = step(a, b)` is donation-safe)."""
+        names: Set[str] = set()
+        attrs: Set[str] = set()
+        targets = []
+        if isinstance(stmt, ast.Assign):
+            targets = stmt.targets
+        elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+            targets = [stmt.target]
+        for t in targets:
+            for node in ast.walk(t):
+                if isinstance(node, ast.Name):
+                    names.add(node.id)
+                else:
+                    attr = self_attr(node)
+                    if attr:
+                        attrs.add(attr)
+        return names, attrs
+
+    def _check_use_after(self, name: str, stmt: ast.stmt,
+                         events: List[Tuple[int, int, str, str]]):
+        """First event for `name` strictly after the donating statement:
+        a load before any store is a use-after-donate."""
+        end = (getattr(stmt, "end_lineno", stmt.lineno) or stmt.lineno,
+               1 << 30)
+        for lineno, col, kind, nm in events:
+            if nm != name or (lineno, col) <= end:
+                continue
+            if kind == "store":
+                return  # rebound before any read
+            self.raw.append((
+                "use-after-donate", lineno,
+                f"`{name}` was donated at line {stmt.lineno} and is read "
+                f"here — its buffer was deleted by the donating call",
+                f"rebind `{name}` from the call's outputs (or copy with "
+                f"jnp.array before donating)"))
+            return
+
+
+class DonationChecker(Checker):
+    """Flags reads of donated bindings after the jitted call that deleted
+    their buffers, and donated args aliasing fields retained on `self` (the
+    PR 15 resume-slot bug class). Details: module docstring."""
+
+    id = "donation"
+
+    def check(self, src: SourceFile) -> List[Finding]:
+        raw: List[Tuple[str, int, str, str]] = []
+        tree = src.tree
+        # class attr bindings first (cross-method build/call split)
+        class_maps: Dict[ast.ClassDef, Dict[str, Tuple[int, ...]]] = {}
+        for node in ast.walk(tree):
+            if isinstance(node, ast.ClassDef):
+                cb = _ClassBindings()
+                cb.visit(node)
+                class_maps[node] = cb.attrs
+
+        def scan_functions(scope, class_attrs):
+            # NOT recursing into nested defs: _FunctionScan walks the
+            # whole function including closures, so a nested def is
+            # covered by its parent's scan (recursing would double-report)
+            for node in scope.body if hasattr(scope, "body") else []:
+                if isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                    raw.extend(_FunctionScan(node, class_attrs).scan())
+                elif isinstance(node, ast.ClassDef):
+                    scan_functions(node, class_maps.get(node, {}))
+
+        scan_functions(tree, {})
+        return self.make_findings(src, raw)

@@ -1,0 +1,141 @@
+"""Fault-site resolution checker (`fault-sites`).
+
+`FaultSpec` fails fast on a typo'd site at plan-build time (PR 10), but
+a typo'd `fire("...")` call in framework code still ships silently — it
+just never fires, and the chaos coverage it was supposed to provide
+evaporates. This checker closes the loop statically: every site literal
+at a `fire(...)` / `FaultSpec(...)` / `register_site` *reference* must
+resolve against
+
+    KNOWN_SITES  ∪  every `register_site("...")` literal found in-tree
+
+with the registry collected in `begin()` across ALL linted files (the
+fleet registers `serve.replica_crash` in serving/fleet.py; a
+`fire("serve.replica_crash")` in another module must resolve). Module
+constants assigned from `register_site` (`SITE_ROUTE =
+faults.register_site("serve.route")`) resolve by name, including via
+`from x import SITE_ROUTE`-style use in the same package (matched by
+constant name, conservatively global). Dynamic site expressions are
+skipped — the runtime registry owns those.
+
+Rules: `unknown-site` (with a closest-match hint), `bad-site-format`
+(a registered literal without the `<subsystem>.<event>` shape).
+
+Escape hatch: `# lint: fault-sites-ok(reason)`.
+"""
+
+from __future__ import annotations
+
+import ast
+import difflib
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from bigdl_tpu.analysis.core import Checker, Finding, SourceFile
+from bigdl_tpu.analysis.donation import call_name
+from bigdl_tpu.analysis.telemetry_schema import _literal_str
+
+
+def _known_sites() -> Set[str]:
+    from bigdl_tpu.resilience.faults import KNOWN_SITES
+    return set(KNOWN_SITES)
+
+
+class FaultSiteChecker(Checker):
+    """Resolves every `fire(...)`/`FaultSpec` site literal against
+    KNOWN_SITES plus all in-tree `register_site()` calls — site typos
+    become lint errors, not dead chaos coverage. Details: module docstring."""
+
+    id = "fault-sites"
+
+    def __init__(self, known: Optional[Set[str]] = None):
+        self._base = known
+        self.registered: Set[str] = set()
+        self.constants: Dict[str, str] = {}  # NAME -> site literal
+
+    @property
+    def base_sites(self) -> Set[str]:
+        if self._base is None:
+            self._base = _known_sites()
+        return self._base
+
+    # ------------------------------------------------------------- phase 1
+    def begin(self, files: Sequence[SourceFile]):
+        for src in files:
+            for node in ast.walk(src.tree):
+                if isinstance(node, ast.Call) and \
+                        call_name(node.func) == "register_site" and \
+                        node.args:
+                    lit = _literal_str(node.args[0])
+                    if lit is not None:
+                        self.registered.add(lit)
+                if isinstance(node, ast.Assign) and \
+                        isinstance(node.value, ast.Call) and \
+                        call_name(node.value.func) == "register_site" and \
+                        node.value.args:
+                    lit = _literal_str(node.value.args[0])
+                    if lit is not None:
+                        for t in node.targets:
+                            if isinstance(t, ast.Name):
+                                self.constants[t.id] = lit
+
+    # ------------------------------------------------------------- phase 2
+    def check(self, src: SourceFile) -> List[Finding]:
+        # only count `fire` calls that resolve to resilience.faults —
+        # `from bigdl_tpu.resilience.faults import fire` or `faults.fire`
+        # (nn/dynamic_graph.py has an unrelated local `fire`)
+        bare_fire_is_faults = False
+        for node in ast.walk(src.tree):
+            if isinstance(node, ast.ImportFrom) and node.module and \
+                    node.module.endswith("faults"):
+                if any(a.name == "fire" for a in node.names):
+                    bare_fire_is_faults = True
+        raw: List[Tuple[str, int, str, str]] = []
+        known = self.base_sites | self.registered
+        for node in ast.walk(src.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = node.func
+            site_node = None
+            what = None
+            if isinstance(fn, ast.Attribute) and fn.attr == "fire" and \
+                    isinstance(fn.value, ast.Name) and \
+                    fn.value.id == "faults" and node.args:
+                site_node, what = node.args[0], "fire"
+            elif isinstance(fn, ast.Name) and fn.id == "fire" and \
+                    bare_fire_is_faults and node.args:
+                site_node, what = node.args[0], "fire"
+            elif call_name(fn) == "FaultSpec":
+                if node.args:
+                    site_node, what = node.args[0], "FaultSpec"
+                else:
+                    for kw in node.keywords:
+                        if kw.arg == "site":
+                            site_node, what = kw.value, "FaultSpec"
+            elif call_name(fn) == "register_site" and node.args:
+                lit = _literal_str(node.args[0])
+                if lit is not None and (not lit or "." not in lit):
+                    raw.append((
+                        "bad-site-format", node.lineno,
+                        f"registered site {lit!r} does not follow "
+                        f"'<subsystem>.<event>'",
+                        "name it <subsystem>.<event> "
+                        "(docs/resilience.md site convention)"))
+                continue
+            if site_node is None:
+                continue
+            site = _literal_str(site_node)
+            if site is None and isinstance(site_node, ast.Name):
+                site = self.constants.get(site_node.id)
+            if site is None:
+                continue  # dynamic expression: runtime registry owns it
+            if site not in known:
+                close = difflib.get_close_matches(site, sorted(known), 1)
+                hint = (f"did you mean {close[0]!r}?" if close else
+                        "add it to KNOWN_SITES or call register_site() "
+                        "in-tree")
+                raw.append((
+                    "unknown-site", site_node.lineno,
+                    f"{what} site {site!r} resolves against neither "
+                    f"KNOWN_SITES nor any in-tree register_site()",
+                    hint))
+        return self.make_findings(src, raw)

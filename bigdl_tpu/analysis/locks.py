@@ -1,0 +1,179 @@
+"""Lock-discipline checker (`locks`).
+
+The PR 13 fleet needed five review-hardening rounds to close races that
+all had one shape: a field the class mutates under `with self._lock:` in
+one method is read or written WITHOUT the lock in another. This checker
+makes that shape mechanical:
+
+1. Per class, infer the *guarded-field set*: every `self.<attr>`
+   mutated (assigned, aug-assigned, subscript-stored, deleted, or hit
+   with a mutating container method — append/pop/add/...) inside a
+   `with self.<lock>:` block, in any method. Any attribute whose name
+   contains "lock" counts as a lock; `with self._lock:` and
+   multi-item `with self._lock, other:` both count.
+2. Flag accesses (read or write) of guarded fields outside any lock
+   block in OTHER contexts. Exempt: `__init__` and `__del__` (no
+   concurrent callers before construction finishes / during teardown),
+   and methods named `*_unlocked` — the repo's caller-holds-the-lock
+   convention (membership.py's `_alive_unlocked` family): their whole
+   body counts as lock-held, so their writes ALSO feed the guarded set.
+   Nothing else is exempt. Single-threaded phases, benign races
+   (monotonic flags), and reads under an external lock are exactly what
+   the explicit escape hatch is for:
+
+       x = self._queue_depth  # lint: unguarded-ok(monotonic gauge read)
+
+Scope: by default the checker only applies to files under `serving/` and
+`resilience/` (the threaded subsystems; see docs/analysis.md) — pass
+`all_files=True` to run it everywhere (the fixture tests do).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from bigdl_tpu.analysis.core import Checker, Finding, SourceFile
+from bigdl_tpu.analysis.donation import self_attr
+
+#: container-mutator method names that count as a write to `self.X` when
+#: called as `self.X.append(...)` under the lock
+_MUTATORS = {"append", "appendleft", "extend", "insert", "pop", "popleft",
+             "remove", "clear", "add", "discard", "update", "setdefault",
+             "__setitem__"}
+
+_DEFAULT_DIRS = ("serving/", "resilience/")
+
+
+def _is_lock_expr(node: ast.AST) -> bool:
+    attr = self_attr(node)
+    return attr is not None and "lock" in attr.lower()
+
+
+class _MethodScan(ast.NodeVisitor):
+    """One pass over a method: classify every `self.X` access as
+    guarded (lexically inside a `with self.<lock>:`) or not, and as a
+    mutation or a read."""
+
+    def __init__(self):
+        self.depth = 0  # nested lock-with depth
+        # (attr, lineno, guarded, is_write)
+        self.accesses: List[Tuple[str, int, bool, bool]] = []
+
+    def visit_With(self, node: ast.With):
+        is_lock = any(_is_lock_expr(item.context_expr)
+                      for item in node.items)
+        # the lock expression itself is evaluated unguarded — fine
+        for item in node.items:
+            self.visit(item.context_expr)
+            if item.optional_vars:
+                self.visit(item.optional_vars)
+        if is_lock:
+            self.depth += 1
+        for stmt in node.body:
+            self.visit(stmt)
+        if is_lock:
+            self.depth -= 1
+
+    def visit_Attribute(self, node: ast.Attribute):
+        attr = self_attr(node)
+        if attr is not None:
+            is_write = isinstance(node.ctx, (ast.Store, ast.Del))
+            self.accesses.append((attr, node.lineno, self.depth > 0,
+                                  is_write))
+        self.generic_visit(node)
+
+    def visit_Subscript(self, node: ast.Subscript):
+        # self.X[k] = v / del self.X[k]: the Attribute self.X is a Load
+        # in the ast, but it mutates the container
+        attr = self_attr(node.value)
+        if attr is not None and isinstance(node.ctx, (ast.Store, ast.Del)):
+            self.accesses.append((attr, node.lineno, self.depth > 0, True))
+            self.visit(node.slice)
+            return
+        self.generic_visit(node)
+
+    def visit_Call(self, node: ast.Call):
+        # self.X.append(...): mutation of self.X
+        f = node.func
+        if isinstance(f, ast.Attribute) and f.attr in _MUTATORS:
+            attr = self_attr(f.value)
+            if attr is not None:
+                self.accesses.append((attr, f.value.lineno,
+                                      self.depth > 0, True))
+                for a in node.args:
+                    self.visit(a)
+                for kw in node.keywords:
+                    self.visit(kw.value)
+                return
+        self.generic_visit(node)
+
+
+class LockChecker(Checker):
+    """Infers each class's guarded-field set (attrs mutated under `with
+    self._lock:`) and flags unguarded reads/writes in `serving/` and
+    `resilience/` (the PR 13 fleet-race class). Details: module docstring."""
+
+    id = "locks"
+    hatch_tokens = ("unguarded-ok",)
+
+    def __init__(self, all_files: bool = False,
+                 dirs: Tuple[str, ...] = _DEFAULT_DIRS):
+        self.all_files = all_files
+        self.dirs = dirs
+
+    def _applies(self, src: SourceFile) -> bool:
+        if self.all_files:
+            return True
+        return any(d in src.rel for d in self.dirs)
+
+    def check(self, src: SourceFile) -> List[Finding]:
+        if not self._applies(src):
+            return []
+        raw: List[Tuple[str, int, str, str]] = []
+        for node in ast.walk(src.tree):
+            if isinstance(node, ast.ClassDef):
+                raw.extend(self._check_class(node))
+        return self.make_findings(src, raw)
+
+    def _check_class(self, cls: ast.ClassDef
+                     ) -> List[Tuple[str, int, str, str]]:
+        methods = [n for n in cls.body
+                   if isinstance(n, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef))]
+        scans: Dict[str, _MethodScan] = {}
+        for m in methods:
+            s = _MethodScan()
+            if m.name.endswith("_unlocked"):
+                s.depth = 1  # caller-holds-the-lock convention
+            for stmt in m.body:
+                s.visit(stmt)
+            scans[m.name] = s
+        # guarded set: mutated under a lock anywhere in the class
+        guarded: Set[str] = set()
+        uses_lock = False
+        for s in scans.values():
+            for attr, _ln, in_lock, is_write in s.accesses:
+                if in_lock:
+                    uses_lock = True
+                    if is_write:
+                        guarded.add(attr)
+        if not uses_lock or not guarded:
+            return []
+        guarded -= {a for a in guarded if "lock" in a.lower()}
+        raw: List[Tuple[str, int, str, str]] = []
+        for m in methods:
+            if m.name in ("__init__", "__del__"):
+                continue  # before/after the object is shared
+            for attr, lineno, in_lock, is_write in scans[m.name].accesses:
+                if in_lock or attr not in guarded:
+                    continue
+                kind = "write" if is_write else "read"
+                raw.append((
+                    f"unguarded-{kind}", lineno,
+                    f"`self.{attr}` is mutated under the lock elsewhere "
+                    f"in `{cls.name}` but accessed here "
+                    f"({cls.name}.{m.name}, {kind}) without it",
+                    "take the lock, or annotate why it is safe: "
+                    "`# lint: unguarded-ok(reason)`"))
+        return raw

@@ -1,0 +1,186 @@
+"""Recompile-hazard checker (`recompile`).
+
+The runtime compile-count contracts (serving `compile_count() ==
+buckets`, the PR 15 one-executable-per-bucket-layout assertion) catch
+compile storms *after the fact*, in the suites that opt in. This checker
+flags the argument shapes that CAUSE them, at review time, in the
+optimizer/serving hot paths:
+
+- `jit-in-loop` — `jax.jit(...)` / `CompiledFunction(...)` constructed
+  inside a `for`/`while` body: every iteration builds a fresh callable
+  with a cold cache (the jit cache is per-object for closures), i.e. a
+  trace+compile per iteration.
+- `pytree-structure` — a loop-dependent list/tuple display (or
+  `list(...)`/`tuple(...)` call) passed straight to a jitted callable:
+  the pytree structure — and with a growing container, the arity —
+  changes across iterations, and every new structure is a recompile.
+- `varying-shape` — a loop-dependent slice (`x[:n]`, `x[i:j]`) passed
+  straight to a jitted callable: the argument SHAPE varies per
+  iteration; pad to a bucket instead (serving/engine.py's power-of-two
+  discipline is the in-tree pattern).
+- `static-arg-in-loop` — a binding jitted with `static_argnums` called
+  in a loop with a loop-dependent expression in a static position:
+  every distinct value is a new compile cache entry by construction.
+
+"Loop-dependent" is conservative: the loop target plus any name stored
+inside the loop body. Scope: files under `optim/` and `serving/` (the
+hot paths the compile contracts guard) — `all_files=True` widens it.
+
+Escape hatch: `# lint: recompile-ok(reason)`.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from bigdl_tpu.analysis.core import Checker, Finding, SourceFile
+from bigdl_tpu.analysis.donation import (call_name, donating_call,
+                                         literal_argnums, self_attr)
+
+_DEFAULT_DIRS = ("optim/", "serving/")
+_JIT_FACTORIES = {"jit", "pjit", "CompiledFunction"}
+
+
+def _jitted_binding(node: ast.Call) -> bool:
+    """Any jit-like construction (donating or not)."""
+    return call_name(node.func) in _JIT_FACTORIES
+
+
+def _static_argnums(node: ast.Call) -> Tuple[int, ...]:
+    for kw in node.keywords:
+        if kw.arg in ("static_argnums", "static_argnames"):
+            nums = literal_argnums(kw.value)
+            if nums:
+                return nums
+    return ()
+
+
+def _names_in(node: ast.AST) -> Set[str]:
+    return {n.id for n in ast.walk(node) if isinstance(n, ast.Name)}
+
+
+class _Bindings(ast.NodeVisitor):
+    """module+class scan: name/attr -> (is_jitted, static_argnums)."""
+
+    def __init__(self):
+        self.names: Dict[str, Tuple[int, ...]] = {}
+        self.attrs: Dict[str, Tuple[int, ...]] = {}
+
+    def visit_Assign(self, node: ast.Assign):
+        if isinstance(node.value, ast.Call) and _jitted_binding(node.value):
+            statics = _static_argnums(node.value)
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    self.names[t.id] = statics
+                else:
+                    attr = self_attr(t)
+                    if attr:
+                        self.attrs[attr] = statics
+        self.generic_visit(node)
+
+
+class RecompileChecker(Checker):
+    """Flags compile-storm call shapes in the optimizer/serving hot paths:
+    jit built in a loop, loop-varying static args, changing pytree
+    structures, per-iteration shapes. Details: module docstring."""
+
+    id = "recompile"
+
+    def __init__(self, all_files: bool = False,
+                 dirs: Tuple[str, ...] = _DEFAULT_DIRS):
+        self.all_files = all_files
+        self.dirs = dirs
+
+    def _applies(self, src: SourceFile) -> bool:
+        return self.all_files or any(d in src.rel for d in self.dirs)
+
+    def check(self, src: SourceFile) -> List[Finding]:
+        if not self._applies(src):
+            return []
+        b = _Bindings()
+        b.visit(src.tree)
+        raw: List[Tuple[str, int, str, str]] = []
+        for loop in ast.walk(src.tree):
+            if not isinstance(loop, (ast.For, ast.While)):
+                continue
+            loop_vars = self._loop_vars(loop)
+            for node in ast.walk(loop):
+                if not isinstance(node, ast.Call):
+                    continue
+                if _jitted_binding(node):
+                    raw.append((
+                        "jit-in-loop", node.lineno,
+                        f"`{call_name(node.func)}(...)` is constructed "
+                        f"inside a loop — a fresh callable (and compile "
+                        f"cache) per iteration",
+                        "hoist the jit/CompiledFunction construction out "
+                        "of the loop; reuse one callable"))
+                    continue
+                statics = self._jitted_callee(node, b)
+                if statics is None:
+                    continue
+                self._check_args(node, statics, loop_vars, raw)
+        return self.make_findings(src, raw)
+
+    @staticmethod
+    def _loop_vars(loop) -> Set[str]:
+        out: Set[str] = set()
+        if isinstance(loop, ast.For):
+            out |= _names_in(loop.target)
+        for node in ast.walk(loop):
+            if isinstance(node, ast.Name) and \
+                    isinstance(node.ctx, ast.Store):
+                out.add(node.id)
+        return out
+
+    @staticmethod
+    def _jitted_callee(node: ast.Call, b: _Bindings
+                       ) -> Optional[Tuple[int, ...]]:
+        if isinstance(node.func, ast.Name) and node.func.id in b.names:
+            return b.names[node.func.id]
+        attr = self_attr(node.func)
+        if attr is not None and attr in b.attrs:
+            return b.attrs[attr]
+        return None
+
+    def _check_args(self, call: ast.Call, statics: Tuple[int, ...],
+                    loop_vars: Set[str],
+                    raw: List[Tuple[str, int, str, str]]):
+        fn = call_name(call.func) or "?"
+        for i, arg in enumerate(call.args):
+            loop_dep = bool(_names_in(arg) & loop_vars)
+            if i in statics and loop_dep:
+                raw.append((
+                    "static-arg-in-loop", arg.lineno,
+                    f"static arg {i} of jitted `{fn}` varies with the "
+                    f"loop — every distinct value is a separate compile",
+                    "make the argument a traced value, or bucket it to a "
+                    "small closed set"))
+                continue
+            if not loop_dep:
+                continue
+            if isinstance(arg, (ast.List, ast.Tuple)) or (
+                    isinstance(arg, ast.Call) and
+                    call_name(arg.func) in ("list", "tuple")):
+                raw.append((
+                    "pytree-structure", arg.lineno,
+                    f"a loop-dependent {type(arg).__name__.lower()} is "
+                    f"passed straight to jitted `{fn}` — a changing "
+                    f"pytree structure recompiles",
+                    "fix the container arity (pad/stack to a constant "
+                    "layout) before the jitted call"))
+            elif isinstance(arg, ast.Subscript) and \
+                    isinstance(arg.slice, ast.Slice):
+                bound_names = set()
+                for b_ in (arg.slice.lower, arg.slice.upper, arg.slice.step):
+                    if b_ is not None:
+                        bound_names |= _names_in(b_)
+                if bound_names & loop_vars:
+                    raw.append((
+                        "varying-shape", arg.lineno,
+                        f"a loop-dependent slice is passed straight to "
+                        f"jitted `{fn}` — the argument shape varies per "
+                        f"iteration (one compile per length)",
+                        "pad to a shape bucket (power-of-two discipline, "
+                        "serving/engine.py) instead of slicing raw"))

@@ -1,0 +1,126 @@
+"""Telemetry-schema conformance checker (`telemetry`).
+
+`RECORD_SCHEMAS` (observability/telemetry.py) is the closed field
+contract every sink consumer relies on; `validate_record` enforces it at
+runtime — but only in the suites that opt in. This checker enforces the
+same contract at lint time, over the record-dict LITERALS at emit sites:
+
+- any call `<something>.emit({...})` or `.event(...)`-free emit whose
+  single positional argument is a dict literal carrying a literal
+  `"type"` key is treated as a telemetry emission (that shape is unique
+  to the telemetry plane — no receiver-type inference needed);
+- `unknown-type` — the literal record type is not in `RECORD_SCHEMAS`
+  (the static twin of the `BIGDL_TPU_STRICT_TELEMETRY=1` runtime gate);
+- `undeclared-field` — a literal key that the (closed) schema declares
+  neither as required nor optional (`type`/`time`/`*_nonfinite` are
+  always allowed; `open` schemas only check declared-key types);
+- `missing-required` — only when the dict literal has NO `**splat`
+  (a splat may supply anything): a required field that is absent.
+
+Literal-value type checks are deliberately skipped — most values are
+expressions; the runtime validator owns value typing. The schemas are
+imported from the live module (same package, stdlib-only imports), so
+the lint contract can never drift from the runtime contract.
+
+Escape hatch: `# lint: telemetry-ok(reason)`.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Tuple
+
+from bigdl_tpu.analysis.core import Checker, Finding, SourceFile
+
+
+def _record_schemas() -> Dict[str, Dict]:
+    from bigdl_tpu.observability.telemetry import RECORD_SCHEMAS
+    return RECORD_SCHEMAS
+
+
+def _literal_str(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+class TelemetryChecker(Checker):
+    """Cross-checks record-dict literals at `Telemetry.emit(...)` sites
+    against the live RECORD_SCHEMAS: unknown types, undeclared fields,
+    missing required fields. Details: module docstring."""
+
+    id = "telemetry"
+
+    def __init__(self, schemas: Optional[Dict[str, Dict]] = None):
+        self._schemas = schemas
+
+    @property
+    def schemas(self) -> Dict[str, Dict]:
+        if self._schemas is None:
+            self._schemas = _record_schemas()
+        return self._schemas
+
+    def check(self, src: SourceFile) -> List[Finding]:
+        raw: List[Tuple[str, int, str, str]] = []
+        for node in ast.walk(src.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if not (isinstance(node.func, ast.Attribute) and
+                    node.func.attr in ("emit", "_emit")):
+                continue
+            if len(node.args) != 1 or not isinstance(node.args[0],
+                                                     ast.Dict):
+                continue
+            d: ast.Dict = node.args[0]
+            rtype = None
+            for k, v in zip(d.keys, d.values):
+                if k is not None and _literal_str(k) == "type":
+                    rtype = _literal_str(v)
+            if rtype is None:
+                continue  # not a telemetry record literal (or dynamic)
+            self._check_record(d, rtype, raw)
+        return self.make_findings(src, raw)
+
+    def _check_record(self, d: ast.Dict, rtype: str,
+                      raw: List[Tuple[str, int, str, str]]):
+        schemas = self.schemas
+        if rtype not in schemas:
+            known = ", ".join(sorted(schemas))
+            raw.append((
+                "unknown-type", d.lineno,
+                f"record type {rtype!r} is not declared in "
+                f"RECORD_SCHEMAS",
+                f"declare it in observability/telemetry.py or use one "
+                f"of: {known}"))
+            return
+        schema = schemas[rtype]
+        fields = {**schema["required"], **schema["optional"]}
+        has_splat = any(k is None for k in d.keys)
+        literal_keys = []
+        for k in d.keys:
+            if k is None:
+                continue
+            ks = _literal_str(k)
+            if ks is not None:
+                literal_keys.append((ks, k.lineno))
+        if not schema.get("open"):
+            for ks, lineno in literal_keys:
+                if ks in ("type", "time") or ks.endswith("_nonfinite"):
+                    continue
+                if ks not in fields:
+                    raw.append((
+                        "undeclared-field", lineno,
+                        f"field {ks!r} is not declared for closed record "
+                        f"type {rtype!r}",
+                        f"add it to RECORD_SCHEMAS[{rtype!r}] (and "
+                        f"docs/observability.md) or drop it"))
+        if not has_splat:
+            present = {ks for ks, _ in literal_keys}
+            for req in schema["required"]:
+                if req not in present:
+                    raw.append((
+                        "missing-required", d.lineno,
+                        f"required field {req!r} of record type "
+                        f"{rtype!r} is absent from the literal",
+                        f"emit {req!r} (RECORD_SCHEMAS[{rtype!r}] lists "
+                        f"it as required)"))

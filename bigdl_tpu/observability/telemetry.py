@@ -374,6 +374,13 @@ class Telemetry:
         return self
 
     def emit(self, record: Dict):
+        if os.environ.get("BIGDL_TPU_STRICT_TELEMETRY") == "1":
+            rtype = record.get("type")
+            if rtype not in RECORD_SCHEMAS:
+                raise ValueError(
+                    f"unknown telemetry record type {rtype!r} under "
+                    f"BIGDL_TPU_STRICT_TELEMETRY=1 — declare it in "
+                    f"RECORD_SCHEMAS (known: {', '.join(sorted(RECORD_SCHEMAS))})")
         # chaos site: a FaultInjector plan can make the sink path flake
         # here, proving observability failures stay non-fatal to the
         # system being observed (the serving engine catches and keeps

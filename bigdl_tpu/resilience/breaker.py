@@ -106,7 +106,7 @@ class CircuitBreaker:
                     self._n_shed += 1
                     return False
                 fire = (OPEN, HALF_OPEN)
-                self._set(HALF_OPEN)
+                self._set_unlocked(HALF_OPEN)
             # half-open: one probe in flight at a time
             if self._probe_inflight:
                 self._n_shed += 1
@@ -134,7 +134,7 @@ class CircuitBreaker:
                 self._probe_ok += 1
                 if self._probe_ok >= self.probe_successes:
                     fire = (HALF_OPEN, CLOSED)
-                    self._set(CLOSED)
+                    self._set_unlocked(CLOSED)
         if fire is not None:
             self._fire(*fire)
 
@@ -150,19 +150,19 @@ class CircuitBreaker:
                 # the probe failed: straight back to open, timer restarted
                 self._probe_inflight = False
                 fire = (HALF_OPEN, OPEN)
-                self._trip()
+                self._trip_unlocked()
             elif self._state == CLOSED:
                 self._consecutive_failures += 1
                 if self._consecutive_failures >= self.failure_threshold:
                     fire = (CLOSED, OPEN)
-                    self._trip()
+                    self._trip_unlocked()
             # already open: outcome of an in-flight call from before the
             # trip — nothing changes
         if fire is not None:
             self._fire(*fire)
 
     # ------------------------------------------------------------ internal
-    def _set(self, state: str):
+    def _set_unlocked(self, state: str):
         self._state = state
         if state == HALF_OPEN:
             self._probe_ok = 0
@@ -171,7 +171,7 @@ class CircuitBreaker:
             self._consecutive_failures = 0
             self._opened_at = None
 
-    def _trip(self):
+    def _trip_unlocked(self):
         self._state = OPEN
         self._opened_at = self.clock()
         self._consecutive_failures = 0

@@ -332,10 +332,10 @@ class InferenceEngine:
                 raise EngineClosedError("engine is closed")
             if self._thread is not None:
                 return self
-            self._thread = threading.Thread(
+            t = self._thread = threading.Thread(
                 target=self._run, name="bigdl-serving-dispatch",
                 daemon=False)
-        self._thread.start()
+        t.start()
         return self
 
     def close(self, drain: bool = True):

@@ -990,8 +990,9 @@ class ServingFleet:
         deterministic — exactly-once delivery); `idempotent=False`
         streams fail fast with `ServingReroutedError` instead. See
         `FleetTokenStream`."""
-        if self._closing:
-            raise EngineClosedError("serving fleet is closed")
+        with self._lock:
+            if self._closing:
+                raise EngineClosedError("serving fleet is closed")
         kw: Dict = {}
         if max_new_tokens is not None:
             kw["max_new_tokens"] = max_new_tokens
@@ -1206,9 +1207,9 @@ class ServingFleet:
         `health()` snapshots, run the autoscale policy, and emit the
         `serving_fleet` telemetry record. Call this on a loop (or let
         `maintain_interval_s` run it) — it is the fleet's heartbeat."""
-        if self._closing:
-            return
         with self._lock:
+            if self._closing:
+                return
             active = [(rid, rep) for rid, rep in self._replicas.items()
                       if rep.state == ACTIVE]
             suspended = set(self._suspended)
@@ -1225,8 +1226,9 @@ class ServingFleet:
             except KeyError:
                 pass  # removed by a concurrent scale-down
         for rid in self.registry.sweep():
-            if rid in self._replicas:
-                self._drain(rid, reason="lease_expired", kill=False)
+            # _drain takes the lock and no-ops on an unknown/terminal
+            # replica — no unguarded membership pre-check needed here
+            self._drain(rid, reason="lease_expired", kill=False)
         with self._lock:
             active = [rep for rep in self._replicas.values()
                       if rep.state == ACTIVE]

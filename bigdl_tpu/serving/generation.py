@@ -474,6 +474,7 @@ class GenerationEngine(InferenceEngine):
                         if not self._q and self._active == 0:
                             break
                 self._admit_into_slots()
+                # lint: unguarded-ok(the dispatcher thread is the only _active writer; _slock exists for cross-thread stats readers, not this owner-thread read)
                 if self._active:
                     self._decode_once()
         finally:

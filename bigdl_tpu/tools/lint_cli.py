@@ -1,0 +1,152 @@
+"""CLI front-end for the project-specific static checker suite.
+
+    python -m bigdl_tpu.tools.lint_cli check [--baseline FILE]
+        [--format text|json] [--deep] [--update-baseline] [paths ...]
+
+With no paths, lints the shipped surface: the `bigdl_tpu` package plus
+the repo's `scripts/` directory (the linter lints its own tooling).
+The committed baseline (`bigdl_tpu/analysis/baseline.json`) suppresses
+accepted pre-existing findings, each with a reason string; anything NOT
+in the baseline fails the run — the ratchet CI turns (scripts/run_ci.sh
+`--lint` stage).
+
+Exit codes: 0 = clean (no non-baselined findings); 1 = findings (the
+list is printed — `--format json` for the diffable CI form); 2 = usage
+or I/O error. `--update-baseline` rewrites the baseline from the
+current findings (then edit each entry's reason — `load_baseline`
+rejects reason-less entries) and exits 0.
+
+Stale baseline entries (key no longer found) are reported on stderr but
+do not fail the run: a fixed bug's leftover excuse should be deleted,
+not block the fix.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+from typing import Dict, List, Optional
+
+from bigdl_tpu.analysis import (apply_baseline, default_baseline_path,
+                                default_checkers, load_baseline,
+                                repo_root, run_checkers, save_baseline)
+
+_USAGE = """\
+usage: python -m bigdl_tpu.tools.lint_cli check [options] [paths ...]
+  --baseline FILE     baseline to apply (default:
+                      bigdl_tpu/analysis/baseline.json)
+  --format text|json  finding output form (default text; json for CI)
+  --deep              also run the executed invariant checks (imports
+                      the kernels' tile pickers; needs jax importable)
+  --update-baseline   rewrite the baseline from current findings\
+"""
+
+
+def default_paths() -> List[str]:
+    """The shipped lint surface: the package + repo scripts/ (when the
+    checkout layout is present — an installed wheel lints itself only)."""
+    root = repo_root()
+    pkg = os.path.join(root, "bigdl_tpu")
+    out = [pkg if os.path.isdir(pkg) else os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__)))]
+    scripts = os.path.join(root, "scripts")
+    if os.path.isdir(scripts):
+        out.append(scripts)
+    return out
+
+
+def check(paths: List[str], baseline_path: Optional[str] = None,
+          fmt: str = "text", deep: bool = False,
+          update_baseline: bool = False, out=None) -> int:
+    out = out or sys.stdout
+    baseline_path = baseline_path or default_baseline_path()
+    paths = paths or default_paths()
+    for p in paths:
+        if not os.path.exists(p):
+            print(f"lint_cli: no such path: {p}", file=sys.stderr)
+            return 2
+    findings = run_checkers(paths, default_checkers())
+    if deep:
+        from bigdl_tpu.analysis.tiling import deep_check
+        findings.extend(deep_check())
+    if update_baseline:
+        save_baseline(baseline_path, findings,
+                      reason="accepted pre-existing finding "
+                             "(ratchet start) — EDIT with the real why")
+        print(f"lint_cli: wrote {len(findings)} entries to "
+              f"{baseline_path} — now edit each entry's reason",
+              file=sys.stderr)
+        return 0
+    try:
+        baseline = load_baseline(baseline_path)
+    except (ValueError, json.JSONDecodeError, OSError) as e:
+        print(f"lint_cli: bad baseline {baseline_path}: {e}",
+              file=sys.stderr)
+        return 2
+    new, unused = apply_baseline(findings, baseline)
+    if unused:
+        print(f"lint_cli: {len(unused)} stale baseline entr"
+              f"{'y' if len(unused) == 1 else 'ies'} (finding fixed — "
+              f"delete the excuse):", file=sys.stderr)
+        for k in unused:
+            print(f"  {k}", file=sys.stderr)
+    if fmt == "json":
+        out.write(json.dumps({
+            "findings": [f.as_dict() for f in new],
+            "suppressed": len(findings) - len(new),
+            "stale_baseline_keys": unused,
+        }, indent=2) + "\n")
+    else:
+        for f in new:
+            out.write(f.text() + "\n")
+        out.write(
+            f"lint: {len(new)} finding{'s' if len(new) != 1 else ''} "
+            f"({len(findings) - len(new)} baselined"
+            f"{', ' + str(len(unused)) + ' stale baseline keys' if unused else ''})\n")
+    return 1 if new else 0
+
+
+def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if argv and argv[0] in ("-h", "--help"):
+        print(_USAGE, file=sys.stderr)
+        return 0
+    if not argv or argv[0] != "check":
+        print(_USAGE, file=sys.stderr)
+        return 2
+    rest = argv[1:]
+    kw: Dict = {}
+    paths: List[str] = []
+    i = 0
+    while i < len(rest):
+        a = rest[i]
+        if a == "--baseline":
+            if i + 1 >= len(rest):
+                print("lint_cli: --baseline needs a value",
+                      file=sys.stderr)
+                return 2
+            kw["baseline_path"] = rest[i + 1]
+            i += 1
+        elif a == "--format":
+            if i + 1 >= len(rest) or rest[i + 1] not in ("text", "json"):
+                print("lint_cli: --format needs text|json",
+                      file=sys.stderr)
+                return 2
+            kw["fmt"] = rest[i + 1]
+            i += 1
+        elif a == "--deep":
+            kw["deep"] = True
+        elif a == "--update-baseline":
+            kw["update_baseline"] = True
+        elif a.startswith("-"):
+            print(f"lint_cli: unknown flag {a}", file=sys.stderr)
+            return 2
+        else:
+            paths.append(a)
+        i += 1
+    return check(paths, **kw)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

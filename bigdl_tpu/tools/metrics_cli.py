@@ -175,6 +175,46 @@ def report(path: str, out: TextIO = None) -> int:
     return 0
 
 
+def lint_stream(paths: List[str], out: TextIO = None) -> int:
+    """`report --lint-stream`: run `validate_record` (the runtime twin of
+    the `telemetry` static checker) over every record of the stream(s);
+    exit 2 at the FIRST violation with a `path:line:` diagnostic — a
+    telemetry stream is a contract surface, and one malformed record
+    means the producer is broken, not the line."""
+    out = out or sys.stdout
+    from bigdl_tpu.observability.telemetry import validate_record
+    total = 0
+    for path in paths:
+        try:
+            with open(path) as f:
+                lines = f.readlines()
+        except OSError as e:
+            print(f"metrics_cli: cannot read {path}: {e}",
+                  file=sys.stderr)
+            return 2
+        for i, line in enumerate(lines, 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line, parse_constant=_raise_constant)
+                if not isinstance(rec, dict):
+                    raise ValueError(
+                        f"not a JSON object ({type(rec).__name__})")
+                validate_record(rec)
+            except ValueError as e:
+                print(f"metrics_cli: {path}:{i}: {e}", file=sys.stderr)
+                return 2
+            total += 1
+    if total == 0:
+        print(f"metrics_cli: {', '.join(paths)} hold(s) no records",
+              file=sys.stderr)
+        return 2
+    out.write(f"lint-stream: {total} record"
+              f"{'s' if total != 1 else ''} conform to RECORD_SCHEMAS\n")
+    return 0
+
+
 def trace(trace_id: str, paths: List[str], out: TextIO = None) -> int:
     """Print the critical-path tree of the `trace` record(s) whose
     trace_id starts with `trace_id` (operators copy short prefixes);
@@ -276,7 +316,11 @@ def slo(paths: List[str], check: bool = False,
 
 _USAGE = """\
 usage: python -m bigdl_tpu.tools.metrics_cli <command> ...
-  report <run.jsonl> [more.jsonl ...]      attribution tables
+  report [--lint-stream] <run.jsonl> [...] attribution tables; with
+                                           --lint-stream, validate every
+                                           record against RECORD_SCHEMAS
+                                           instead (exit 2 on first
+                                           violation)
   trace  <trace_id> <run.jsonl> [...]      one request's critical path
   slo    [--check] [--latency-p99-ms N] [--error-objective F]
          [--mfu-floor F] [--mttr-s N] <run.jsonl> [...]
@@ -295,9 +339,13 @@ def main(argv=None) -> int:
         return 2
     cmd, rest = argv[0], argv[1:]
     if cmd == "report":
+        do_lint = "--lint-stream" in rest
+        rest = [a for a in rest if a != "--lint-stream"]
         if not rest:
             print(_USAGE, file=sys.stderr)
             return 2
+        if do_lint:
+            return lint_stream(rest)
         rc = 0
         for path in rest:
             rc = max(rc, report(path))

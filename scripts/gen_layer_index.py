@@ -77,6 +77,7 @@ def _public(pkg):
 
 
 def main(out_path=None):
+    import bigdl_tpu.analysis as analysis
     import bigdl_tpu.keras as keras
     import bigdl_tpu.nn as nn
     import bigdl_tpu.observability as observability
@@ -115,6 +116,8 @@ def main(out_path=None):
               _rows(observability, _public(observability)))
         _emit(f, "bigdl_tpu.serving — micro-batching inference engine",
               _rows(serving, _public(serving)))
+        _emit(f, "bigdl_tpu.analysis — project-specific static checkers",
+              _rows(analysis, _public(analysis)))
     return out_path
 
 

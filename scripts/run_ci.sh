@@ -5,25 +5,58 @@
 #   1. editable install (pure-python package; native lib builds on demand)
 #   2. native host-runtime build (optional — ctypes loader falls back to
 #      pure python when no toolchain is present)
-#   3. full non-slow suite on an 8-virtual-device CPU mesh (the same trick
+#   3. static checker suite (bigdl_tpu.analysis) over the package +
+#      scripts/ + tools/ — ordered before the test/smoke stages so an
+#      invariant violation fails in seconds, not after the full suite;
+#      failure output is the --format json finding list (diffable logs)
+#   4. full non-slow suite on an 8-virtual-device CPU mesh (the same trick
 #      the reference uses: local[N] Spark emulating an N-node cluster,
 #      SURVEY.md §4.4)
-#   4. multi-chip dry-run: jit + execute the flagship training step over a
+#   5. multi-chip dry-run: jit + execute the flagship training step over a
 #      dp x tp mesh, with dp-vs-dp*tp parameter-parity assertions
+#
+# Modes:
+#   (none)        full gate
+#   --lint        lint stage only (the pre-push fast path)
+#   --parity-only lint + the bit-parity smokes, skipping the pytest
+#                 suite and chaos drills (the quick-iteration gate)
 set -euo pipefail
 cd "$(dirname "$0")/.."
+
+MODE=full
+case "${1:-}" in
+  --lint) MODE=lint ;;
+  --parity-only) MODE=parity ;;
+  "") ;;
+  *) echo "usage: run_ci.sh [--lint|--parity-only]" >&2; exit 2 ;;
+esac
 
 # --no-build-isolation: build with the ambient setuptools, no network
 # (zero-egress environments; matches scripts/make_dist.sh)
 python -m pip install -e . --no-build-isolation --quiet
 
-if command -v g++ >/dev/null 2>&1; then
+if [ "$MODE" = full ] && command -v g++ >/dev/null 2>&1; then
   make -C native
 fi
 
 export JAX_PLATFORMS=cpu
 export XLA_FLAGS="--xla_force_host_platform_device_count=8 ${XLA_FLAGS:-}"
+# undeclared telemetry record types are hard errors everywhere in CI
+# (the runtime twin of the lint suite's `telemetry` checker)
+export BIGDL_TPU_STRICT_TELEMETRY=1
 
+# static checker suite: donation safety, lock discipline, recompile
+# hazards, telemetry/fault-site contracts, Pallas tiling (+ the executed
+# tile-picker invariants via --deep). Exits nonzero on any finding not
+# excused by the committed baseline — the ratchet.
+python -m bigdl_tpu.tools.lint_cli check --deep --format json
+
+if [ "$MODE" = lint ]; then
+  echo "CI lint stage passed"
+  exit 0
+fi
+
+if [ "$MODE" = full ]; then
 python -m pytest tests/ -q -m "not slow"
 
 # elastic chaos smoke: injected mesh.device_loss -> shrink -> replay ->
@@ -48,6 +81,7 @@ BIGDL_TPU_TELEMETRY="$chaos_dir" \
   python -m bigdl_tpu.tools.bench_cli --serve-fleet --chaos --replica-loss
 python -m bigdl_tpu.tools.metrics_cli slo --check --mttr-s 60 \
   "$chaos_dir"/serve_fleet_*.jsonl
+fi  # MODE=full
 
 # fusion parity smoke: pattern-fused BN+ReLU (Pallas kernels forced in
 # interpreter mode) must train LeNet and ResNet-8/CIFAR with loss
@@ -62,6 +96,11 @@ python -m bigdl_tpu.tools.bench_cli --fusion --parity-only
 # reduction through the elastic loop (exits nonzero on a break), with
 # one accumulate compile per bucket layout
 python -m bigdl_tpu.tools.bench_cli --overlap --parity-only
+
+if [ "$MODE" = parity ]; then
+  echo "CI parity gate passed (lint + bit-parity smokes)"
+  exit 0
+fi
 
 # generation smoke: continuous-batching greedy decode must reproduce the
 # serial full-recompute reference token-for-token (bench_cli exits

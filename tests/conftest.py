@@ -11,6 +11,11 @@ import os
 # Force CPU even when the session env points at a TPU (JAX_PLATFORMS=axon):
 # unit tests need f32 determinism and the virtual 8-device mesh.
 os.environ["JAX_PLATFORMS"] = "cpu"
+
+# Every Telemetry.emit of an undeclared record type is a hard error in
+# the suite (the runtime twin of the `telemetry` static checker) — a new
+# record type must land in RECORD_SCHEMAS before any test can emit it.
+os.environ.setdefault("BIGDL_TPU_STRICT_TELEMETRY", "1")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
