@@ -89,6 +89,8 @@ class PrometheusTextSink(TelemetrySink):
         self._serving_fleet: Dict = {}  # newest serving_fleet record
         self._slo: Dict[str, Dict] = {}  # newest slo_status per objective
         self._alerts: Dict[str, int] = {}  # alert records seen per slo
+        self._replay: Dict = {}  # newest workload_replay heartbeat
+        self._replay_summary: Dict = {}  # newest replay_summary
         self._counts: Dict[str, int] = {}  # records seen by type
         self._engines: List = []  # (label, weakref) pairs
 
@@ -105,6 +107,10 @@ class PrometheusTextSink(TelemetrySink):
                 self._generation = dict(record)
             elif rtype == "serving_fleet":
                 self._serving_fleet = dict(record)
+            elif rtype == "workload_replay":
+                self._replay = dict(record)
+            elif rtype == "replay_summary":
+                self._replay_summary = dict(record)
             elif rtype == "slo_status" and record.get("slo"):
                 self._slo[record["slo"]] = dict(record)
             elif rtype == "alert" and record.get("slo"):
@@ -165,6 +171,8 @@ class PrometheusTextSink(TelemetrySink):
             fleet = dict(self._fleet)
             slo = {k: dict(v) for k, v in self._slo.items()}
             alerts = dict(self._alerts)
+            replay = dict(self._replay)
+            replay_summary = dict(self._replay_summary)
             counts = dict(self._counts)
             engines = list(self._engines)
         lines: List[str] = []
@@ -341,6 +349,49 @@ class PrometheusTextSink(TelemetrySink):
         self._sample(lines, "slo_alerts_total", "counter",
                      "SLO burn-rate alerts fired.",
                      [({"slo": s}, n) for s, n in sorted(alerts.items())])
+        # --- workload replay: progress from the newest heartbeat,
+        # verdict from the newest replay_summary (workload/replay.py)
+        if replay:
+            wlabel = {"workload": str(replay.get("workload", "?"))}
+            for field, name, mtype, help_ in (
+                    ("entries_total", "workload_replay_entries_total",
+                     "gauge", "Entries in the workload being replayed."),
+                    ("entries_done", "workload_replay_entries_done",
+                     "gauge", "Workload entries replayed so far."),
+                    ("chaos_fired", "workload_replay_chaos_fired",
+                     "gauge", "Chaos actions fired so far."),
+                    ("ok", "workload_replay_ok_total", "counter",
+                     "Replayed requests that completed ok."),
+                    ("errors", "workload_replay_errors_total", "counter",
+                     "Replayed requests that failed."),
+                    ("timeouts", "workload_replay_timeouts_total",
+                     "counter", "Replayed requests past their deadline."),
+                    ("shed", "workload_replay_shed_total", "counter",
+                     "Replayed requests shed by backpressure."),
+                    ("offset_ms", "workload_replay_offset_ms", "gauge",
+                     "Virtual-timeline position of the replay (ms)."),
+            ):
+                val = replay.get(field)
+                if isinstance(val, (int, float)):
+                    self._sample(lines, name, mtype, help_,
+                                 [(wlabel, val)])
+        if replay_summary:
+            slabel = {"workload":
+                      str(replay_summary.get("workload", "?"))}
+            if "seed" in replay_summary:
+                slabel["seed"] = str(replay_summary["seed"])
+            self._sample(
+                lines, "workload_replay_complete", "gauge",
+                "1 once a replay finished (labels carry its scenario).",
+                [(slabel, 1)])
+            div = replay_summary.get("divergent")
+            if isinstance(div, bool):
+                self._sample(
+                    lines, "workload_replay_divergent", "gauge",
+                    "1 when the finished replay diverged from its "
+                    "baseline stream under the SLO-replay invariance "
+                    "contract (0 = invariant).",
+                    [(slabel, int(div))])
         # --- live breaker state per tracked engine
         breaker_samples = []
         health_samples = []

@@ -233,7 +233,16 @@ RECORD_SCHEMAS: Dict[str, Dict] = {
                      "prefill_ms": _NUM, "decode_ms": _NUM, "tokens": int,
                      "batch": int, "bucket": int,
                      "critical_path": list, "error": str,
-                     "sample_weight": int, "replica_id": str},
+                     "sample_weight": int, "replica_id": str,
+                     # replayable-workload fields (workload/record.py):
+                     # arrival offset relative to the emitter's start,
+                     # session identity, the deadline BUDGET the caller
+                     # gave (latency_ms is what happened; the budget is
+                     # what was promised), and the request shape/prompt
+                     # size needed to re-synthesize an equivalent request
+                     "arrival_offset_ms": _NUM, "session_id": _OPT_STR,
+                     "deadline_budget_ms": _OPT_NUM, "idempotent": bool,
+                     "shape": list, "prompt_tokens": int},
     },
     # continuous-batching generation snapshot (serving/generation.py),
     # one every emit_every decode steps plus a final one at close;
@@ -269,6 +278,31 @@ RECORD_SCHEMAS: Dict[str, Dict] = {
                      "compliance": _OPT_NUM, "burn_rate": _OPT_NUM,
                      "error_budget_remaining": _OPT_NUM,
                      "window_s": _NUM, "alerts_fired": int},
+    },
+    # replay progress heartbeat (workload/replay.py), one every
+    # progress_every replayed entries; every field is deterministic under
+    # a fixed (workload, seed, target config) so two replays of the same
+    # scenario emit IDENTICAL sequences — metrics_cli diff relies on it
+    "workload_replay": {
+        "required": {"workload": str, "entries_total": int,
+                     "entries_done": int, "chaos_fired": int},
+        "optional": {"seed": int, "speed": _NUM, "offset_ms": _NUM,
+                     "ok": int, "errors": int, "timeouts": int,
+                     "shed": int},
+    },
+    # one per completed replay (workload/replay.py): the outcome tallies
+    # + config fingerprint that metrics_cli diff compares across runs.
+    # `divergent` is set only when the replayer was handed a baseline
+    # stream to compare against; PrometheusTextSink renders it as the
+    # workload_replay_divergent gauge
+    "replay_summary": {
+        "required": {"workload": str, "entries_total": int,
+                     "ok": int, "errors": int, "timeouts": int,
+                     "shed": int, "chaos_fired": int},
+        "optional": {"seed": int, "speed": _NUM, "replicas": int,
+                     "workload_sha256": str, "duration_ms": _NUM,
+                     "rerouted": int, "cancelled": int,
+                     "divergent": bool, "divergence": _OPT_STR},
     },
     # a burn-rate breach transition (observability/slo.py); the flight
     # recorder treats this as a dump trigger

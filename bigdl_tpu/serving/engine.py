@@ -142,10 +142,12 @@ def default_buckets(max_batch_size: int) -> List[int]:
 
 class _Request:
     __slots__ = ("features", "future", "t_submit", "deadline", "ctx",
-                 "seq", "t_gather")
+                 "seq", "t_gather", "session", "deadline_budget_ms")
 
     def __init__(self, features, deadline: Optional[float],
-                 ctx: Optional[TraceContext] = None, seq: int = 0):
+                 ctx: Optional[TraceContext] = None, seq: int = 0,
+                 session=None,
+                 deadline_budget_ms: Optional[float] = None):
         self.features = features
         self.future: Future = Future()
         self.t_submit = time.perf_counter()
@@ -153,6 +155,8 @@ class _Request:
         self.ctx = ctx            # trace identity, carried across threads
         self.seq = seq
         self.t_gather: Optional[float] = None  # when its batch closed
+        self.session = session    # echoed into the trace record
+        self.deadline_budget_ms = deadline_budget_ms  # as GIVEN, not spent
 
     def signature(self):
         return tuple((f.shape, str(f.dtype)) for f in self.features)
@@ -304,6 +308,11 @@ class InferenceEngine:
         self._flops_total = 0.0
         self._bytes_total = 0.0
         self._t0_mono = time.monotonic()
+        # perf_counter twin of _t0_mono: trace records stamp each
+        # request's arrival_offset_ms against it, so a recorded stream
+        # carries its own relative timeline (workload/record.py replays
+        # it without wall-clock side channels)
+        self._t0_perf = time.perf_counter()
         # route the predictor's compile telemetry into this engine's
         # stream under a serving label — bucket warmup cost and recompile
         # storms then show up as `compile` records
@@ -400,12 +409,17 @@ class InferenceEngine:
             pass
 
     # ------------------------------------------------------------ admission
-    def submit(self, sample, deadline_ms: Optional[float] = None) -> Future:
+    def submit(self, sample, deadline_ms: Optional[float] = None,
+               session=None) -> Future:
         """Enqueue one request; returns a `concurrent.futures.Future`
         resolving to the per-sample output row (or raising
         `ServingTimeoutError` / `ServingError`). `sample` is a `Sample`
         or a raw feature array. `deadline_ms` bounds the request's whole
-        queued life: admission (block mode) and batching both observe it."""
+        queued life: admission (block mode) and batching both observe it.
+        `session` is an opaque caller identity echoed into the request's
+        trace record as `session_id` — the engine itself has no affinity
+        (that is the fleet router's job); carrying it here keeps a
+        single-engine trace stream replayable."""
         if isinstance(sample, Sample):
             feats = sample.features
         else:
@@ -419,7 +433,8 @@ class InferenceEngine:
         ctx = TraceContext.new_trace() \
             if (self.telemetry is not None or self.tracer is not None) \
             else None
-        req = _Request(feats, deadline, ctx=ctx, seq=next(self._req_seq))
+        req = _Request(feats, deadline, ctx=ctx, seq=next(self._req_seq),
+                       session=session, deadline_budget_ms=deadline_ms)
         self._admit(req)
         return req.future
 
@@ -815,7 +830,16 @@ class InferenceEngine:
                 continue
             rec = {"type": "trace", "trace_id": r.ctx.trace_id,
                    "kind": "serving_request", "status": status,
-                   "latency_ms": round(total_ms, 3)}
+                   "latency_ms": round(total_ms, 3),
+                   "arrival_offset_ms":
+                       round((r.t_submit - self._t0_perf) * 1e3, 3)}
+            if r.session is not None:
+                rec["session_id"] = str(r.session)
+            if r.deadline_budget_ms is not None:
+                rec["deadline_budget_ms"] = round(r.deadline_budget_ms, 3)
+            if r.features:
+                rec["shape"] = [int(d) for d in
+                                np.asarray(r.features[0]).shape]
             if self.replica_id is not None:
                 rec["replica_id"] = self.replica_id
             if status == "ok" and self.trace_sample > 1:

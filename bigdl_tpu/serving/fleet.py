@@ -76,6 +76,7 @@ from __future__ import annotations
 import bisect
 import functools
 import hashlib
+import inspect
 import logging
 import random
 import threading
@@ -485,7 +486,7 @@ class _FleetRequest:
 
 class _Replica:
     __slots__ = ("replica_id", "engine", "state", "outstanding",
-                 "health_cache", "tracer", "warmups")
+                 "health_cache", "tracer", "warmups", "accepts_session")
 
     def __init__(self, replica_id: str, engine, tracer=None):
         self.replica_id = replica_id
@@ -495,6 +496,20 @@ class _Replica:
         self.health_cache: Optional[Dict] = None
         self.tracer = tracer
         self.warmups = 0
+        # whether engine.submit takes session= — probed ONCE here, not
+        # per request, because `engine_factory` doubles (tests, remote
+        # shims) predate the kwarg and a TypeError mid-route would read
+        # as a replica failure
+        self.accepts_session = _submit_accepts_session(engine)
+
+
+def _submit_accepts_session(engine) -> bool:
+    try:
+        params = inspect.signature(engine.submit).parameters
+    except (TypeError, ValueError):
+        return False
+    return ("session" in params
+            or any(p.kind is p.VAR_KEYWORD for p in params.values()))
 
 
 class Router:
@@ -644,7 +659,11 @@ class Router:
         if deadline_ms is not None and deadline_ms <= 0:
             raise ServingTimeoutError(
                 "deadline lapsed before the request reached a replica")
-        ef = rep.engine.submit(req.sample, deadline_ms=deadline_ms)
+        if rep.accepts_session and req.session is not None:
+            ef = rep.engine.submit(req.sample, deadline_ms=deadline_ms,
+                                   session=req.session)
+        else:
+            ef = rep.engine.submit(req.sample, deadline_ms=deadline_ms)
         with self.fleet._lock:
             req.replica_id = rep.replica_id
             req.engine_future = ef
@@ -861,6 +880,9 @@ class ServingFleet:
                            telemetry=telemetry)
         self.autoscale = autoscale
         self._lock = threading.RLock()
+        # arrival_offset_ms anchor for the fleet's caller-visible trace
+        # records — same contract as InferenceEngine._t0_perf
+        self._t0_perf = time.perf_counter()
         self._replicas: Dict[str, _Replica] = {}
         self._next_idx = 0
         self._closing = False
@@ -1439,7 +1461,27 @@ class ServingFleet:
                "trace_id": TraceContext.new_trace().trace_id,
                "kind": kind, "status": status,
                "latency_ms": round(
-                   (time.perf_counter() - req.t_submit) * 1e3, 3)}
+                   (time.perf_counter() - req.t_submit) * 1e3, 3),
+               "arrival_offset_ms": round(
+                   (req.t_submit - self._t0_perf) * 1e3, 3)}
+        # `req` is a _FleetRequest or a FleetTokenStream (private-name
+        # variants of the same fields)
+        session = getattr(req, "session", None)
+        if session is None:
+            session = getattr(req, "_session", None)
+        if session is not None:
+            rec["session_id"] = str(session)
+        idem = getattr(req, "idempotent", None)
+        if idem is None:
+            idem = getattr(req, "_idempotent", None)
+        if idem is not None:
+            rec["idempotent"] = bool(idem)
+        deadline = getattr(req, "deadline", None)
+        if deadline is None:
+            deadline = getattr(req, "_deadline", None)
+        if deadline is not None:
+            rec["deadline_budget_ms"] = round(
+                (deadline - req.t_submit) * 1e3, 3)
         if req.replica_id is not None:
             rec["replica_id"] = req.replica_id
         if error is not None:

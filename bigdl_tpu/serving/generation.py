@@ -225,11 +225,14 @@ class TokenStream:
 class _GenRequest:
     __slots__ = ("prompt", "max_new_tokens", "eos_id", "stream", "deadline",
                  "ctx", "seq", "t_submit", "t_gather", "t_prefill1",
-                 "tokens_out", "slot", "pos")
+                 "tokens_out", "slot", "pos", "session",
+                 "deadline_budget_ms")
 
     def __init__(self, prompt: np.ndarray, max_new_tokens: int,
                  eos_id: Optional[int], deadline: Optional[float],
-                 ctx: Optional[TraceContext], seq: int):
+                 ctx: Optional[TraceContext], seq: int,
+                 session=None,
+                 deadline_budget_ms: Optional[float] = None):
         self.prompt = prompt
         self.max_new_tokens = max_new_tokens
         self.eos_id = eos_id
@@ -243,6 +246,8 @@ class _GenRequest:
         self.tokens_out: List[int] = []
         self.slot: Optional[int] = None
         self.pos = 0  # next decode position (= prompt length after prefill)
+        self.session = session    # echoed into the trace record
+        self.deadline_budget_ms = deadline_budget_ms  # as GIVEN, not spent
 
 
 class GenerationEngine(InferenceEngine):
@@ -372,12 +377,15 @@ class GenerationEngine(InferenceEngine):
     # ------------------------------------------------------------ admission
     def generate(self, prompt, max_new_tokens: Optional[int] = None,
                  eos_id: Optional[int] = None,
-                 deadline_ms: Optional[float] = None) -> TokenStream:
+                 deadline_ms: Optional[float] = None,
+                 session=None) -> TokenStream:
         """Admit one greedy-decode request; returns its `TokenStream`.
         `prompt` is a 1-D array of 1-based token ids. `deadline_ms`
         bounds the request's QUEUED life (admission + waiting for a free
         slot); once its prefill lands, a request runs to completion.
-        Raises `ValueError` for inadmissible requests
+        `session` is an opaque caller identity echoed into the trace
+        record as `session_id` (replayable streams; the fleet router owns
+        affinity). Raises `ValueError` for inadmissible requests
         (`len(prompt) + max_new_tokens > max_len`), plus the engine's
         usual admission errors."""
         prompt = np.asarray(prompt, dtype=np.int32).reshape(-1)
@@ -401,7 +409,8 @@ class GenerationEngine(InferenceEngine):
             else None
         req = _GenRequest(prompt, n_new,
                           self.default_eos_id if eos_id is None else eos_id,
-                          deadline, ctx, next(self._req_seq))
+                          deadline, ctx, next(self._req_seq),
+                          session=session, deadline_budget_ms=deadline_ms)
         self._admit(req)
         return req.stream
 
@@ -410,7 +419,8 @@ class GenerationEngine(InferenceEngine):
         (same failure semantics as iterating `generate(...)`)."""
         yield from self.generate(prompt, **kw)
 
-    def submit(self, sample, deadline_ms: Optional[float] = None):
+    def submit(self, sample, deadline_ms: Optional[float] = None,
+               session=None):
         raise ServingError(
             "GenerationEngine serves generate()/stream(); use "
             "InferenceEngine for one-shot forwards")
@@ -785,7 +795,14 @@ class GenerationEngine(InferenceEngine):
         rec = {"type": "trace", "trace_id": r.ctx.trace_id,
                "kind": "generate", "status": status,
                "latency_ms": round(total_ms, 3),
-               "tokens": len(r.tokens_out)}
+               "tokens": len(r.tokens_out),
+               "prompt_tokens": int(r.prompt.size),
+               "arrival_offset_ms":
+                   round((r.t_submit - self._t0_perf) * 1e3, 3)}
+        if r.session is not None:
+            rec["session_id"] = str(r.session)
+        if r.deadline_budget_ms is not None:
+            rec["deadline_budget_ms"] = round(r.deadline_budget_ms, 3)
         if self.replica_id is not None:
             rec["replica_id"] = self.replica_id
         if status == "ok" and self.trace_sample > 1:

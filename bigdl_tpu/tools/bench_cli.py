@@ -1443,6 +1443,148 @@ def bench_serve_fleet(replicas: int = 3, clients: int = 6,
     return out
 
 
+def bench_replay_invariance(replicas: int = 3, requests: int = 90,
+                            sessions: int = 6, seed: int = 7,
+                            deadline_ms: float = 60_000.0):
+    """Replay-invariance drill (the CI gate behind `metrics_cli diff`):
+    record a short fleet run into a workload file, embed a seeded
+    chaos plan (kill one replica a third of the way in, restore it at
+    two thirds), replay the file THREE times against fresh fleets —
+    twice with the same seed, once perturbed — and check the
+    SLO-replay invariance contract both ways: the same-seed pair must
+    be stream-identical under `workload.diff.compare_streams`, and the
+    perturbed replay must be reported divergent with a pointer.
+
+    When BIGDL_TPU_TELEMETRY names a directory the three canonical
+    streams land in `replay_invariance_{a,b,perturbed}_<pid>.jsonl`
+    (plus the workload file itself), which scripts/run_ci.sh re-judges
+    through `metrics_cli diff` and `metrics_cli slo --check` — the
+    same verdict from the CLI an operator would use. Prints ONE json
+    line; `recovered`-style gate: `invariant` AND
+    `perturbation_detected` must both hold."""
+    import bigdl_tpu.nn as nn_
+    from bigdl_tpu.dataset.sample import Sample
+    from bigdl_tpu.observability import InMemorySink, Telemetry
+    from bigdl_tpu.observability.slo import SloEngine, default_slos
+    from bigdl_tpu.serving import ServingFleet
+    from bigdl_tpu.workload import (ChaosAction, ChaosSchedule,
+                                    VirtualClock, Workload,
+                                    WorkloadRecorder, WorkloadReplayer,
+                                    compare_streams)
+
+    def build_model():
+        m = (nn_.Sequential().add(nn_.Reshape([784]))
+             .add(nn_.Linear(784, 32)).add(nn_.Tanh())
+             .add(nn_.Linear(32, 10)).add(nn_.LogSoftMax()))
+        m.ensure_params()
+        return m
+
+    rs = np.random.RandomState(0)
+    samples = [Sample(rs.rand(28, 28).astype(np.float32))
+               for _ in range(16)]
+    tel_dir = os.environ.get("BIGDL_TPU_TELEMETRY")
+    if tel_dir:
+        os.makedirs(tel_dir, exist_ok=True)
+
+    # --- phase 1: record a live run (with a mid-run kill+restore, so
+    # the recorded traffic includes rerouting noise the recorder must
+    # distill away) into a workload file
+    recorder = WorkloadRecorder(name="ci_fleet_run", seed=seed)
+    rec_tel = Telemetry(recorder, resources=False)
+    fleet = ServingFleet(build_model(), n_replicas=replicas,
+                         warmup_sample=samples[0], telemetry=rec_tel,
+                         drain_grace_s=0.5, lease_s=30.0, seed=0,
+                         engine_kwargs={"max_batch_size": 8,
+                                        "max_wait_ms": 1.0,
+                                        "queue_capacity": 256})
+    try:
+        futs = []
+        for i in range(requests):
+            futs.append(fleet.submit(samples[i % len(samples)],
+                                     deadline_ms=deadline_ms,
+                                     session=f"s{i % sessions}",
+                                     idempotent=True))
+            if i == requests // 3:
+                fleet.fail("replica1", reason="recorded chaos kill")
+            elif i == (2 * requests) // 3:
+                fleet.restore("replica1")
+        for f in futs:
+            try:
+                f.result(timeout=60.0)
+            except Exception:
+                pass  # outcomes are the REPLAY's to re-derive
+    finally:
+        fleet.close()
+        rec_tel.close()
+    # the seeded chaos plan: entry-boundary triggers (deterministic
+    # under time compression), targets left to the schedule's rng so
+    # the seed genuinely matters
+    chaos_plan = [ChaosAction("kill", after_entries=requests // 3),
+                  ChaosAction("restore",
+                              after_entries=(2 * requests) // 3)]
+    workload = recorder.workload(
+        chaos=[a.to_dict() for a in chaos_plan])
+    wl_path = os.path.join(tel_dir or ".",
+                           f"replay_workload_{os.getpid()}.jsonl")
+    workload.save(wl_path)
+    workload = Workload.load(wl_path)  # replay what CI would replay
+
+    # --- phase 2: three replays against fresh fleets
+    def replay(replay_seed: int, tag: str):
+        sink = InMemorySink()
+        sinks = [sink]
+        path = None
+        if tel_dir:
+            from bigdl_tpu.observability import JsonlSink
+            path = os.path.join(
+                tel_dir, f"replay_invariance_{tag}_{os.getpid()}.jsonl")
+            sinks.append(JsonlSink(path, append=False))
+        tel = Telemetry(*sinks, resources=False)
+        SloEngine(default_slos(latency_p99_ms=deadline_ms),
+                  emit_every_s=0.25).attach(tel)
+        target = ServingFleet(build_model(), n_replicas=replicas,
+                              warmup_sample=samples[0], telemetry=None,
+                              drain_grace_s=0.5, lease_s=30.0, seed=0,
+                              engine_kwargs={"max_batch_size": 8,
+                                             "max_wait_ms": 1.0,
+                                             "queue_capacity": 256})
+        try:
+            summary = WorkloadReplayer(
+                target, workload,
+                chaos=ChaosSchedule.from_dicts(workload.chaos,
+                                               seed=replay_seed),
+                seed=replay_seed, telemetry=tel, clock=VirtualClock(),
+                progress_every=max(1, len(workload) // 5)).run()
+        finally:
+            target.close()
+            tel.close()
+        return sink.records, summary, path
+
+    a_records, a_summary, a_path = replay(seed, "a")
+    b_records, _, b_path = replay(seed, "b")
+    p_records, _, p_path = replay(seed + 1, "perturbed")
+
+    same = compare_streams(a_records, b_records)
+    perturbed = compare_streams(a_records, p_records)
+    out = {
+        "metric": "replay_invariance",
+        "workload_entries": len(workload),
+        "replicas": replicas,
+        "seed": seed,
+        "chaos_fired": a_summary.get("chaos_fired"),
+        "outcomes": {k: a_summary.get(k) for k in
+                     ("ok", "errors", "timeouts", "shed")},
+        "invariant": not same.divergent,
+        "invariance_break": same.first,
+        "perturbation_detected": perturbed.divergent,
+        "perturbation_pointer": perturbed.first,
+        "streams": [p for p in (a_path, b_path, p_path) if p],
+        "workload_file": wl_path,
+    }
+    print(json.dumps(out), flush=True)
+    return out
+
+
 def bench_baseline_configs():
     """One stderr line per remaining BASELINE.md config (the headline
     already covers ResNet-50): LeNet-5, Inception-v1, PTB LSTM, and
@@ -1799,6 +1941,7 @@ def main():
     device_loss = False
     serve_fleet = False
     replica_loss = False
+    replay_invariance = False
     generate = False
     generate_clients = 8
     fusion_ab = False
@@ -1840,6 +1983,8 @@ def main():
             device_loss = True  # silently swallowed by the headline path
         elif a == "--serve-fleet":
             serve_fleet = True
+        elif a == "--replay-invariance":
+            replay_invariance = True
         elif a == "--generate":
             generate = True
         elif a.startswith("--generate-clients="):
@@ -1896,6 +2041,23 @@ def main():
         _configure_compile_cache()
         out = bench_generation_ab(clients=generate_clients)
         if not out.get("parity"):
+            raise SystemExit(1)
+        return
+    if replay_invariance:
+        # SLO-replay invariance drill: record a short fleet run, embed
+        # a seeded kill/restore chaos plan, replay it three times
+        # (same seed twice, perturbed once) and gate on the contract:
+        # same workload + same seed => identical canonical stream;
+        # perturbed seed => divergent with a first-divergence pointer.
+        # The streams land in BIGDL_TPU_TELEMETRY for the metrics_cli
+        # diff / slo --check re-judgment in scripts/run_ci.sh.
+        logging.getLogger("bigdl_tpu.optim").setLevel(logging.ERROR)
+        logging.getLogger("bigdl_tpu.serving").setLevel(logging.ERROR)
+        logging.getLogger("bigdl_tpu.resilience").setLevel(logging.ERROR)
+        logging.getLogger("bigdl_tpu.workload").setLevel(logging.ERROR)
+        _configure_compile_cache()
+        out = bench_replay_invariance()
+        if not (out.get("invariant") and out.get("perturbation_detected")):
             raise SystemExit(1)
         return
     if serve_fleet or replica_loss:

@@ -1,6 +1,6 @@
 """Operator CLI over a recorded telemetry JSONL stream.
 
-Three subcommands, all reading the strict JSONL a `JsonlSink` wrote
+Four subcommands, all reading the strict JSONL a `JsonlSink` wrote
 (bench `--telemetry` / `--attribution` runs, or any
 `Telemetry(JsonlSink(...))` run):
 
@@ -15,6 +15,11 @@ Three subcommands, all reading the strict JSONL a `JsonlSink` wrote
   print the per-objective table; `--check` exits 1 when any objective is
   out of budget (alert fired, budget overspent, or an unrecovered worker
   loss) — the CI gate `scripts/run_ci.sh` uses on the chaos smoke.
+- `diff <a.jsonl> <b.jsonl>` — compare two streams under the SLO-replay
+  invariance contract (`bigdl_tpu.workload.diff`): outcome tallies,
+  slo_status trajectory, chaos trail, replay summary; exit 1 with a
+  first-divergence pointer when they disagree — the replay-invariance
+  CI gate.
 
 Exit codes: 0 = output printed and (with --check) every objective inside
 budget; 1 = --check found a violated objective; 2 = unreadable/empty
@@ -314,6 +319,44 @@ def slo(paths: List[str], check: bool = False,
     return 0
 
 
+def diff(path_a: str, path_b: str, out: TextIO = None) -> int:
+    """Compare two record streams under the SLO-replay invariance
+    contract (bigdl_tpu.workload.diff): outcome tallies by
+    (kind, status), the ordered `slo_status` trajectory with burn
+    rates, the chaos-action trail, replay progress, and the
+    `replay_summary` fingerprints. Exit 0 identical / 1 divergent
+    (first-divergence pointer printed) / 2 malformed. Works on any two
+    streams — two replays for the CI gate, or two live `slo --check`'d
+    runs side by side."""
+    out = out or sys.stdout
+    from bigdl_tpu.workload.diff import compare_streams
+    streams = []
+    for path in (path_a, path_b):
+        try:
+            streams.append(load_records(path))
+        except (OSError, ValueError) as e:
+            print(f"metrics_cli: cannot read {path}: {e}",
+                  file=sys.stderr)
+            return 2
+        if not streams[-1]:
+            print(f"metrics_cli: {path} holds no records",
+                  file=sys.stderr)
+            return 2
+    result = compare_streams(streams[0], streams[1])
+    w = out.write
+    w(f"== diff: {path_a} vs {path_b} ==\n")
+    if not result.divergent:
+        w("  identical under the invariance contract (outcome tallies, "
+          "slo_status trajectory, chaos trail, replay summary)\n")
+        return 0
+    w(f"  DIVERGENT ({len(result.details)} "
+      f"difference{'s' if len(result.details) != 1 else ''})\n")
+    w(f"  first divergence: {result.first}\n")
+    for d in result.details[1:]:
+        w(f"    {d}\n")
+    return 1
+
+
 _USAGE = """\
 usage: python -m bigdl_tpu.tools.metrics_cli <command> ...
   report [--lint-stream] <run.jsonl> [...] attribution tables; with
@@ -324,7 +367,13 @@ usage: python -m bigdl_tpu.tools.metrics_cli <command> ...
   trace  <trace_id> <run.jsonl> [...]      one request's critical path
   slo    [--check] [--latency-p99-ms N] [--error-objective F]
          [--mfu-floor F] [--mttr-s N] <run.jsonl> [...]
-                                           SLO replay / CI gate\
+                                           SLO replay / CI gate
+  diff   <a.jsonl> <b.jsonl>               compare two streams under the
+                                           SLO-replay invariance
+                                           contract; exit 0 identical /
+                                           1 divergent (with a first-
+                                           divergence pointer) /
+                                           2 malformed\
 """
 
 
@@ -334,10 +383,15 @@ def main(argv=None) -> int:
     if argv and argv[0] in ("-h", "--help"):
         print(_USAGE, file=sys.stderr)
         return 0
-    if not argv or argv[0] not in ("report", "trace", "slo"):
+    if not argv or argv[0] not in ("report", "trace", "slo", "diff"):
         print(_USAGE, file=sys.stderr)
         return 2
     cmd, rest = argv[0], argv[1:]
+    if cmd == "diff":
+        if len(rest) != 2:
+            print(_USAGE, file=sys.stderr)
+            return 2
+        return diff(rest[0], rest[1])
     if cmd == "report":
         do_lint = "--lint-stream" in rest
         rest = [a for a in rest if a != "--lint-stream"]

@@ -1,0 +1,39 @@
+"""Fleet-scale traffic record/replay (the serving plane's answer to
+elastic training's deterministic replay).
+
+Production traffic becomes a *workload file* — a strict-JSONL artifact
+holding arrival offsets, session ids, request shapes, deadlines, and
+idempotency flags (`WorkloadRecorder`, or the seeded
+Poisson/bursty/diurnal synthesizers). A `WorkloadReplayer` drives that
+file against a live `InferenceEngine` / `GenerationEngine` /
+`ServingFleet` at configurable time compression on an injectable
+clock, interleaved with a seeded declarative `ChaosSchedule` (replica
+kills/restores, autoscale churn, routing faults), and emits one
+CANONICAL deterministic telemetry stream. `compare_streams` (the
+engine under `metrics_cli diff`) then turns "did this PR change what
+the fleet does under Tuesday's traffic with a kill at peak?" into an
+exit code: same workload + same seed must reproduce the same outcome
+tallies and `slo_status` trajectory — the SLO-replay invariance gate
+`scripts/run_ci.sh` enforces. Scenario files live in
+`tests/workloads/`; the format and contract are `docs/workload.md`.
+"""
+
+from bigdl_tpu.workload.chaos import (CHAOS_ACTIONS, ChaosAction,
+                                      ChaosSchedule)
+from bigdl_tpu.workload.diff import (DiffResult, compare_streams,
+                                     load_stream)
+from bigdl_tpu.workload.record import (Workload, WorkloadEntry,
+                                       WorkloadRecorder, bursty_arrivals,
+                                       diurnal_arrivals, poisson_arrivals,
+                                       synthesize)
+from bigdl_tpu.workload.replay import (RealClock, VirtualClock,
+                                       WorkloadReplayer)
+
+__all__ = [
+    "CHAOS_ACTIONS", "ChaosAction", "ChaosSchedule",
+    "DiffResult", "compare_streams", "load_stream",
+    "Workload", "WorkloadEntry", "WorkloadRecorder",
+    "bursty_arrivals", "diurnal_arrivals", "poisson_arrivals",
+    "synthesize",
+    "RealClock", "VirtualClock", "WorkloadReplayer",
+]

@@ -86,6 +86,7 @@ def main(out_path=None):
     import bigdl_tpu.parallel as parallel
     import bigdl_tpu.resilience as resilience
     import bigdl_tpu.serving as serving
+    import bigdl_tpu.workload as workload
 
     out_path = out_path or os.path.join(
         os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
@@ -116,6 +117,9 @@ def main(out_path=None):
               _rows(observability, _public(observability)))
         _emit(f, "bigdl_tpu.serving — micro-batching inference engine",
               _rows(serving, _public(serving)))
+        _emit(f, "bigdl_tpu.workload — traffic record/replay, chaos "
+                 "schedules, SLO-replay diff",
+              _rows(workload, _public(workload)))
         _emit(f, "bigdl_tpu.analysis — project-specific static checkers",
               _rows(analysis, _public(analysis)))
     return out_path

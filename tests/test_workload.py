@@ -1,0 +1,565 @@
+"""Workload record/replay tests (bigdl_tpu/workload/).
+
+The contracts under test are the ones docs/workload.md promises: the
+seeded synthetic generators and `ChaosSchedule` are pure functions of
+their seed; workload files survive a save/load round-trip and the
+loader rejects malformed files with a `path:line` pointer; the
+`WorkloadRecorder` distills a live fleet's telemetry stream into the
+same entries the callers submitted (expanding sampled records,
+skipping fleet-internal casualties); and — the tentpole — the
+SLO-replay invariance contract: same workload + same seed replayed
+against the same target config yields a canonical stream
+`compare_streams` finds identical, while a perturbed seed or replica
+count diverges WITH a first-divergence pointer. Replays run over the
+`SimEngine`-style double from the fleet tests (no jit, no dispatcher
+thread) so the whole suite is fast; the real-engine path is covered by
+the `bench_cli --replay-invariance` CI smoke.
+"""
+
+import json
+import threading
+from collections import deque
+from concurrent.futures import Future, InvalidStateError
+
+import pytest
+
+from bigdl_tpu.observability import InMemorySink, Telemetry
+from bigdl_tpu.observability.export import PrometheusTextSink
+from bigdl_tpu.observability.slo import SloEngine, default_slos
+from bigdl_tpu.observability.telemetry import validate_record
+from bigdl_tpu.serving import ServingFleet
+from bigdl_tpu.serving.engine import EngineClosedError
+from bigdl_tpu.tools.metrics_cli import diff as cli_diff
+from bigdl_tpu.workload import (ChaosAction, ChaosSchedule, VirtualClock,
+                                Workload, WorkloadEntry, WorkloadRecorder,
+                                WorkloadReplayer, bursty_arrivals,
+                                compare_streams, diurnal_arrivals,
+                                poisson_arrivals, synthesize)
+
+
+# --------------------------------------------------------------------------
+# SimEngine: the engine-protocol stand-in (mirrors tests/test_fleet.py,
+# plus the session kwarg the workload path threads through)
+# --------------------------------------------------------------------------
+class SimEngine:
+    """No-jit, no-thread engine double: submits resolve immediately
+    with `(replica_id, sample)` and the last-seen deadline/session are
+    recorded for the pacing/deadline assertions."""
+
+    def __init__(self, replica_id):
+        self.replica_id = replica_id
+        self.held = deque()
+        self.closed = False
+        self.warmups = 0
+        self.submits = 0
+        self.last_deadline_ms = None
+        self.last_session = None
+        self._lock = threading.Lock()
+
+    def submit(self, sample, deadline_ms=None, session=None):
+        with self._lock:
+            if self.closed:
+                raise EngineClosedError(f"{self.replica_id} closed")
+            self.submits += 1
+            self.last_deadline_ms = deadline_ms
+            self.last_session = session
+        fut = Future()
+        try:
+            fut.set_result((self.replica_id, sample))
+        except InvalidStateError:
+            pass
+        return fut
+
+    def warmup(self, sample):
+        self.warmups += 1
+        return 0
+
+    def health(self):
+        return {"status": "ok", "open_buckets": [], "breakers": {},
+                "queue_depth": 0, "queue_capacity": 1024}
+
+    def stats(self):
+        return {"queue_depth": 0, "submitted": self.submits,
+                "completed": self.submits, "shed": 0}
+
+    def close(self, drain=True):
+        with self._lock:
+            self.closed = True
+
+
+def sim_fleet(n=3, telemetry=None, **kw):
+    """A fleet of SimEngines; returns (fleet, engines dict)."""
+    engines = {}
+
+    def factory(rid):
+        eng = SimEngine(rid)
+        engines[rid] = eng
+        return eng
+
+    kw.setdefault("warmup_sample", "w")
+    kw.setdefault("drain_grace_s", 0.2)
+    kw.setdefault("seed", 0)
+    fleet = ServingFleet(engine_factory=factory, n_replicas=n,
+                         telemetry=telemetry, **kw)
+    return fleet, engines
+
+
+def steady_workload(n=40, sessions=4, deadline_ms=60_000.0, seed=3,
+                    chaos=None, name="steady"):
+    return synthesize(name, poisson_arrivals(20.0, n / 20.0, seed=seed),
+                      seed=seed, shape=[4], deadline_ms=deadline_ms,
+                      sessions=sessions, chaos=chaos)
+
+
+def replay_once(workload, n_replicas=3, seed=1, chaos=None, slo=True,
+                **replayer_kw):
+    """One replay against a fresh sim fleet; returns (records, summary)."""
+    sink = InMemorySink()
+    tel = Telemetry(sink, resources=False)
+    if slo:
+        SloEngine(default_slos(latency_p99_ms=60_000.0),
+                  emit_every_s=0.25).attach(tel)
+    fleet, _ = sim_fleet(n=n_replicas)
+    try:
+        summary = WorkloadReplayer(
+            fleet, workload,
+            chaos=chaos if chaos is not None
+            else (ChaosSchedule.from_dicts(workload.chaos, seed=seed)
+                  if workload.chaos else None),
+            seed=seed, clock=VirtualClock(), telemetry=tel,
+            progress_every=10, **replayer_kw).run()
+    finally:
+        fleet.close()
+        tel.close()
+    return sink.records, summary
+
+
+# --------------------------------------------------------------------------
+# clocks and synthetic generators
+# --------------------------------------------------------------------------
+def test_virtual_clock_jumps_instead_of_waiting():
+    clk = VirtualClock(start=5.0)
+    assert clk.now() == 5.0
+    clk.sleep(2.5)
+    assert clk.now() == 7.5
+    clk.sleep(-3.0)  # the replayer computes negative waits when behind
+    assert clk.now() == 7.5
+
+
+@pytest.mark.parametrize("gen,kw", [
+    (poisson_arrivals, {"rate_per_s": 50.0, "duration_s": 1.0}),
+    (bursty_arrivals, {"rate_per_s": 50.0, "duration_s": 1.0}),
+    (diurnal_arrivals, {"rate_per_s": 50.0, "duration_s": 1.0}),
+])
+def test_generators_are_seeded_and_monotonic(gen, kw):
+    a = gen(seed=11, **kw)
+    b = gen(seed=11, **kw)
+    c = gen(seed=12, **kw)
+    assert a == b  # same seed => identical arrival list
+    assert a != c
+    assert a, "generator produced no arrivals"
+    assert all(x <= y for x, y in zip(a, a[1:]))
+    assert all(0 <= x <= kw["duration_s"] * 1e3 for x in a)
+
+
+def test_synthesize_deals_sessions_and_sorts():
+    wl = steady_workload(sessions=3)
+    assert len(wl) > 0
+    offs = [e.arrival_offset_ms for e in wl.entries]
+    assert offs == sorted(offs)
+    assert {e.session_id for e in wl.entries} == {"s0", "s1", "s2"}
+    assert all(e.deadline_ms == 60_000.0 for e in wl.entries)
+
+
+# --------------------------------------------------------------------------
+# workload files
+# --------------------------------------------------------------------------
+def test_workload_save_load_roundtrip(tmp_path):
+    chaos = [ChaosAction("kill", after_entries=5).to_dict(),
+             ChaosAction("restore", after_entries=10).to_dict()]
+    wl = steady_workload(chaos=chaos)
+    path = str(tmp_path / "wl.jsonl")
+    wl.save(path)
+    back = Workload.load(path)
+    assert back.name == wl.name
+    assert back.seed == wl.seed
+    assert back.chaos == chaos
+    assert [e.to_dict() for e in back.entries] == \
+        [e.to_dict() for e in wl.entries]
+    assert back.sha256() == wl.sha256()
+
+
+def test_workload_load_rejects_malformed(tmp_path):
+    # missing header
+    p = tmp_path / "headerless.jsonl"
+    p.write_text(json.dumps({"type": "workload_entry",
+                             "arrival_offset_ms": 0.0}) + "\n")
+    with pytest.raises(ValueError, match=r"headerless\.jsonl:1"):
+        Workload.load(str(p))
+    # non-monotonic offsets (hand-built file; save() cannot produce one)
+    p = tmp_path / "unsorted.jsonl"
+    p.write_text("\n".join([
+        json.dumps({"type": "workload", "version": 1, "name": "x",
+                    "seed": 0}),
+        json.dumps({"type": "workload_entry", "arrival_offset_ms": 10.0}),
+        json.dumps({"type": "workload_entry", "arrival_offset_ms": 5.0}),
+    ]) + "\n")
+    with pytest.raises(ValueError, match=r"unsorted\.jsonl:3"):
+        Workload.load(str(p))
+    # non-strict JSON constants must not parse
+    p = tmp_path / "nan.jsonl"
+    p.write_text(json.dumps({"type": "workload", "version": 1,
+                             "name": "x", "seed": 0})
+                 + '\n{"type": "workload_entry", '
+                 '"arrival_offset_ms": NaN}\n')
+    with pytest.raises(ValueError, match=r"nan\.jsonl:2"):
+        Workload.load(str(p))
+    # empty file
+    p = tmp_path / "empty.jsonl"
+    p.write_text("")
+    with pytest.raises(ValueError, match="empty workload"):
+        Workload.load(str(p))
+
+
+def test_scale_rate_compresses_offsets():
+    wl = steady_workload()
+    fast = wl.scale_rate(2.0)
+    assert len(fast) == len(wl)
+    for a, b in zip(fast.entries, wl.entries):
+        assert a.arrival_offset_ms == pytest.approx(
+            b.arrival_offset_ms / 2.0)
+
+
+# --------------------------------------------------------------------------
+# chaos schedules
+# --------------------------------------------------------------------------
+def test_chaos_schedule_random_is_seeded():
+    kw = dict(duration_ms=10_000.0, kills=2, restore_after_ms=500.0,
+              scale_events=1)
+    a = ChaosSchedule.random(5, **kw).to_dicts()
+    b = ChaosSchedule.random(5, **kw).to_dicts()
+    c = ChaosSchedule.random(6, **kw).to_dicts()
+    assert a == b
+    assert a != c
+
+
+def test_chaos_target_choice_is_seeded_per_fleet():
+    # an unpinned kill target is drawn from the schedule's rng over the
+    # SORTED active pool — same seed, same fleet shape => same victim
+    victims = []
+    for _ in range(2):
+        fleet, _ = sim_fleet(n=3)
+        try:
+            sched = ChaosSchedule([ChaosAction("kill", after_entries=1)],
+                                  seed=9)
+            events = sched.fire_due(fleet, offset_ms=0.0, entries_done=1)
+            assert len(events) == 1 and events[0]["ok"]
+            victims.append(events[0]["target"])
+        finally:
+            fleet.close()
+    assert victims[0] == victims[1]
+
+
+def test_chaos_kill_then_restore_round_trips_membership():
+    fleet, _ = sim_fleet(n=3)
+    try:
+        sched = ChaosSchedule([
+            ChaosAction("kill", after_entries=2, target="replica1"),
+            ChaosAction("restore", after_entries=4, target="replica1"),
+        ])
+        assert sched.fire_due(fleet, 0.0, entries_done=1) == []
+        ev = sched.fire_due(fleet, 0.0, entries_done=2)
+        assert [e["action"] for e in ev] == ["kill"]
+        assert "replica1" in fleet.replica_ids("lost")
+        ev = sched.fire_due(fleet, 0.0, entries_done=4)
+        assert [e["action"] for e in ev] == ["restore"]
+        assert ev[0]["ok"] is True
+        assert "replica1" in fleet.replica_ids("active")
+        # every action fires exactly once
+        assert sched.fire_due(fleet, 0.0, entries_done=99) == []
+    finally:
+        fleet.close()
+
+
+def test_chaos_action_validation():
+    with pytest.raises(ValueError):
+        ChaosAction("explode", after_entries=1)  # unknown action
+    with pytest.raises(ValueError):
+        ChaosAction("kill")  # no trigger
+    with pytest.raises(ValueError):
+        ChaosAction("kill", at_offset_ms=1.0, after_entries=1)  # both
+
+
+# --------------------------------------------------------------------------
+# recorder
+# --------------------------------------------------------------------------
+def test_recorder_roundtrip_from_live_fleet_stream():
+    # success traces come from the replica ENGINES (the fleet's own
+    # _trace_outcome only covers router-decided failures), so the
+    # double emits the engine-contract ok trace per submit
+    rec = WorkloadRecorder(name="live", seed=2)
+    tel = Telemetry(rec, resources=False)
+
+    class TracingSimEngine(SimEngine):
+        def submit(self, sample, deadline_ms=None, session=None):
+            fut = super().submit(sample, deadline_ms=deadline_ms,
+                                 session=session)
+            r = {"type": "trace", "trace_id": f"t{self.submits}",
+                 "kind": "serving_request", "status": "ok",
+                 "latency_ms": 0.1, "replica_id": self.replica_id,
+                 "deadline_budget_ms": deadline_ms}
+            if session is not None:
+                r["session_id"] = str(session)
+            tel.emit(r)
+            return fut
+
+    engines = {}
+
+    def factory(rid):
+        engines[rid] = TracingSimEngine(rid)
+        return engines[rid]
+
+    fleet = ServingFleet(engine_factory=factory, n_replicas=2,
+                         warmup_sample="w", drain_grace_s=0.2, seed=0,
+                         telemetry=tel)
+    try:
+        futs = [fleet.submit(f"x{i}", deadline_ms=60_000.0,
+                             session=f"s{i % 3}") for i in range(12)]
+        for f in futs:
+            f.result(timeout=10.0)
+    finally:
+        fleet.close()
+        tel.close()
+    wl = rec.workload()
+    assert len(wl) == 12
+    offs = [e.arrival_offset_ms for e in wl.entries]
+    assert offs == sorted(offs) and offs[0] == 0.0  # normalized t0
+    assert all(e.kind == "serving_request" for e in wl.entries)
+    # the router hands the engine the REMAINING budget, so recorded
+    # deadlines sit just under the caller's 60s
+    assert all(e.deadline_ms == pytest.approx(60_000.0, abs=100.0)
+               for e in wl.entries)
+    assert {e.session_id for e in wl.entries} == {"s0", "s1", "s2"}
+    # ...and the recorded workload replays clean
+    _, summary = replay_once(wl, n_replicas=2, slo=False)
+    assert summary["ok"] == 12 and summary["errors"] == 0
+
+
+def test_recorder_expands_sample_weight_and_skips_fleet_noise():
+    rec = WorkloadRecorder(name="sampled")
+    # a 1-in-3 sampled ok record stands for 3 arrivals
+    rec.emit({"type": "trace", "time": 100.0, "trace_id": "t1",
+              "kind": "serving_request", "status": "ok",
+              "latency_ms": 5.0, "sample_weight": 3})
+    # fleet-managed replica casualty: the fleet re-routed this one and
+    # emitted its own fleet_request outcome — recording both would
+    # double-count the caller's single arrival
+    rec.emit({"type": "trace", "time": 100.1, "trace_id": "t2",
+              "kind": "serving_request", "status": "cancelled",
+              "replica_id": "replica0", "latency_ms": 1.0})
+    # non-trace records pass through silently
+    rec.emit({"type": "step", "time": 100.2, "step": 1})
+    wl = rec.workload()
+    assert len(wl) == 3
+    assert all(e.kind == "serving_request" for e in wl.entries)
+
+
+# --------------------------------------------------------------------------
+# replay: the SLO-replay invariance contract
+# --------------------------------------------------------------------------
+def chaos_plan():
+    return [ChaosAction("kill", after_entries=10).to_dict(),
+            ChaosAction("restore", after_entries=25).to_dict()]
+
+
+def test_same_workload_same_seed_is_invariant():
+    wl = steady_workload(chaos=chaos_plan())
+    a, summary = replay_once(wl, seed=1)
+    b, _ = replay_once(wl, seed=1)
+    result = compare_streams(a, b)
+    assert not result.divergent, result.details
+    assert summary["entries_total"] == len(wl)
+    assert summary["ok"] == len(wl)
+    assert summary["chaos_fired"] == 2
+    assert summary["replicas"] == 3
+    # the slo trajectory is part of the compared stream, not vacuous
+    assert any(r["type"] == "slo_status" for r in a)
+    assert any(r["type"] == "event" and r.get("event") == "chaos_action"
+               for r in a)
+
+
+def test_perturbed_seed_diverges_with_pointer():
+    wl = steady_workload(chaos=chaos_plan())
+    a, _ = replay_once(wl, seed=1)
+    b, _ = replay_once(wl, seed=2)
+    result = compare_streams(a, b)
+    assert result.divergent
+    assert result.first.startswith("config[0].seed")
+
+
+def test_perturbed_replica_count_diverges_with_pointer():
+    wl = steady_workload(chaos=chaos_plan())
+    a, _ = replay_once(wl, n_replicas=3, seed=1)
+    b, _ = replay_once(wl, n_replicas=2, seed=1)
+    result = compare_streams(a, b)
+    assert result.divergent
+    assert result.first.startswith("config[0].replicas")
+
+
+def test_outcome_divergence_is_caught_not_just_config():
+    # same config fingerprint, different outcomes: doctor one stream's
+    # tally — the diff must point at the outcome section
+    wl = steady_workload()
+    a, _ = replay_once(wl, seed=1)
+    b = [dict(r) for r in a]
+    for r in b:
+        if r["type"] == "replay_summary":
+            r["ok"] -= 1
+            r["errors"] += 1
+    for r in b:
+        if r["type"] == "trace" and r["status"] == "ok":
+            r["status"] = "error"
+            break
+    result = compare_streams(a, b)
+    assert result.divergent
+    assert "outcome" in result.first or "summary" in result.first
+
+
+def test_replay_baseline_self_diff_stamps_summary():
+    wl = steady_workload()
+    # baseline without an SloEngine: the second run emits no
+    # slo_status records either, so the projected streams must match
+    a, _ = replay_once(wl, seed=1, slo=False)
+    sink = InMemorySink()
+    tel = Telemetry(sink, resources=False)
+    fleet, _ = sim_fleet(n=3)
+    try:
+        summary = WorkloadReplayer(fleet, wl, seed=1,
+                                   clock=VirtualClock(), telemetry=tel,
+                                   progress_every=10,  # heartbeat
+                                   # cadence is part of the stream
+                                   baseline=a).run()
+    finally:
+        fleet.close()
+        tel.close()
+    assert summary["divergent"] is False
+
+
+# --------------------------------------------------------------------------
+# replay: time compression and deadline semantics
+# --------------------------------------------------------------------------
+def test_time_compression_preserves_order_and_recorded_deadlines():
+    wl = steady_workload(sessions=0)
+    eng = SimEngine("solo")  # bare engine target: no fleet indirection
+    summary = WorkloadReplayer(eng, wl, speed=100.0,
+                               clock=VirtualClock()).run()
+    assert summary["ok"] == len(wl)
+    assert "replicas" not in summary  # not a fleet
+    # deadlines honored AS RECORDED under compression (the honest
+    # default: compressed arrivals, production deadline budgets)
+    assert eng.last_deadline_ms == 60_000.0
+    assert eng.submits == len(wl)
+
+
+def test_scale_deadlines_divides_budgets():
+    wl = steady_workload(sessions=0)
+    eng = SimEngine("solo")
+    WorkloadReplayer(eng, wl, speed=100.0, scale_deadlines=True,
+                     clock=VirtualClock()).run()
+    assert eng.last_deadline_ms == pytest.approx(600.0)
+
+
+def test_canonical_stream_is_ordered_and_virtual_timed():
+    wl = steady_workload(chaos=chaos_plan())
+    records, _ = replay_once(wl, seed=1, slo=False)
+    traces = [r for r in records if r["type"] == "trace"]
+    assert len(traces) == len(wl)
+    offs = [r["arrival_offset_ms"] for r in traces]
+    assert offs == sorted(offs)
+    assert [r["trace_id"] for r in traces] == \
+        [f"replay-{i:06d}" for i in range(len(wl))]
+    for r in traces:  # virtual time = epoch + offset, not wall clock
+        # (offset field is rounded to µs; time carries the exact value)
+        assert r["time"] == pytest.approx(r["arrival_offset_ms"] / 1e3,
+                                          abs=1e-6)
+        assert "latency_ms" in r
+    # replay and summary records validate against the closed schemas
+    for r in records:
+        if r["type"] in ("trace", "workload_replay", "replay_summary"):
+            validate_record(r)
+
+
+# --------------------------------------------------------------------------
+# diff CLI and Prometheus surfaces
+# --------------------------------------------------------------------------
+def _write_stream(path, records):
+    with open(path, "w") as f:
+        for r in records:
+            f.write(json.dumps(r) + "\n")
+
+
+def test_metrics_cli_diff_exit_codes(tmp_path, capsys):
+    wl = steady_workload()
+    a, _ = replay_once(wl, seed=1)
+    b, _ = replay_once(wl, seed=1)
+    p, _ = replay_once(wl, seed=2)
+    pa, pb, pp = (str(tmp_path / n) for n in ("a.jsonl", "b.jsonl",
+                                              "p.jsonl"))
+    _write_stream(pa, a)
+    _write_stream(pb, b)
+    _write_stream(pp, p)
+    assert cli_diff(pa, pb) == 0
+    assert "identical" in capsys.readouterr().out
+    assert cli_diff(pa, pp) == 1
+    out = capsys.readouterr().out
+    assert "DIVERGENT" in out and "first divergence" in out
+    # malformed / unreadable inputs are exit 2 (distinct from divergent)
+    bad = str(tmp_path / "bad.jsonl")
+    with open(bad, "w") as f:
+        f.write("not json\n")
+    assert cli_diff(pa, bad) == 2
+    assert cli_diff(pa, str(tmp_path / "missing.jsonl")) == 2
+    capsys.readouterr()
+
+
+def test_prometheus_renders_replay_gauges():
+    sink = PrometheusTextSink()
+    sink.emit({"type": "workload_replay", "time": 1.0, "workload": "wl",
+               "entries_total": 40, "entries_done": 20, "chaos_fired": 1,
+               "ok": 19, "errors": 1, "timeouts": 0, "shed": 0,
+               "offset_ms": 500.0})
+    sink.emit({"type": "replay_summary", "time": 2.0, "workload": "wl",
+               "entries_total": 40, "ok": 39, "errors": 1, "timeouts": 0,
+               "shed": 0, "chaos_fired": 2, "seed": 7,
+               "divergent": False})
+    text = sink.render()
+    assert 'bigdl_tpu_workload_replay_entries_done{workload="wl"} 20' \
+        in text
+    assert 'bigdl_tpu_workload_replay_ok_total{workload="wl"} 19' in text
+    assert 'bigdl_tpu_workload_replay_chaos_fired{workload="wl"} 1' \
+        in text
+    assert ('bigdl_tpu_workload_replay_divergent'
+            '{workload="wl",seed="7"} 0') in text
+    assert ('bigdl_tpu_workload_replay_complete'
+            '{workload="wl",seed="7"} 1') in text
+
+
+# --------------------------------------------------------------------------
+# checked-in scenario files
+# --------------------------------------------------------------------------
+def test_checked_in_scenarios_load_and_replay(request):
+    wl_dir = request.path.parent / "workloads"
+    paths = sorted(wl_dir.glob("*.jsonl"))
+    assert paths, "tests/workloads/ scenario files missing"
+    for p in paths:
+        wl = Workload.load(str(p))
+        assert len(wl) > 0
+        offs = [e.arrival_offset_ms for e in wl.entries]
+        assert offs == sorted(offs)
+    # the chaos scenario holds the invariance contract end to end
+    wl = Workload.load(str(wl_dir / "kill_at_peak.jsonl"))
+    assert wl.chaos, "kill_at_peak.jsonl must embed a chaos plan"
+    a, summary = replay_once(wl, seed=4)
+    b, _ = replay_once(wl, seed=4)
+    assert not compare_streams(a, b).divergent
+    assert summary["chaos_fired"] == len(wl.chaos)
